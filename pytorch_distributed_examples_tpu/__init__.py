@@ -1,0 +1,15 @@
+"""Alias package mirroring the reference suite's name.
+
+The canonical implementation is :mod:`tpudist`; this package re-exports it so
+`import pytorch_distributed_examples_tpu as pde` works for users arriving
+from the reference repo's naming (`ArnauGabrielAtienza/pytorch_distributed_examples`).
+"""
+
+import sys as _sys
+
+import tpudist as _t
+from tpudist import *  # noqa: F401,F403
+from tpudist import __all__, __version__  # noqa: F401
+
+for _sub in ("models", "ops", "parallel", "utils", "data", "elastic", "runtime", "train"):
+    _sys.modules[__name__ + "." + _sub] = getattr(_t, _sub)

@@ -1,0 +1,36 @@
+"""Test harness: simulate an 8-device mesh on host CPU.
+
+The TPU-native analog of the reference's ``mp.spawn``-on-localhost pattern
+(`model_parallel_ResNet50.py:260` — SURVEY.md §4): a multi-device topology
+exercisable on one host, so mesh/sharding/checkpoint/elastic code runs in CI
+without a TPU.  Real-hardware smoke tests live in ``tests/tpu/`` and are
+skipped unless a TPU backend is present.
+
+Platform forcing is belt-and-braces: the ambient environment may register a
+real TPU backend at interpreter startup AND force ``jax_platforms`` via
+``jax.config`` (which overrides the ``JAX_PLATFORMS`` env var), so we update
+the config again after importing jax — unit tests must never touch real
+hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after the env is set)
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must run before any jax import"
+    return devs[:8]

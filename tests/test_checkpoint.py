@@ -1,0 +1,74 @@
+import numpy as np
+import pytest
+
+from tpudist.elastic.checkpoint import (
+    Checkpointer,
+    latest_step,
+    restore_pytree,
+    save_pytree,
+)
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {"w": rng.random((4, 3), dtype=np.float32), "b": rng.random(3, dtype=np.float32)},
+        "opt": [rng.random(2, dtype=np.float32), np.int32(7)],
+    }
+
+
+def test_roundtrip(tmp_path):
+    tree = _tree()
+    save_pytree(tmp_path / "ckpt.npz", tree, meta={"epoch": 3})
+    restored, meta = restore_pytree(tmp_path / "ckpt.npz", _tree(seed=1))
+    assert meta == {"epoch": 3}
+    np.testing.assert_array_equal(restored["params"]["w"], tree["params"]["w"])
+    np.testing.assert_array_equal(restored["opt"][1], tree["opt"][1])
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    save_pytree(tmp_path / "c.npz", {"w": np.zeros((2, 2))})
+    with pytest.raises(ValueError):
+        restore_pytree(tmp_path / "c.npz", {"w": np.zeros((3, 3))})
+
+
+def test_missing_leaf_rejected(tmp_path):
+    save_pytree(tmp_path / "c.npz", {"w": np.zeros(2)})
+    with pytest.raises(KeyError):
+        restore_pytree(tmp_path / "c.npz", {"w": np.zeros(2), "extra": np.zeros(1)})
+
+
+def test_checkpointer_latest_and_retention(tmp_path):
+    ckpt = Checkpointer(tmp_path, keep=2)
+    for step in (1, 5, 9):
+        ckpt.save(step, _tree(step))
+    assert latest_step(tmp_path) == 9
+    step, tree, meta = ckpt.restore_latest(_tree())
+    assert step == 9
+    np.testing.assert_array_equal(tree["params"]["w"], _tree(9)["params"]["w"])
+    # retention dropped step_1
+    assert not (tmp_path / "step_1").exists()
+    assert (tmp_path / "step_5").exists()
+
+
+def test_checkpointer_ignores_uncommitted(tmp_path):
+    ckpt = Checkpointer(tmp_path)
+    ckpt.save(3, _tree())
+    # a torn checkpoint: directory exists, no COMMITTED marker
+    (tmp_path / "step_7").mkdir()
+    (tmp_path / "step_7" / "state.npz").write_bytes(b"garbage")
+    assert latest_step(tmp_path) == 3
+
+
+def test_async_save(tmp_path):
+    ckpt = Checkpointer(tmp_path, async_save=True)
+    tree = _tree()
+    ckpt.save(1, tree)
+    tree["params"]["w"][:] = -1  # mutate after save returns: must not affect checkpoint
+    ckpt.wait()
+    _, restored, _ = ckpt.restore_latest(_tree(1))
+    assert not np.any(restored["params"]["w"] == -1)
+
+
+def test_restore_latest_empty(tmp_path):
+    assert Checkpointer(tmp_path / "nope").restore_latest(_tree()) is None

@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+from tpudist.data import (
+    ShardedLoader,
+    ShardedSampler,
+    load_mnist,
+    ragged_embedding_batches,
+    synthetic_images,
+)
+from tpudist.data.mnist import MNIST_MEAN, MNIST_STD, synthetic_mnist
+from tpudist.runtime.mesh import data_mesh
+
+
+class TestShardedSampler:
+    def test_covers_all_indices_disjointly(self):
+        n, world = 103, 4
+        samplers = [ShardedSampler(n, world, r, shuffle=True, seed=1) for r in range(world)]
+        all_idx = np.concatenate([s.indices(epoch=0) for s in samplers])
+        # padded by wrap-around to equal shard sizes (DistributedSampler semantics)
+        assert all(len(s.indices(0)) == -(-n // world) for s in samplers)
+        assert set(all_idx) == set(range(n))
+
+    def test_epoch_seeding(self):
+        s = ShardedSampler(100, 2, 0, shuffle=True, seed=3)
+        a, b = s.indices(epoch=0), s.indices(epoch=1)
+        assert not np.array_equal(a, b)
+        assert np.array_equal(a, s.indices(epoch=0))  # deterministic
+
+    def test_no_shuffle_natural_order(self):
+        s = ShardedSampler(8, 2, 1, shuffle=False)
+        assert np.array_equal(s.indices(0), [1, 3, 5, 7])
+
+    def test_drop_last(self):
+        s = ShardedSampler(10, 4, 0, drop_last=True)
+        assert s.shard_size == 2
+
+    def test_bad_shard(self):
+        with pytest.raises(ValueError):
+            ShardedSampler(10, 2, 5)
+
+
+class TestMnist:
+    def test_synthetic_shapes_and_norm(self):
+        ds = synthetic_mnist("train", n=256)
+        assert ds.images.shape == (256, 28, 28, 1)
+        assert ds.labels.shape == (256,)
+        assert ds.num_classes == 10
+        # un-normalized pixel range maps back into [0, 1]
+        raw = ds.images * MNIST_STD + MNIST_MEAN
+        assert raw.min() >= -1e-5 and raw.max() <= 1 + 1e-5
+
+    def test_synthetic_deterministic_and_split_disjoint(self):
+        a = synthetic_mnist("train", n=64)
+        b = synthetic_mnist("train", n=64)
+        assert np.array_equal(a.images, b.images)
+        t = synthetic_mnist("test", n=64)
+        assert not np.array_equal(a.images, t.images)
+
+    def test_load_mnist_falls_back(self):
+        ds = load_mnist("test", n=128)
+        assert len(ds) == 128
+
+
+class TestSynthetic:
+    def test_images(self):
+        x, y = synthetic_images(4, hw=32, num_classes=10)
+        assert x.shape == (4, 32, 32, 3)
+        assert y.shape == (4, 10)
+        assert np.allclose(y.sum(axis=1), 1.0)
+
+    def test_ragged_batches(self):
+        batches = list(ragged_embedding_batches(3, batch=10, max_len=10))
+        assert len(batches) == 3
+        idx, mask, tgt = batches[0]
+        assert idx.shape == (10, 10) and mask.shape == (10, 10) and tgt.shape == (10,)
+        lengths = mask.sum(axis=1)
+        assert lengths.min() >= 2 and lengths.max() <= 10
+        assert (idx < 100).all() and (tgt < 8).all()
+
+
+class TestShardedLoader:
+    def test_host_only(self):
+        x = np.arange(40).reshape(20, 2).astype(np.float32)
+        y = np.arange(20)
+        loader = ShardedLoader([x, y], global_batch=4)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 5
+        assert batches[0][0].shape == (4, 2)
+
+    def test_sharded_placement(self, devices8):
+        mesh = data_mesh(8)
+        x = np.random.default_rng(0).random((64, 3), dtype=np.float32)
+        y = np.arange(64)
+        loader = ShardedLoader([x, y], global_batch=16, mesh=mesh)
+        xb, yb = next(iter(loader))
+        assert xb.shape == (16, 3)
+        assert len(xb.sharding.device_set) == 8
+        # each device's shard matches its sampler's stream
+        np.testing.assert_array_equal(np.asarray(yb)[:2], [0, 8])
+
+    def test_shuffled_epochs_differ(self):
+        x = np.arange(32, dtype=np.float32)[:, None]
+        loader = ShardedLoader([x], global_batch=8, shuffle=True, seed=0)
+        e0 = np.concatenate([b[0] for b in loader.epoch(0)])
+        e1 = np.concatenate([b[0] for b in loader.epoch(1)])
+        assert not np.array_equal(e0, e1)
+        assert set(e0.ravel()) == set(e1.ravel())

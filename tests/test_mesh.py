@@ -1,0 +1,44 @@
+import jax
+import pytest
+
+from tpudist.runtime import mesh as M
+
+
+def test_data_mesh_all_devices():
+    m = M.data_mesh()
+    assert m.axis_names == ("data",)
+    assert m.shape["data"] == len(jax.devices())
+
+
+def test_make_mesh_wildcard():
+    m = M.make_mesh({"data": -1, "model": 2})
+    assert m.shape["model"] == 2
+    assert m.shape["data"] == len(jax.devices()) // 2
+
+
+def test_mesh_spec_errors():
+    with pytest.raises(ValueError):
+        M.MeshSpec({"a": -1, "b": -1}).resolve(8)
+    with pytest.raises(ValueError):
+        M.MeshSpec({"a": 3}).resolve(8)
+    with pytest.raises(ValueError):
+        M.make_mesh({"data": 5}, jax.devices()[:4])
+
+
+def test_pipeline_and_dm_meshes():
+    pm = M.pipeline_mesh(stages=2)
+    assert pm.shape["stage"] == 2
+    dm = M.data_model_mesh(model=4)
+    assert dm.shape["model"] == 4
+
+
+def test_local_batch_size():
+    m = M.data_mesh(4)
+    assert M.local_batch_size(128, m) == 32
+    with pytest.raises(ValueError):
+        M.local_batch_size(130, m)
+
+
+def test_get_devices_too_many():
+    with pytest.raises(ValueError):
+        M.get_devices(10_000)

@@ -1,0 +1,70 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudist.models import MLP, ConvNet, EmbeddingBagClassifier, ResNet50, resnet50_stages
+
+
+def test_mlp_shapes_and_param_structure():
+    model = MLP(hidden_layers=2, features=64)
+    x = jnp.zeros((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (4, 10)
+    # 1 input + hidden_layers + 1 output Dense layers
+    assert len(params["params"]) == 4
+
+
+def test_convnet_shapes():
+    model = ConvNet()
+    x = jnp.zeros((2, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 10)
+    # dropout active only in train mode and needs an rng
+    out = model.apply(params, x, train=True, rngs={"dropout": jax.random.key(1)})
+    assert out.shape == (2, 10)
+
+
+def test_convnet_flatten_width_matches_reference():
+    # reference Net flattens to 320 (`mnist_horovod.py:21`): 4*4*20
+    model = ConvNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    assert params["params"]["Dense_0"]["kernel"].shape == (320, 50)
+
+
+def test_resnet50_stage_split():
+    stages = resnet50_stages(2, num_classes=13)
+    assert len(stages) == 2
+    # reference split: 3+4 blocks in stage 1, 6+3 in stage 2
+    assert len(stages[0].blocks) == 7 and stages[0].with_stem
+    assert len(stages[1].blocks) == 9 and stages[1].with_head
+
+    x = jnp.zeros((2, 64, 64, 3))
+    p1 = stages[0].init(jax.random.key(0), x)
+    h = stages[0].apply(p1, x)
+    assert h.shape == (2, 8, 8, 512)  # 64/8 spatial, 128*4 channels after layer2
+    p2 = stages[1].init(jax.random.key(1), h)
+    logits = stages[1].apply(p2, h)
+    assert logits.shape == (2, 13)
+    assert logits.dtype == jnp.float32
+
+
+def test_resnet50_full_model_matches_two_stage_depth():
+    model = ResNet50(num_classes=7, compute_dtype=jnp.float32)
+    x = jnp.zeros((1, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)
+    assert model.apply(params, x).shape == (1, 7)
+
+
+def test_embedding_bag_classifier():
+    model = EmbeddingBagClassifier()
+    idx = jnp.zeros((5, 10), jnp.int32)
+    mask = jnp.ones((5, 10), jnp.float32)
+    params = model.init(jax.random.key(0), idx, mask)
+    assert params["params"]["embedding"].shape == (100, 16)
+    logits = model.apply(params, idx, mask)
+    assert logits.shape == (5, 8)
+    # masked positions must not contribute: zero mask -> bias-only logits
+    z = model.apply(params, idx, jnp.zeros_like(mask))
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z[0:1]).repeat(5, 0), rtol=1e-6)

@@ -1,0 +1,60 @@
+"""End-to-end Trainer slice on the 8-device mesh: learns, snapshots, resumes
+(`mnist_ddp_elastic.py` Trainer parity, SURVEY.md §7 step 4-5)."""
+
+import jax
+import numpy as np
+import optax
+
+from tpudist.data.loader import ShardedLoader
+from tpudist.data.mnist import synthetic_mnist
+from tpudist.models import MLP
+from tpudist.runtime.mesh import data_mesh
+from tpudist.train.trainer import Trainer, TrainerConfig
+
+
+def _make_trainer(tmp_path, epochs=2, n=512):
+    mesh = data_mesh(8)
+    train_ds = synthetic_mnist("train", n=n)
+    test_ds = synthetic_mnist("test", n=256)
+    train_loader = ShardedLoader(
+        [train_ds.images, train_ds.labels], global_batch=64, mesh=mesh, shuffle=True
+    )
+    test_loader = ShardedLoader([test_ds.images, test_ds.labels], global_batch=64, mesh=mesh)
+    model = MLP(hidden_layers=1, features=64)
+    params = model.init(jax.random.key(0), train_ds.images[:1])["params"]
+    config = TrainerConfig(
+        total_epochs=epochs,
+        save_every=1,
+        batch_size=64,
+        snapshot_path=str(tmp_path / "snapshot.npz"),
+        log_every=1000,
+    )
+    return Trainer(
+        config, model.apply, params, optax.adam(1e-3), mesh, train_loader, test_loader
+    ), mesh
+
+
+def test_trainer_learns_and_snapshots(tmp_path):
+    trainer, _ = _make_trainer(tmp_path, epochs=3)
+    summary = trainer.train()
+    assert summary["test_accuracy"] > 0.9  # synthetic digits are easy
+    assert (tmp_path / "snapshot.npz").exists()
+    assert summary["images_per_sec"] > 0
+
+
+def test_trainer_resumes_from_snapshot(tmp_path):
+    trainer, _ = _make_trainer(tmp_path, epochs=2)
+    trainer.train()
+    step_after = int(jax.device_get(trainer.state.step))
+
+    resumed, _ = _make_trainer(tmp_path, epochs=2)
+    # snapshot said 2 epochs already ran -> nothing left to do
+    assert resumed.epochs_run == 2
+    assert int(jax.device_get(resumed.state.step)) == step_after
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(resumed.state.params)[0]),
+        np.asarray(jax.tree.leaves(trainer.state.params)[0]),
+    )
+    # training further continues from epoch 2
+    resumed.train(max_epochs=3)
+    assert resumed.epochs_run == 3

@@ -1,0 +1,23 @@
+"""Data layer: datasets, per-host sharding, device feeding.
+
+TPU-native replacement of the reference's L2 data stack (torchvision MNIST +
+DataLoader + DistributedSampler — SURVEY.md §1 L2): pure-numpy ingestion, an
+epoch-seeded sharding sampler with DistributedSampler-compatible semantics,
+and batch iterators that land data directly in the right device sharding.
+"""
+
+from tpudist.data.loader import ShardedLoader
+from tpudist.data.mnist import MNIST_MEAN, MNIST_STD, Dataset, load_mnist
+from tpudist.data.sampler import ShardedSampler
+from tpudist.data.synthetic import ragged_embedding_batches, synthetic_images
+
+__all__ = [
+    "Dataset",
+    "MNIST_MEAN",
+    "MNIST_STD",
+    "ShardedLoader",
+    "ShardedSampler",
+    "load_mnist",
+    "ragged_embedding_batches",
+    "synthetic_images",
+]

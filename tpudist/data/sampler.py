@@ -1,0 +1,65 @@
+"""Epoch-seeded sharding sampler — the DistributedSampler equivalent.
+
+Reproduces the semantics the reference relies on
+(`mnist_ddp_elastic.py:183-189`, `mnist_horovod.py:41-42` — SURVEY.md §7
+hard part (c)):
+
+* the index list is padded by wrap-around so every shard has equal length
+  (``ceil(N / world) * world``), exactly like
+  ``torch.utils.data.DistributedSampler(drop_last=False)``;
+* shard ``r`` takes indices ``perm[r::world]`` (rank-strided);
+* ``shuffle=True`` permutes with a generator seeded by ``seed + epoch`` —
+  the ``set_epoch`` contract (`mnist_ddp_elastic.py:84,89`);
+* ``shuffle=False`` keeps natural order (the DDP example's configuration,
+  `mnist_ddp_elastic.py:184-188`).
+
+On TPU the "rank" is a *data-shard index*: with a single controller per host
+feeding ``local_device_count`` devices, each host materializes the union of
+its devices' shards and the batch is laid out so device d receives shard
+``host_offset + d``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ShardedSampler:
+    n: int
+    num_shards: int
+    shard: int
+    shuffle: bool = False
+    seed: int = 0
+    drop_last: bool = False
+
+    def __post_init__(self) -> None:
+        if not (0 <= self.shard < self.num_shards):
+            raise ValueError(f"shard {self.shard} out of range [0, {self.num_shards})")
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    @property
+    def shard_size(self) -> int:
+        if self.drop_last:
+            return self.n // self.num_shards
+        return -(-self.n // self.num_shards)  # ceil
+
+    def indices(self, epoch: int | None = None) -> np.ndarray:
+        epoch = self.epoch if epoch is None else epoch
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(self.n)
+        else:
+            order = np.arange(self.n)
+        if self.drop_last:
+            total = self.shard_size * self.num_shards
+            order = order[:total]
+        else:
+            total = self.shard_size * self.num_shards
+            if total > self.n:  # pad by wrap-around, as DistributedSampler does
+                order = np.concatenate([order, order[: total - self.n]])
+        return order[self.shard :: self.num_shards]

@@ -1,0 +1,64 @@
+"""Synthetic data generators for the non-MNIST workloads.
+
+Twins of the reference's synthetic streams:
+
+* random images + one-hot labels for the ResNet50 pipeline
+  (`model_parallel_ResNet50.py:208-217`: 3 batches of 32×3×128×128, 1000
+  one-hot classes) — here NHWC and any batch size;
+* ragged EmbeddingBag batches (`server_model_data_parallel.py:49-68`: 20-50
+  indices over 100 embeddings, ragged offsets, 8-class targets), re-expressed
+  as *static-shape* padded ``[batch, max_len]`` index matrices + masks,
+  because dynamic raggedness defeats XLA; padding + mask is the TPU-native
+  encoding of the same information.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def synthetic_images(
+    batch: int,
+    *,
+    hw: int = 128,
+    channels: int = 3,
+    num_classes: int = 1000,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One batch of random NHWC images and one-hot labels
+    (`model_parallel_ResNet50.py:208-217` equivalent)."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((batch, hw, hw, channels), dtype=np.float32)
+    labels = rng.integers(0, num_classes, size=batch)
+    one_hot = np.zeros((batch, num_classes), dtype=np.float32)
+    one_hot[np.arange(batch), labels] = 1.0
+    return x, one_hot
+
+
+def ragged_embedding_batches(
+    num_batches: int,
+    batch: int = 10,
+    *,
+    num_embeddings: int = 100,
+    max_len: int = 10,
+    min_len: int = 2,
+    num_classes: int = 8,
+    seed: int = 0,
+) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Yield ``(indices [B, max_len], mask [B, max_len], target [B])``.
+
+    Matches the intent of ``get_next_batch`` (`server_model_data_parallel.py:
+    49-68`): each sample looks up a random ragged set of embedding rows,
+    summed (mode="sum").  The reference's offsets encoding becomes a padding
+    mask.  (The reference function as committed has a latent arity bug,
+    SURVEY.md §3.5 — the documented intent is implemented, not the bug.)
+    """
+    rng = np.random.default_rng(seed)
+    for _ in range(num_batches):
+        lengths = rng.integers(min_len, max_len + 1, size=batch)
+        indices = rng.integers(0, num_embeddings, size=(batch, max_len)).astype(np.int32)
+        mask = (np.arange(max_len)[None, :] < lengths[:, None]).astype(np.float32)
+        target = rng.integers(0, num_classes, size=batch).astype(np.int32)
+        yield indices, mask, target

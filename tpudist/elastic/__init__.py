@@ -1,0 +1,32 @@
+"""Elastic training: checkpoint/resume, commit/rollback, restart loop.
+
+The reference's signature capability (SURVEY.md §5 "Failure detection"),
+delivered there by two external mechanisms (TorchElastic rendezvous +
+re-exec, `mnist_ddp_elastic.py:5-6`; Horovod elastic commit/rollback,
+`horovod_mnist_elastic.py:55-108`).  tpudist unifies both into one model:
+durable checkpoints (:mod:`checkpoint`) + in-memory commits
+(:class:`ElasticState`) + a supervising run loop (:func:`elastic_run`) that
+rolls back to the last commit and re-enters training when workers fail or
+the world resizes, firing reset hooks (lr rescale etc.) on membership change.
+"""
+
+from tpudist.elastic.checkpoint import (
+    Checkpointer,
+    latest_step,
+    restore_pytree,
+    save_pytree,
+)
+from tpudist.elastic.state import ElasticState, HostDataState
+from tpudist.elastic.loop import WorldChanged, WorkerFailure, elastic_run
+
+__all__ = [
+    "Checkpointer",
+    "ElasticState",
+    "HostDataState",
+    "WorkerFailure",
+    "WorldChanged",
+    "elastic_run",
+    "latest_step",
+    "restore_pytree",
+    "save_pytree",
+]

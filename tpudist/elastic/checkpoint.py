@@ -1,0 +1,134 @@
+"""Durable pytree checkpoints.
+
+Replaces ``torch.save({MODEL_STATE, EPOCHS_RUN})`` snapshots
+(`mnist_ddp_elastic.py:95-104,61-68`) with full-train-state checkpoints
+(params + optimizer state + step + RNG — SURVEY.md §5), written atomically
+(tmp file + rename) so a preemption mid-write can never corrupt the latest
+restore point.  Format: one ``.npz`` archive keyed by pytree paths + a JSON
+metadata sidecar; no framework objects are pickled, so checkpoints are
+readable by any numpy, and restores are validated leaf-by-leaf against the
+template's shapes.
+
+``Checkpointer`` adds step-numbered directories, retention, and optional
+async (background-thread) saves — the device→host copy happens synchronously
+(cheap) and the disk write overlaps the next steps, which is what makes
+frequent elastic commits affordable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from tpudist.utils.trees import flatten_with_names, tree_to_numpy, unflatten_like
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def save_pytree(path: str | os.PathLike, tree: Any, meta: dict | None = None) -> None:
+    """Atomically write ``tree`` (+ JSON-serializable ``meta``) to ``path``
+    (a ``.npz`` file; ``<path>.meta.json`` sidecar)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    named = flatten_with_names(tree_to_numpy(tree))
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **named)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if meta is not None:
+        mtmp = path.with_suffix(".meta.json.tmp")
+        mtmp.write_text(json.dumps(meta))
+        os.replace(mtmp, path.with_suffix(".meta.json"))
+
+
+def restore_pytree(path: str | os.PathLike, template: Any) -> tuple[Any, dict]:
+    """Load a checkpoint into the structure of ``template`` (shape-checked).
+    Returns ``(tree, meta)``."""
+    path = Path(path)
+    with np.load(path) as archive:
+        named = {k: archive[k] for k in archive.files}
+    tree = unflatten_like(template, named)
+    meta_path = path.with_suffix(".meta.json")
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    return tree, meta
+
+
+def latest_step(directory: str | os.PathLike) -> int | None:
+    """Highest step with a *complete* checkpoint in ``directory``."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    steps = []
+    for child in directory.iterdir():
+        m = _STEP_RE.match(child.name)
+        if m and (child / "state.npz").exists() and (child / "COMMITTED").exists():
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+class Checkpointer:
+    """Step-numbered checkpoint directory manager.
+
+    Layout: ``<dir>/step_<N>/state.npz`` (+ meta) with a ``COMMITTED``
+    marker written last — readers only trust marked checkpoints, making the
+    save atomic at the directory level too.
+    """
+
+    def __init__(self, directory: str | os.PathLike, keep: int = 3,
+                 async_save: bool = False) -> None:
+        self.directory = Path(directory)
+        self.keep = keep
+        self.async_save = async_save
+        self._thread: threading.Thread | None = None
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def save(self, step: int, tree: Any, meta: dict | None = None) -> None:
+        # Snapshot to host synchronously: the caller may mutate/donate the
+        # device buffers immediately after we return.
+        host_tree = tree_to_numpy(tree)
+        self.wait()
+        if self.async_save:
+            self._thread = threading.Thread(
+                target=self._write, args=(step, host_tree, meta), daemon=True
+            )
+            self._thread.start()
+        else:
+            self._write(step, host_tree, meta)
+
+    def _write(self, step: int, host_tree: Any, meta: dict | None) -> None:
+        step_dir = self.directory / f"step_{step}"
+        save_pytree(step_dir / "state.npz", host_tree, meta)
+        (step_dir / "COMMITTED").touch()
+        self._retain()
+
+    def _retain(self) -> None:
+        steps = sorted(
+            int(m.group(1))
+            for child in self.directory.iterdir()
+            if (m := _STEP_RE.match(child.name))
+        )
+        for old in steps[: -self.keep] if self.keep else []:
+            shutil.rmtree(self.directory / f"step_{old}", ignore_errors=True)
+
+    def restore_latest(self, template: Any) -> tuple[int, Any, dict] | None:
+        """Return ``(step, tree, meta)`` for the newest complete checkpoint,
+        or None when the directory holds none (fresh start)."""
+        self.wait()
+        step = latest_step(self.directory)
+        if step is None:
+            return None
+        tree, meta = restore_pytree(self.directory / f"step_{step}" / "state.npz", template)
+        return step, tree, meta

@@ -1,0 +1,101 @@
+"""Commit/rollback elastic state — the ``hvd.elastic.TorchState`` twin.
+
+The reference wraps model+optimizer+counters in a ``TorchState`` whose
+``commit()`` is a consistency barrier + in-memory backup, rolled back when
+membership changes (`horovod_mnist_elastic.py:104,71-72` — SURVEY.md §3.3).
+Here the device-side train state is one pytree, so commit = device→host
+snapshot (and optionally a durable checkpoint via
+:class:`tpudist.elastic.checkpoint.Checkpointer`), rollback = re-placement of
+the committed pytree.
+
+The reference's committed batch index lags the true position by one batch and
+only protects the first resumed epoch (quirk documented in SURVEY.md §3.3);
+here ``HostDataState`` is committed atomically with the device state, so
+resume lands exactly on the committed (epoch, batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+
+from tpudist.elastic.checkpoint import Checkpointer
+from tpudist.utils.trees import host_to_leaf, tree_to_numpy
+
+ResetCallback = Callable[["ElasticState", int, int], None]
+
+
+@dataclasses.dataclass
+class HostDataState:
+    """Host-side progress counters committed with the device state
+    (epoch + batch offset, the ``TorchState(batch=0, epoch=0)`` fields,
+    `horovod_mnist_elastic.py:104`)."""
+
+    epoch: int = 0
+    batch: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ElasticState:
+    """Holds the live train-state pytree plus host counters, with
+    commit/rollback and world-size reset callbacks."""
+
+    def __init__(
+        self,
+        state: Any,
+        host: HostDataState | None = None,
+        checkpointer: Checkpointer | None = None,
+        world_size: int = 1,
+    ) -> None:
+        self.state = state
+        self.host = host or HostDataState()
+        self.checkpointer = checkpointer
+        self.world_size = world_size
+        self._reset_callbacks: list[ResetCallback] = []
+        self._committed_state: Any = None
+        self._committed_host: HostDataState | None = None
+        self.commits = 0
+        self.rollbacks = 0
+        self.commit()  # initial state is always restorable
+
+    def register_reset_callbacks(self, callbacks: Sequence[ResetCallback]) -> None:
+        """`state.register_reset_callbacks([on_state_reset])` parity
+        (`horovod_mnist_elastic.py:105`)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def commit(self) -> None:
+        """Consistency point: snapshot device state to host memory; also a
+        durable checkpoint when a checkpointer is attached (strictly stronger
+        than the reference's memory-only commit)."""
+        self._committed_state = tree_to_numpy(self.state)
+        self._committed_host = dataclasses.replace(self.host)
+        self.commits += 1
+        if self.checkpointer is not None:
+            self.checkpointer.save(
+                int(jax.device_get(self.state.step)) if hasattr(self.state, "step")
+                else self.commits,
+                self.state,
+                meta={**self.host.as_dict(), "world_size": self.world_size},
+            )
+
+    def rollback(self) -> None:
+        """Restore the last committed (device state, host counters)."""
+        if self._committed_state is None:
+            raise RuntimeError("nothing committed")
+        template = self.state
+        self.state = jax.tree.map(host_to_leaf, template, self._committed_state)
+        self.host = dataclasses.replace(self._committed_host)
+        self.rollbacks += 1
+
+    def on_world_change(self, new_world_size: int) -> None:
+        """Rollback + fire reset callbacks — what ``@hvd.elastic.run`` does on
+        worker add/drop (`horovod_mnist_elastic.py:80-82`: lr/√N rescale)."""
+        old = self.world_size
+        self.rollback()
+        self.world_size = new_world_size
+        for cb in self._reset_callbacks:
+            cb(self, old, new_world_size)

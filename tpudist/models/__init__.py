@@ -1,0 +1,15 @@
+"""Model zoo (flax.linen).
+
+One shared zoo replaces the reference's copy-pasted model definitions
+(SURVEY.md §2.1 duplication note): MLP (`mnist_ddp_elastic.py:133-159`),
+LeNet-style ConvNet (`mnist_horovod.py:9-25` ≡ `horovod_mnist_elastic.py:
+16-32`), two-stage ResNet50 (`model_parallel_ResNet50.py:43-139`), and the
+EmbeddingBag+Linear hybrid (`server_model_data_parallel.py:34-46`).
+"""
+
+from tpudist.models.convnet import ConvNet
+from tpudist.models.embedding import EmbeddingBagClassifier
+from tpudist.models.mlp import MLP
+from tpudist.models.resnet import ResNet50, resnet50_stages
+
+__all__ = ["ConvNet", "EmbeddingBagClassifier", "MLP", "ResNet50", "resnet50_stages"]

@@ -1,0 +1,39 @@
+"""LeNet-style ConvNet — twin of the Horovod examples' ``Net``
+(`mnist_horovod.py:9-25`, duplicated at `horovod_mnist_elastic.py:16-32`):
+conv(1→10, k5) + maxpool + relu, conv(10→20, k5) + dropout + maxpool + relu,
+flatten(320) → fc(50) → dropout → fc(10).
+
+TPU-first choices: NHWC layout (XLA's preferred conv layout on TPU),
+channels widened optionally via ``width_mult`` to feed the MXU, returns
+*logits* — log_softmax lives in the loss (`tpudist.ops.losses.nll_loss`
+composes it), where XLA fuses it with the reduction.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    width_mult: int = 1
+    dropout_rate: float = 0.5
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        w = self.width_mult
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(10 * w, (5, 5), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20 * w, (5, 5), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)  # 4*4*20*w = 320*w, like the reference's 320
+        x = nn.relu(nn.Dense(50 * w, dtype=self.compute_dtype)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return logits.astype(jnp.float32)

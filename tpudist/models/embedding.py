@@ -1,0 +1,39 @@
+"""EmbeddingBag + Linear classifier — twin of ``HybridModel``
+(`server_model_data_parallel.py:34-46`): an EmbeddingBag(100, 16, mode="sum")
+lookup feeding a Linear(16, 8).
+
+TPU-first encoding: the ragged (indices, offsets) pair becomes a static-shape
+``[batch, max_len]`` index matrix + float mask (see
+:func:`tpudist.data.synthetic.ragged_embedding_batches`); the bag-sum is a
+mask-weighted gather-sum, which XLA lowers to one fused gather+reduce.
+
+The embedding table is declared with its own parameter subtree ("embedding")
+so the PS-hybrid strategy can shard it over the model axis while the dense
+head replicates over the data axis (`tpudist.parallel.ps_hybrid`).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class EmbeddingBagClassifier(nn.Module):
+    num_embeddings: int = 100
+    embedding_dim: int = 16
+    num_classes: int = 8
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, indices: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (self.num_embeddings, self.embedding_dim),
+            jnp.float32,
+        )
+        # Bag-sum: gather rows then mask-weighted sum over the bag dimension.
+        rows = jnp.take(table, indices, axis=0).astype(self.compute_dtype)
+        bag = jnp.einsum("blh,bl->bh", rows, mask.astype(self.compute_dtype))
+        logits = nn.Dense(self.num_classes, dtype=self.compute_dtype, name="fc")(bag)
+        return logits.astype(jnp.float32)

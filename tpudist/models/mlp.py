@@ -1,0 +1,31 @@
+"""Configurable MLP — twin of the DDP example's ``Model``
+(`mnist_ddp_elastic.py:133-159`): 784 → features, then ``hidden_layers`` ×
+(features → features), then → 10, ReLU between layers; reference config is
+``hidden_layers=5, features=1024`` (`mnist_ddp_elastic.py:172`).
+
+Widths of 1024 are MXU-friendly (multiples of 128 lanes); compute runs in
+bfloat16 with float32 params when ``compute_dtype=jnp.bfloat16``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden_layers: int = 5
+    features: int = 1024
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1).astype(self.compute_dtype)
+        x = nn.Dense(self.features, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        for _ in range(self.hidden_layers):
+            x = nn.Dense(self.features, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        logits = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,184 @@
+"""ResNet50, structured as pipeline stages.
+
+Twin of the reference's two-shard ResNet50 (`model_parallel_ResNet50.py:
+39-139`): Bottleneck stacks with a 1x1-conv downsample, split after layer2 —
+stage 1 = conv7x7/s2 + norm + relu + maxpool + layer1(64×3) + layer2(128×4,s2)
+(`:85-114`), stage 2 = layer3(256×6,s2) + layer4(512×3,s2) + avgpool +
+fc(2048→1000) (`:117-139`).
+
+TPU-first departures from the reference:
+
+* NHWC layout, bfloat16 compute with float32 params/normalization;
+* GroupNorm by default instead of BatchNorm: stateless (no running-stat
+  plumbing through the pipeline) and needs no cross-replica sync under data
+  parallelism; BatchNorm remains available (``norm="batch"``) with
+  ``axis_name``-synced statistics for strict parity experiments;
+* no per-shard locks — stages are pure functions, the hazard the reference's
+  ``threading.Lock`` guards (`model_parallel_ResNet50.py:48,112,137`) does
+  not exist (SURVEY.md §5 "Race detection");
+* an arbitrary ``num_stages`` split (2 reproduces the reference) chosen at
+  block granularity so stages balance FLOPs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+STAGE_SIZES = (3, 4, 6, 3)  # ResNet50 Bottleneck counts per layer group
+STAGE_WIDTHS = (64, 128, 256, 512)
+
+
+def _norm(norm: str, dtype: Any) -> Callable[..., nn.Module]:
+    if norm == "group":
+        return lambda: nn.GroupNorm(num_groups=32, dtype=dtype, param_dtype=jnp.float32)
+    if norm == "batch":
+        return lambda: nn.BatchNorm(
+            use_running_average=False, momentum=0.9, dtype=dtype, axis_name="data"
+        )
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class Bottleneck(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (×4), with projection shortcut when
+    shape changes (`model_parallel_ResNet50.py:64-76` equivalent)."""
+
+    features: int
+    strides: int = 1
+    norm: str = "group"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        mk_norm = _norm(self.norm, self.compute_dtype)
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.compute_dtype)(x)
+        y = nn.relu(mk_norm()(y))
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=self.compute_dtype,
+        )(y)
+        y = nn.relu(mk_norm()(y))
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.compute_dtype)(y)
+        y = mk_norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features * 4, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.compute_dtype,
+            )(residual)
+            residual = mk_norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNetStem(nn.Module):
+    """conv7x7/s2 + norm + relu + 3x3 maxpool/s2 (`model_parallel_ResNet50.py:90-95`)."""
+
+    norm: str = "group"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.relu(_norm(self.norm, self.compute_dtype)()(x))
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+class ResNetHead(nn.Module):
+    """Global avgpool + fc(2048→num_classes) (`model_parallel_ResNet50.py:127-130`)."""
+
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.compute_dtype)(x).astype(jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class _BlockSpecEntry:
+    features: int
+    strides: int
+
+
+def _block_plan() -> list[_BlockSpecEntry]:
+    plan = []
+    for group, (count, width) in enumerate(zip(STAGE_SIZES, STAGE_WIDTHS)):
+        for i in range(count):
+            stride = 2 if (i == 0 and group > 0) else 1
+            plan.append(_BlockSpecEntry(width, stride))
+    return plan
+
+
+class ResNetSegment(nn.Module):
+    """A contiguous run of Bottleneck blocks; optionally carries the stem
+    (first segment) and the head (last segment)."""
+
+    blocks: Sequence[_BlockSpecEntry]
+    with_stem: bool = False
+    with_head: bool = False
+    num_classes: int = 1000
+    norm: str = "group"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.with_stem:
+            x = ResNetStem(self.norm, self.compute_dtype)(x)
+        x = x.astype(self.compute_dtype)
+        for b in self.blocks:
+            x = Bottleneck(b.features, b.strides, self.norm, self.compute_dtype)(x)
+        if self.with_head:
+            x = ResNetHead(self.num_classes, self.compute_dtype)(x)
+        return x
+
+
+def resnet50_stages(
+    num_stages: int = 2,
+    num_classes: int = 1000,
+    norm: str = "group",
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> list[ResNetSegment]:
+    """Split ResNet50's 16 Bottleneck blocks into ``num_stages`` contiguous
+    segments.  ``num_stages=2`` reproduces the reference split (blocks 0-6 =
+    layer1+layer2 with the stem; blocks 7-15 = layer3+layer4 with the head,
+    `model_parallel_ResNet50.py:96-100,125-126`)."""
+    plan = _block_plan()
+    if num_stages == 2:
+        cuts = [7]  # after layer2, the reference's split point
+    else:
+        per = -(-len(plan) // num_stages)
+        cuts = [per * i for i in range(1, num_stages)]
+    bounds = [0, *cuts, len(plan)]
+    segs = []
+    for s in range(num_stages):
+        segs.append(
+            ResNetSegment(
+                blocks=tuple(plan[bounds[s] : bounds[s + 1]]),
+                with_stem=(s == 0),
+                with_head=(s == num_stages - 1),
+                num_classes=num_classes,
+                norm=norm,
+                compute_dtype=compute_dtype,
+            )
+        )
+    return segs
+
+
+class ResNet50(nn.Module):
+    """The whole network as one module (for single-device / pure-DP runs)."""
+
+    num_classes: int = 1000
+    norm: str = "group"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for seg in resnet50_stages(1, self.num_classes, self.norm, self.compute_dtype):
+            x = seg(x)
+        return x

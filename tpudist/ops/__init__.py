@@ -1,0 +1,5 @@
+"""Numerical ops: losses, metrics; pallas kernels live in ``ops.kernels``."""
+
+from tpudist.ops.losses import accuracy, cross_entropy, mse_loss, nll_loss
+
+__all__ = ["accuracy", "cross_entropy", "mse_loss", "nll_loss"]

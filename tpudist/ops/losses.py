@@ -1,0 +1,44 @@
+"""Losses used across the workload twins.
+
+Covers the reference's loss surface: CrossEntropyLoss
+(`mnist_ddp_elastic.py:174`, `server_model_data_parallel.py:91`), NLL over
+log_softmax outputs (`mnist_horovod.py:62`, `horovod_mnist_elastic.py:68`),
+and MSE on one-hot targets (`model_parallel_ResNet50.py:203,223`).
+
+All are computed from *logits* in float32, with the log-softmax fused into
+the reduction by XLA (stable logsumexp form) — returning log-probs from the
+model, as the reference's ``Net.forward`` does, would just be an unfused
+version of the same graph.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+
+def log_softmax(logits: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    return logits - logsumexp(logits, axis=axis, keepdims=True)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels."""
+    logp = log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def nll_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """The reference pairs log_softmax models with F.nll_loss; from logits
+    the two compose to exactly :func:`cross_entropy`."""
+    return cross_entropy(logits, labels)
+
+
+def mse_loss(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    pred = pred.astype(jnp.float32)
+    return jnp.mean(jnp.square(pred - target.astype(jnp.float32)))
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Fraction correct (sum form is assembled by callers when sharded)."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -1,0 +1,18 @@
+"""Parallelism strategies over mesh axes.
+
+The reference's L3 layer (SURVEY.md §1): DDP / Horovod data parallelism →
+:mod:`data_parallel` (explicit ``psum`` over ICI); the RPC micro-batched
+pipeline → :mod:`pipeline` (``ppermute`` + ``lax.scan`` schedules); the
+parameter-server hybrid → :mod:`ps_hybrid` (model-axis-sharded embedding +
+data-parallel dense).  Distributed autograd and DistributedOptimizer have no
+counterpart here because ``jax.grad`` + optax work through shardings natively
+(SURVEY.md §2.2).
+"""
+
+from tpudist.parallel.data_parallel import (
+    broadcast_params,
+    make_dp_eval_step,
+    make_dp_train_step,
+)
+
+__all__ = ["broadcast_params", "make_dp_eval_step", "make_dp_train_step"]

@@ -1,0 +1,124 @@
+"""Data parallelism: explicit gradient allreduce over the mesh's data axis.
+
+This is the TPU-native form of both DDP (`mnist_ddp_elastic.py:58` — gradient
+allreduce in backward hooks over gloo) and Horovod's
+``DistributedOptimizer`` + ring allreduce (`mnist_horovod.py:53` — SURVEY.md
+§2.2): the train step runs SPMD under :func:`jax.shard_map` with the batch
+split along ``data`` and params replicated; one ``lax.pmean`` over the axis
+is the gradient sync, lowered by XLA to a fused ICI all-reduce.  Horovod's
+tensor-fusion buckets come for free — XLA coalesces the whole grad pytree
+into large collective ops.
+
+``broadcast_params`` is the ``hvd.broadcast_parameters(root_rank=0)``
+equivalent (`mnist_horovod.py:56`): on TPU, params created once on the host
+and ``device_put`` with a replicated sharding ARE identical on every device,
+so the broadcast is a placement, not a collective protocol.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+if False:  # typing only; a runtime import would cycle through tpudist.train
+    from tpudist.train.state import TrainState  # noqa: F401
+
+# loss_fn(params, batch, rng) -> (loss, aux_dict); batch is a tuple of arrays
+LossFn = Callable[[Any, tuple, jax.Array], tuple[jnp.ndarray, dict]]
+
+
+def broadcast_params(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a host/device pytree identically onto every mesh device.
+
+    Device-array leaves are copied first: ``device_put`` may alias the input
+    buffer as one replica of the result, and the train step's buffer donation
+    would then silently delete the *caller's* array.
+    """
+    sharding = NamedSharding(mesh, P())
+
+    def put(x):
+        if isinstance(x, jax.Array):
+            x = jnp.array(x, copy=True)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, tree)
+
+
+def make_dp_train_step(
+    loss_fn: LossFn,
+    mesh: Mesh,
+    axis: str = "data",
+    donate: bool = True,
+):
+    """Build ``train_step(state, *batch) -> (state, metrics)``.
+
+    The returned step is jit-compiled over ``mesh``; per-device it computes
+    local grads on its batch shard, ``pmean``s them over ``axis`` (THE
+    all-reduce), and applies the optax update redundantly-but-identically on
+    every device — the same contract DDP/Horovod give, without a wrapper
+    object or hooks.
+    """
+
+    def _step(state, batch):
+        # Distinct dropout/augmentation stream per data shard, common stream
+        # for anything that must agree across shards.
+        shard_rng = jax.random.fold_in(state.rng, lax.axis_index(axis))
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, shard_rng
+        )
+        grads = lax.pmean(grads, axis)
+        metrics = {"loss": lax.pmean(loss, axis), **
+                   {k: lax.pmean(v, axis) for k, v in aux.items()}}
+        return state.apply_gradients(grads), metrics
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, *batch):
+        return sharded(state, batch)
+
+    return train_step
+
+
+def make_dp_eval_step(
+    predict_fn: Callable[[Any, tuple], jnp.ndarray],
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Build ``eval_step(params, *batch_with_labels) -> correct_count``.
+
+    Counts (not fractions) are psum'd; the caller divides by the number of
+    samples it actually fed (with ``drop_last=False`` loaders that includes
+    wrap-around-padded duplicates — use ``drop_last=True`` eval loaders for
+    duplicate-free accuracy).  The reference evaluates the full
+    (sampler-sharded) test set on every rank and prints per-rank accuracy
+    (`mnist_ddp_elastic.py:117-130`); here every shard evaluates its slice
+    once and the global count is exact.
+    """
+
+    def _step(params, batch):
+        *inputs, labels = batch
+        logits = predict_fn(params, tuple(inputs))
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+        return lax.psum(correct, axis)
+
+    sharded = jax.shard_map(
+        _step, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(), check_vma=False
+    )
+
+    @jax.jit
+    def eval_step(params, *batch):
+        return sharded(params, batch)
+
+    return eval_step

@@ -1,0 +1,39 @@
+"""Runtime layer: device meshes, multi-host bootstrap, coordination.
+
+TPU-native replacement for the reference's L1 communication/runtime layer
+(gloo process groups, Horovod/MPI, TensorPipe RPC — SURVEY.md §1 L1): on TPU
+the collective substrate is XLA over ICI/DCN, so "init_process_group" becomes
+mesh construction + (multi-host) ``jax.distributed.initialize``.
+"""
+
+from tpudist.runtime.distributed import (
+    DistributedContext,
+    initialize,
+    local_rank,
+    process_count,
+    process_index,
+    world_info,
+)
+from tpudist.runtime.mesh import (
+    MeshSpec,
+    data_mesh,
+    data_model_mesh,
+    get_devices,
+    make_mesh,
+    pipeline_mesh,
+)
+
+__all__ = [
+    "DistributedContext",
+    "MeshSpec",
+    "data_mesh",
+    "data_model_mesh",
+    "get_devices",
+    "initialize",
+    "local_rank",
+    "make_mesh",
+    "pipeline_mesh",
+    "process_count",
+    "process_index",
+    "world_info",
+]

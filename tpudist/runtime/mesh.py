@@ -1,0 +1,122 @@
+"""Device-mesh construction.
+
+The mesh is the single abstraction that replaces the reference's three
+communication substrates (gloo groups `mnist_ddp_elastic.py:26`, Horovod ring
+`mnist_horovod.py:28`, TensorPipe RPC mesh `model_parallel_ResNet50.py:233-249`
+— SURVEY.md §1 L1).  Every parallelism strategy in tpudist is expressed as
+shardings over named mesh axes:
+
+* ``data``  — batch axis; gradient psum rides ICI (DDP / Horovod equivalent)
+* ``stage`` — pipeline axis; activations travel via ppermute (RPC pipeline
+  equivalent)
+* ``model`` — parameter-sharding axis (parameter-server / tensor sharding)
+
+Meshes are ordinary :class:`jax.sharding.Mesh` objects; helpers here only
+decide the device grid layout so that the fastest-varying axis maps to
+physically adjacent chips (ICI-friendly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def get_devices(n: int | None = None, backend: str | None = None) -> list[jax.Device]:
+    """Return the first ``n`` jax devices (all if ``n`` is None).
+
+    Raises with a clear message when fewer devices exist — the moral
+    equivalent of the reference's world-size checks
+    (`server_model_data_parallel.py:184`).
+    """
+    devs = jax.devices(backend) if backend else jax.devices()
+    if n is None:
+        return list(devs)
+    if len(devs) < n:
+        raise ValueError(
+            f"requested {n} devices but only {len(devs)} available "
+            f"({[d.platform for d in devs[:4]]}...). For CPU-simulated meshes set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N before importing jax."
+        )
+    return list(devs[:n])
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description: ordered ``{axis_name: size}``.
+
+    ``size == -1`` for at most one axis means "all remaining devices".
+    """
+
+    axes: Mapping[str, int]
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        axes = dict(self.axes)
+        wild = [k for k, v in axes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in axes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            axes[wild[0]] = n_devices // fixed
+        total = math.prod(axes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {axes} needs {total} devices, have {n_devices}"
+            )
+        return axes
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        devices = list(devices) if devices is not None else get_devices()
+        axes = self.resolve(len(devices))
+        grid = np.asarray(devices).reshape(tuple(axes.values()))
+        return Mesh(grid, tuple(axes.keys()))
+
+
+def make_mesh(
+    axes: Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh from ``{axis: size}`` (one axis may be -1 = remaining)."""
+    return MeshSpec(axes).build(devices)
+
+
+def data_mesh(n: int | None = None) -> Mesh:
+    """1-D data-parallel mesh over all (or first ``n``) devices."""
+    return make_mesh({"data": -1}, get_devices(n))
+
+
+def data_model_mesh(model: int, n: int | None = None) -> Mesh:
+    """2-D data × model mesh (hybrid DP × parameter sharding).
+
+    ``model`` is the fastest-varying axis so model-axis collectives stay on
+    adjacent chips.
+    """
+    return make_mesh({"data": -1, "model": model}, get_devices(n))
+
+
+def pipeline_mesh(stages: int, n: int | None = None) -> Mesh:
+    """2-D data × stage mesh for (optionally data-parallel) pipelining."""
+    return make_mesh({"data": -1, "stage": stages}, get_devices(n))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for a [batch, ...] array split along the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh, axis: str = "data") -> int:
+    n = mesh.shape[axis]
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {axis}={n}")
+    return global_batch // n

@@ -1,0 +1,6 @@
+"""Training drivers (the reference's L4 layer, SURVEY.md §1)."""
+
+from tpudist.train.state import TrainState
+from tpudist.train.trainer import Trainer, TrainerConfig
+
+__all__ = ["TrainState", "Trainer", "TrainerConfig"]

@@ -1,0 +1,57 @@
+"""Train state: the full pytree that defines a training run.
+
+Deliberately exceeds the reference's snapshot fidelity (SURVEY.md §5
+"Checkpoint / resume": the reference saves only MODEL_STATE + EPOCHS_RUN,
+`mnist_ddp_elastic.py:99-102`, losing optimizer state and RNG): tpudist's
+state carries params, optimizer state, step counter and the PRNG key, so a
+restore is a bitwise continuation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(
+        cls,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        rng: jax.Array | int = 0,
+    ) -> "TrainState":
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        new_rng, _ = jax.random.split(self.rng)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            rng=new_rng,
+        )

@@ -1,0 +1,15 @@
+"""Utilities: config, logging, metrics, pytree helpers."""
+
+from tpudist.utils.config import cli_override, config_field, make_parser
+from tpudist.utils.logging import get_logger
+from tpudist.utils.metrics import MetricLogger, Stopwatch, ThroughputMeter
+
+__all__ = [
+    "MetricLogger",
+    "Stopwatch",
+    "ThroughputMeter",
+    "cli_override",
+    "config_field",
+    "get_logger",
+    "make_parser",
+]

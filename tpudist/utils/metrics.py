@@ -1,0 +1,102 @@
+"""Step-time / throughput metrics.
+
+Strictly more than the reference's perf signal (end-to-end ``time.time()``
+deltas, `mnist_ddp_elastic.py:210-213`, `model_parallel_ResNet50.py:258-262`):
+per-step wall clock with warmup exclusion, images/sec, and an optional
+``jax.profiler`` trace hook (SURVEY.md §5 "Tracing / profiling").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+
+class Stopwatch:
+    """Wall-clock timer; ``block=True`` syncs outstanding device work first
+    (async dispatch otherwise makes step timings meaningless)."""
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def reset(self, block: bool = False) -> None:
+        if block:
+            jax.effects_barrier()
+        self._t0 = time.perf_counter()
+
+    def elapsed(self, block: bool = False) -> float:
+        if block:
+            jax.effects_barrier()
+        return time.perf_counter() - self._t0
+
+
+class ThroughputMeter:
+    """Images/sec (or items/sec) with warmup-step exclusion."""
+
+    def __init__(self, warmup_steps: int = 1) -> None:
+        self.warmup_steps = warmup_steps
+        self._steps = 0
+        self._items = 0
+        self._elapsed = 0.0
+        self._last: float | None = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def step(self, n_items: int) -> None:
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return
+        self._steps += 1
+        if self._steps > self.warmup_steps:
+            self._items += n_items
+            self._elapsed += now - self._last
+        self._last = now
+
+    @property
+    def items_per_sec(self) -> float:
+        return self._items / self._elapsed if self._elapsed else 0.0
+
+    @property
+    def mean_step_time(self) -> float:
+        counted = self._steps - self.warmup_steps
+        return self._elapsed / counted if counted > 0 else 0.0
+
+
+class MetricLogger:
+    """Running means of scalar metrics, flushed per epoch.
+
+    Values may be device arrays: they are accumulated *lazily* (no ``float``
+    per step, which would block on the async dispatch queue) and synced to
+    host in one batch at :meth:`means` / :meth:`reset`."""
+
+    def __init__(self) -> None:
+        self._values: dict[str, list] = defaultdict(list)
+
+    def update(self, **metrics) -> None:
+        for k, v in metrics.items():
+            self._values[k].append(v)
+
+    def means(self) -> dict[str, float]:
+        host = jax.device_get(dict(self._values))
+        return {k: float(sum(map(float, vs)) / len(vs)) for k, vs in host.items()}
+
+    def reset(self) -> dict[str, float]:
+        out = self.means()
+        self._values.clear()
+        return out
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """``with maybe_profile("/tmp/trace"):`` wraps a region in a profiler
+    trace viewable in XProf/TensorBoard; no-op when ``trace_dir`` is None."""
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield

@@ -1,0 +1,88 @@
+"""Pytree helpers shared across checkpointing and parallel layers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def tree_size_bytes(tree: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def is_prng_key(x: Any) -> bool:
+    return isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    )
+
+
+def leaf_to_host(x: Any) -> np.ndarray:
+    """One leaf to host numpy; typed PRNG keys serialize as their raw
+    key-data bits so checkpoints stay framework-object-free."""
+    if is_prng_key(x):
+        return np.asarray(jax.random.key_data(x))
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    return np.asarray(jax.device_get(x))
+
+
+def host_to_leaf(template: Any, host: np.ndarray) -> Any:
+    """Inverse of :func:`leaf_to_host`, typed by the template leaf: raw key
+    bits are re-wrapped into a typed key with the template's impl."""
+    if is_prng_key(template):
+        return jax.random.wrap_key_data(
+            jax.numpy.asarray(host), impl=jax.random.key_impl(template)
+        )
+    return host
+
+
+def tree_to_numpy(tree: Any) -> Any:
+    """Device→host copy of every leaf.  Host-numpy leaves are copied too, so
+    the result never aliases caller-mutable memory (async checkpointing
+    depends on this)."""
+    return jax.tree.map(leaf_to_host, tree)
+
+
+def flatten_with_names(tree: Any) -> dict[str, np.ndarray]:
+    """Flatten a pytree to ``{path: leaf}`` with stable, human-readable keys
+    (used as npz archive member names by the checkpointer)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path) or "."
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _path_str(entry: Any) -> str:
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    return str(entry)
+
+
+def unflatten_like(template: Any, named: dict[str, np.ndarray]) -> Any:
+    """Inverse of :func:`flatten_with_names` given a structural template."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path) or "."
+        if key not in named:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        val = named[key]
+        if is_prng_key(leaf):
+            leaves.append(host_to_leaf(leaf, val))
+            continue
+        if tuple(val.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {val.shape} != template {np.shape(leaf)}"
+            )
+        leaves.append(val.astype(np.asarray(leaf).dtype, copy=False))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
