@@ -14,5 +14,29 @@ from tpudist.parallel.data_parallel import (
     make_dp_eval_step,
     make_dp_train_step,
 )
+from tpudist.parallel.pipeline import (
+    make_pipeline_forward,
+    make_pipeline_train_step,
+    make_stacked_pipeline_train_step,
+    stacked_state_specs,
+)
+from tpudist.parallel.ps_hybrid import (
+    make_ps_hybrid_forward,
+    make_ps_hybrid_train_step,
+    ps_state_specs,
+    sharded_bag_lookup,
+)
 
-__all__ = ["broadcast_params", "make_dp_eval_step", "make_dp_train_step"]
+__all__ = [
+    "broadcast_params",
+    "make_dp_eval_step",
+    "make_dp_train_step",
+    "make_pipeline_forward",
+    "make_pipeline_train_step",
+    "make_ps_hybrid_forward",
+    "make_ps_hybrid_train_step",
+    "make_stacked_pipeline_train_step",
+    "ps_state_specs",
+    "sharded_bag_lookup",
+    "stacked_state_specs",
+]

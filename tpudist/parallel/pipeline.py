@@ -1,0 +1,378 @@
+"""Micro-batched pipeline model parallelism on a mesh axis.
+
+TPU-native replacement for the reference's RPC pipeline
+(`model_parallel_ResNet50.py:142-184`): there, stages live on RPC workers,
+micro-batches flow master→worker1→worker2 as RRefs with async futures, and
+``dist_autograd``/``DistributedOptimizer`` stitch backward and the update
+together across processes (`:222-225`).  Here the entire schedule is ONE
+compiled SPMD program:
+
+* the mesh has a ``stage`` axis; device column ``s`` executes stage ``s``;
+* a GPipe fill-drain schedule runs as ``lax.scan`` over
+  ``num_microbatches + num_stages - 1`` ticks;
+* stage-to-stage activation transfer is ``lax.ppermute`` over ICI — the
+  ``RRef.to_here()`` hop (`:110-114`) with the copy fused into the program;
+* heterogeneous stages are dispatched with ``lax.switch`` on the device's
+  stage index over *flattened, padded* activation buffers (SPMD programs need
+  uniform shapes; padding to the widest stage boundary is the TPU-native
+  encoding of "different tensors per worker");
+* ``jax.grad`` differentiates through scan+ppermute+switch, so backward
+  needs no distributed-autograd engine: the transpose of ppermute IS the
+  reverse hop (SURVEY.md §2.2 "mechanism dissolved");
+* gradients are ``psum``'d over the stage axis (each device produced only its
+  own stage's grads) and ``pmean``'d over the data axis, then one optax
+  update runs identically everywhere — no DistributedOptimizer RPCs
+  (`:202-206`).
+
+The reference serializes micro-batches *within* a stage with a
+``threading.Lock`` (`:48,112,137`); here a stage processes one micro-batch
+per tick by construction and stages are pure, so the hazard doesn't exist.
+
+Params for every stage are replicated across the mesh (memory cost
+``n_stages ×``; fine for few-stage pipelines like the reference's two-shard
+split).  For deep homogeneous stacks use
+:func:`make_stacked_pipeline_train_step`, which shards a stacked parameter
+pytree over the stage axis (O(1/n_stages) memory) and needs no switch.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# A stage is a pure function (stage_params, activations[mb, ...]) -> out[mb, ...]
+StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def _numel(shape: Sequence[int]) -> int:
+    return math.prod(shape[1:])
+
+
+def _flatten_pad(a: jnp.ndarray, width: int, dtype) -> jnp.ndarray:
+    flat = a.reshape(a.shape[0], -1).astype(dtype)
+    return jnp.pad(flat, ((0, 0), (0, width - flat.shape[1])))
+
+
+def _boundary_shapes(stage_fns, params, x_mb_shape, x_dtype):
+    """Static shape chain: input of each stage + final output (eval_shape —
+    no FLOPs, trace-time only)."""
+    shapes = [jax.ShapeDtypeStruct(x_mb_shape, x_dtype)]
+    for s, fn in enumerate(stage_fns):
+        shapes.append(jax.eval_shape(fn, params[s], shapes[-1]))
+    return shapes
+
+
+def _check_microbatchable(b: int, num_microbatches: int) -> None:
+    if b % num_microbatches:
+        raise ValueError(
+            f"local batch {b} not divisible by {num_microbatches} micro-batches"
+        )
+
+
+def _run_schedule(
+    apply_buf,          # (buf, t) -> buf' : one stage application on buffers
+    encode,             # micro-batch [mb, ...] -> buffer
+    decode,             # buffer -> output micro-batch
+    xs: jnp.ndarray,    # [S, mb, ...] local micro-batches
+    buf0: jnp.ndarray,
+    out0: jnp.ndarray,  # [S, *out_shape] accumulator
+    *,
+    n_stages: int,
+    stage_axis: str,
+):
+    """The GPipe fill-drain schedule, shared by both pipeline variants:
+    stage 0 ingests micro-batch ``t`` at tick ``t``; the last stage drains
+    micro-batch ``t - (n_stages - 1)``; activations hop one stage per tick
+    via ``ppermute``.  Returns the [S, ...] outputs, nonzero only on the
+    last stage's devices."""
+    S = xs.shape[0]
+    my_stage = lax.axis_index(stage_axis)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        x_t = encode(xs[jnp.clip(t, 0, S - 1)])
+        buf_in = jnp.where(my_stage == 0, x_t, buf)
+        y = apply_buf(buf_in, t)
+        m = t - (n_stages - 1)
+        m_clip = jnp.clip(m, 0, S - 1)
+        valid = (my_stage == n_stages - 1) & (m >= 0)
+        current = lax.dynamic_slice_in_dim(outputs, m_clip, 1, axis=0)[0]
+        outputs = lax.dynamic_update_slice_in_dim(
+            outputs, jnp.where(valid, decode(y), current)[None], m_clip, axis=0
+        )
+        if n_stages > 1:
+            buf = lax.ppermute(
+                y, stage_axis, [(i, i + 1) for i in range(n_stages - 1)]
+            )
+        else:
+            buf = y
+        return (buf, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(S + n_stages - 1))
+    return outputs
+
+
+def _pipeline_forward(
+    stage_fns,
+    params,
+    xs: jnp.ndarray,
+    *,
+    stage_axis: str,
+    n_stages: int,
+    remat: bool,
+    buf_dtype=jnp.float32,
+):
+    """Heterogeneous-stage forward on the local shard: stages dispatched by
+    ``lax.switch`` over flattened activation buffers padded to the widest
+    stage boundary.  ``xs``: [S, mb, ...] local micro-batches."""
+    S, mb = xs.shape[0], xs.shape[1]
+    shapes = _boundary_shapes(stage_fns, params, (mb, *xs.shape[2:]), xs.dtype)
+    for s in shapes:
+        if not jnp.issubdtype(s.dtype, jnp.floating):
+            raise ValueError(
+                f"stage-boundary dtype {s.dtype} cannot round-trip through the "
+                f"{jnp.dtype(buf_dtype).name} pipeline buffer; move integer "
+                "inputs (e.g. token-id embedding) inside stage 0"
+            )
+    width = max(_numel(s.shape) for s in shapes)
+    out_struct = shapes[-1]
+    out_numel = _numel(out_struct.shape)
+
+    def make_branch(s: int):
+        def run(operand):
+            branch_params, buf = operand
+            xin = (
+                buf[:, : _numel(shapes[s].shape)]
+                .reshape(mb, *shapes[s].shape[1:])
+                .astype(shapes[s].dtype)
+            )
+            out = stage_fns[s](branch_params[s], xin)
+            return _flatten_pad(out, width, buf_dtype)
+
+        return jax.checkpoint(run) if remat else run
+
+    branches = [make_branch(s) for s in range(n_stages)]
+    my_stage = lax.axis_index(stage_axis)
+
+    return _run_schedule(
+        apply_buf=lambda buf, t: lax.switch(my_stage, branches, (params, buf)),
+        encode=lambda a: _flatten_pad(a, width, buf_dtype),
+        decode=lambda y: (
+            y[:, :out_numel].reshape(out_struct.shape).astype(out_struct.dtype)
+        ),
+        xs=xs,
+        buf0=jnp.zeros((mb, width), buf_dtype),
+        out0=jnp.zeros((S, *out_struct.shape), out_struct.dtype),
+        n_stages=n_stages,
+        stage_axis=stage_axis,
+    )
+
+
+def make_pipeline_train_step(
+    stage_fns: Sequence[StageFn],
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    num_microbatches: int,
+    data_axis: str = "data",
+    stage_axis: str = "stage",
+    remat: bool = False,
+    donate: bool = True,
+    buf_dtype=jnp.float32,
+):
+    """Build ``train_step(state, x, y) -> (state, metrics)``.
+
+    ``state.params`` must be a tuple/list with one entry per stage (as built
+    by e.g. ``tpudist.models.resnet50_stages`` + per-stage ``init``), fully
+    replicated over the mesh.  ``x``/``y`` are global batches sharded along
+    ``data_axis``; the local batch is split into ``num_microbatches``
+    contiguous micro-batches exactly like the reference's
+    ``xs.split(split_size)`` (`model_parallel_ResNet50.py:169`;
+    ``num_microbatches`` ≙ its ``num_split`` sweep values {4, 8}).
+
+    With ``donate=True`` (default) the input state is CONSUMED by each call —
+    including any caller-held arrays that alias it (e.g. the params tree the
+    state was created from, if it was already on device).  To run several
+    independent sweeps from one initialization, pass ``donate=False`` or
+    rebuild the state per sweep.
+    """
+    n_stages = mesh.shape[stage_axis]
+    if len(stage_fns) != n_stages:
+        raise ValueError(
+            f"{len(stage_fns)} stage fns but mesh {stage_axis}={n_stages}"
+        )
+
+    def _step(state, batch):
+        x, y = batch
+        b = x.shape[0]
+        _check_microbatchable(b, num_microbatches)
+        xs = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+        def local_loss(params):
+            outputs = _pipeline_forward(
+                stage_fns, params, xs,
+                stage_axis=stage_axis, n_stages=n_stages, remat=remat,
+                buf_dtype=buf_dtype,
+            )
+            # The loss lives ONLY on the last stage (outputs are zeros
+            # elsewhere).  Keeping it masked-local — no collective in the
+            # differentiated path — means backward cotangents flow to earlier
+            # stages exclusively through the transposed ppermute hops, which
+            # is exactly the reverse pipeline schedule.
+            l = loss_fn(outputs.reshape(b, *outputs.shape[2:]), y)
+            return jnp.where(lax.axis_index(stage_axis) == n_stages - 1, l, 0.0)
+
+        loss, grads = jax.value_and_grad(local_loss)(state.params)
+        # each device holds grads only for its own stage → assemble over the
+        # stage axis, then average over data shards (the DDP-style sync)
+        grads = lax.psum(grads, stage_axis)
+        grads = lax.pmean(grads, data_axis)
+        metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
+        return state.apply_gradients(grads), metrics
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(P(), (P(data_axis), P(data_axis))),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, x, y):
+        return sharded(state, (x, y))
+
+    return train_step
+
+
+def make_pipeline_forward(
+    stage_fns: Sequence[StageFn],
+    mesh: Mesh,
+    num_microbatches: int,
+    data_axis: str = "data",
+    stage_axis: str = "stage",
+    buf_dtype=jnp.float32,
+):
+    """Inference-only pipelined forward: ``fn(params, x) -> logits``."""
+    n_stages = mesh.shape[stage_axis]
+
+    def _fwd(params, x):
+        b = x.shape[0]
+        _check_microbatchable(b, num_microbatches)
+        xs = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+        outputs = _pipeline_forward(
+            stage_fns, params, xs,
+            stage_axis=stage_axis, n_stages=n_stages, remat=False,
+            buf_dtype=buf_dtype,
+        )
+        outputs = lax.psum(outputs, stage_axis)
+        return outputs.reshape(b, *outputs.shape[2:])
+
+    sharded = jax.shard_map(
+        _fwd, mesh=mesh,
+        in_specs=(P(), P(data_axis)),
+        out_specs=P(data_axis),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage"):
+    """PartitionSpec pytree for a TrainState whose params are stage-stacked:
+    every array leaf with leading dim ``n_stages`` shards over the stage
+    axis (params and the mirroring optimizer moments), everything else
+    (step counters, scalars, rng) replicates."""
+
+    def leaf_spec(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n_stages:
+            return P(stage_axis)
+        return P()
+
+    return jax.tree.map(leaf_spec, state)
+
+
+def make_stacked_pipeline_train_step(
+    block_fn: StageFn,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    num_microbatches: int,
+    state_example,
+    data_axis: str = "data",
+    stage_axis: str = "stage",
+    remat: bool = False,
+    donate: bool = True,
+):
+    """Pipeline of HOMOGENEOUS blocks with stage-sharded parameters.
+
+    ``state.params`` leaves are stacked ``[n_stages, ...]`` and sharded
+    ``P(stage_axis)`` — each device holds only its own stage's slice, so
+    parameter memory scales O(1/n_stages) (the property that makes pipeline
+    parallelism worth having at scale; the reference's two-shard placement
+    `model_parallel_ResNet50.py:152-165` achieves the same by construction).
+    The block must map activations to activations of the same shape.
+
+    ``state_example`` (a TrainState, concrete or abstract) is used only to
+    derive the per-leaf sharding specs via :func:`stacked_state_specs`.
+    """
+    n_stages = mesh.shape[stage_axis]
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state_example.params):
+        if not (hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n_stages):
+            raise ValueError(
+                f"stacked pipeline requires every param leaf stacked "
+                f"[{n_stages}, ...]; {jax.tree_util.keystr(path)} has shape "
+                f"{getattr(leaf, 'shape', None)}"
+            )
+    state_specs = stacked_state_specs(state_example, n_stages, stage_axis)
+
+    def _step(state, batch):
+        x, y = batch
+        b = x.shape[0]
+        _check_microbatchable(b, num_microbatches)
+        xs = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+        my_stage = lax.axis_index(stage_axis)
+
+        def local_loss(params):
+            # local param slice has leading dim 1 from the stage sharding
+            my_params = jax.tree.map(lambda p: p[0], params)
+            run = jax.checkpoint(block_fn) if remat else block_fn
+            outputs = _run_schedule(
+                apply_buf=lambda buf, t: run(my_params, buf),
+                encode=lambda a: a,
+                decode=lambda yv: yv,
+                xs=xs,
+                buf0=jnp.zeros_like(xs[0]),
+                out0=jnp.zeros_like(xs),
+                n_stages=n_stages,
+                stage_axis=stage_axis,
+            )
+            # masked-local loss on the last stage; cotangents reach earlier
+            # stages through the transposed ppermute (see the heterogeneous
+            # variant for rationale)
+            l = loss_fn(outputs.reshape(b, *outputs.shape[2:]), y)
+            return jnp.where(my_stage == n_stages - 1, l, 0.0)
+
+        loss, grads = jax.value_and_grad(local_loss)(state.params)
+        # stage-sharded params: each device's grads are for its own slice
+        # already — only the data-axis average is needed.
+        grads = lax.pmean(grads, data_axis)
+        metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
+        return state.apply_gradients(grads), metrics
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(state_specs, (P(data_axis), P(data_axis))),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, x, y):
+        return sharded(state, (x, y))
+
+    return train_step

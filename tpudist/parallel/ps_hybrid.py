@@ -1,0 +1,182 @@
+"""Parameter-server-style hybrid parallelism: model-axis-sharded embedding
+table + data-parallel dense layers on a 2-D mesh.
+
+TPU-native replacement for the reference's 4-role RPC topology
+(`server_model_data_parallel.py:114-185`): there, rank 3 ("ps") passively
+hosts an ``EmbeddingBag(100, 16)`` reached via ``RemoteModule`` RPC lookups
+(`:134-139`), two trainer ranks run DDP over a local ``Linear(16, 8)``
+(`:34-46`), and ``dist_autograd`` routes embedding gradients trainer→ps
+while gloo allreduces the dense grads (`:96-105`).  Here the same placement
+is a sharding on a ``data × model`` mesh:
+
+* the embedding table shards row-wise over ``model`` (each device column owns
+  ``V / model`` rows — the "parameter server" dissolved into a sharding);
+* the lookup is a local masked gather + one ``psum`` over ``model`` (the RPC
+  round-trip become an ICI all-reduce *inside* the compiled step);
+* dense layers replicate and sync via the data-axis ``pmean`` (the DDP
+  equivalent);
+* ``jax.grad`` routes embedding cotangents back through the psum transpose —
+  the trainer→ps gradient RPC with no RPC.
+
+Sharding-aware autodiff note (applies to every differentiated cross-shard
+reduction in tpudist, see also :mod:`pipeline`): with ``check_vma=False``
+the transpose of ``psum`` is ``psum``, so if the post-reduction computation
+ran replicated on every shard the cotangents would be over-counted by the
+shard count.  The pattern used here is to MASK the loss to one shard column
+(``lax.axis_index(model) == 0``); the psum transpose then delivers exactly
+one copy of the cotangent to every shard, and replicated values (dense
+grads, metrics) are re-assembled with explicit psums *outside* the
+differentiated path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# dense_apply(fc_params, bag[b, D]) -> logits[b, C]
+DenseApply = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def sharded_bag_lookup(
+    local_table: jnp.ndarray,
+    indices: jnp.ndarray,
+    mask: jnp.ndarray,
+    model_axis: str = "model",
+) -> jnp.ndarray:
+    """Masked bag-sum lookup over a row-sharded embedding table.
+
+    ``local_table``: this shard's rows ``[V_local, D]``; global row ``r``
+    lives on shard ``r // V_local``.  Out-of-shard indices contribute zero;
+    one ``psum`` over ``model_axis`` assembles the full bag sums
+    (mode="sum" semantics of the reference's EmbeddingBag,
+    `server_model_data_parallel.py:136`).
+    """
+    v_local = local_table.shape[0]
+    offset = lax.axis_index(model_axis) * v_local
+    loc = indices - offset
+    in_shard = (loc >= 0) & (loc < v_local)
+    rows = jnp.take(local_table, jnp.clip(loc, 0, v_local - 1), axis=0)
+    weight = jnp.where(in_shard, mask.astype(rows.dtype), 0.0)
+    contrib = jnp.einsum("blh,bl->bh", rows, weight)
+    return lax.psum(contrib, model_axis)
+
+
+def ps_state_specs(state, table_key: str = "embedding", model_axis: str = "model"):
+    """PartitionSpec pytree: leaves whose pytree path contains ``table_key``
+    (the table itself and its mirrored optimizer moments) shard row-wise over
+    ``model_axis``; everything else replicates.  Matching by path, not by
+    shape, so a dense kernel that happens to share the table's dimensions is
+    never mis-sharded."""
+
+    def leaf_spec(path, leaf):
+        in_table = any(
+            isinstance(p, jax.tree_util.DictKey) and p.key == table_key
+            for p in path
+        )
+        if in_table and hasattr(leaf, "ndim") and leaf.ndim >= 1:
+            return P(model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, state)
+
+
+def make_ps_hybrid_train_step(
+    dense_apply: DenseApply,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    state_example,
+    num_embeddings: int,
+    table_key: str = "embedding",
+    data_axis: str = "data",
+    model_axis: str = "model",
+    donate: bool = True,
+):
+    """Build ``train_step(state, indices, mask, targets) -> (state, metrics)``.
+
+    ``state.params`` must be ``{table_key: [V, D], ...dense params...}``
+    (the :class:`tpudist.models.EmbeddingBagClassifier` layout); the table is
+    placed row-sharded via :func:`ps_state_specs`, dense params replicated.
+    Equivalent of ``_run_trainer``'s fwd/bwd/step
+    (`server_model_data_parallel.py:93-105`), as one compiled program.
+    """
+    if num_embeddings % mesh.shape[model_axis]:
+        raise ValueError(
+            f"{num_embeddings} embedding rows not divisible by "
+            f"{model_axis}={mesh.shape[model_axis]}"
+        )
+    state_specs = ps_state_specs(state_example, table_key, model_axis)
+
+    def _step(state, batch):
+        indices, mask, targets = batch
+
+        def local_loss(params):
+            bag = sharded_bag_lookup(
+                params[table_key], indices, mask, model_axis
+            )
+            logits = dense_apply(
+                {k: v for k, v in params.items() if k != table_key}, bag
+            )
+            l = loss_fn(logits, targets)
+            # mask to model column 0 — see module docstring
+            return jnp.where(lax.axis_index(model_axis) == 0, l, 0.0)
+
+        loss, grads = jax.value_and_grad(local_loss)(state.params)
+        synced = {}
+        for k, g in grads.items():
+            if k == table_key:
+                # row grads live on the owning shard; only average data shards
+                synced[k] = lax.pmean(g, data_axis)
+            else:
+                # dense grads were produced on model column 0 only — assemble
+                # across model, average across data (the DDP allreduce,
+                # `server_model_data_parallel.py:41`)
+                synced[k] = lax.pmean(lax.psum(g, model_axis), data_axis)
+        metrics = {"loss": lax.pmean(lax.psum(loss, model_axis), data_axis)}
+        return state.apply_gradients(synced), metrics
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(state_specs, (P(data_axis), P(data_axis), P(data_axis))),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, indices, mask, targets):
+        return sharded(state, (indices, mask, targets))
+
+    return train_step
+
+
+def make_ps_hybrid_forward(
+    dense_apply: DenseApply,
+    mesh: Mesh,
+    state_example,
+    num_embeddings: int,
+    table_key: str = "embedding",
+    data_axis: str = "data",
+    model_axis: str = "model",
+):
+    """Inference: ``fn(params, indices, mask) -> logits`` (replicated)."""
+    param_specs = ps_state_specs(state_example, table_key, model_axis)
+
+    def _fwd(params, indices, mask):
+        bag = sharded_bag_lookup(params[table_key], indices, mask, model_axis)
+        return dense_apply(
+            {k: v for k, v in params.items() if k != table_key}, bag
+        )
+
+    sharded = jax.shard_map(
+        _fwd, mesh=mesh,
+        in_specs=(param_specs, P(data_axis), P(data_axis)),
+        out_specs=P(data_axis),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
