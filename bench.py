@@ -1,0 +1,89 @@
+"""Headline benchmark: MNIST ConvNet data-parallel training throughput.
+
+Reproduces the reference's hottest training configuration — the Horovod DP
+loop (`mnist_horovod.py:58-64`: ConvNet, batch 1024, SGD lr=0.01, NLL) — as
+the tpudist psum data-parallel step on whatever devices are present (one
+real TPU chip under the driver; a CPU-simulated mesh elsewhere), and prints
+ONE JSON line::
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R}
+
+``vs_baseline`` compares against the reference suite's own recipe measured
+on this image's CPU (torch 1-proc, same model/batch/optimizer — recorded in
+``BASELINE.json`` under ``measured.reference_convnet_images_per_sec_cpu``;
+the reference publishes no numbers of its own, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpudist.data.mnist import synthetic_mnist
+    from tpudist.models import ConvNet
+    from tpudist.ops.losses import nll_loss
+    from tpudist.parallel.data_parallel import broadcast_params, make_dp_train_step
+    from tpudist.runtime.mesh import data_mesh
+    from tpudist.train.state import TrainState
+
+    n_chips = len(jax.devices())
+    mesh = data_mesh()
+    global_batch = 1024 * mesh.shape["data"]  # reference batch per replica
+
+    model = ConvNet()
+    ds = synthetic_mnist("train", n=global_batch)
+    images = jnp.asarray(ds.images)
+    labels = jnp.asarray(ds.labels)
+
+    params = model.init(jax.random.key(0), images[:1])["params"]
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=True, rngs={"dropout": rng})
+        return nll_loss(logits, y), {}
+
+    state = TrainState.create(
+        model.apply, broadcast_params(params, mesh), optax.sgd(0.01)
+    )
+    train_step = make_dp_train_step(loss_fn, mesh)
+
+    # Warmup (compile + first dispatches), then steady-state measurement.
+    for _ in range(5):
+        state, metrics = train_step(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec_per_chip = steps * global_batch / dt / n_chips
+
+    baseline = None
+    baseline_path = Path(__file__).parent / "BASELINE.json"
+    if baseline_path.exists():
+        measured = json.loads(baseline_path.read_text()).get("measured", {})
+        baseline = measured.get("reference_convnet_images_per_sec_cpu")
+
+    print(json.dumps({
+        "metric": "mnist_convnet_dp_train_throughput",
+        "value": round(images_per_sec_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": (
+            round(images_per_sec_per_chip / baseline, 3) if baseline else None
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Shared machinery for the parallelism strategies.
+
+Every strategy in this package compiles to the same shape of program: a pure
+per-shard step wrapped in ``jax.shard_map`` over the mesh, jitted with
+optional donation of the (large) train-state argument.  This helper is that
+one pattern, in one place.
+
+``check_vma=False``: the steps use collectives whose transpose under
+``jax.grad`` is relied upon explicitly (see the masked-loss notes in
+:mod:`tpudist.parallel.ps_hybrid`); varying-manual-axes checking rejects
+some of those patterns, so it stays off for all strategies uniformly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh
+
+
+def jit_sharded_step(
+    fn: Callable,
+    mesh: Mesh,
+    in_specs: Any,
+    out_specs: Any,
+    donate_first: bool = True,
+) -> Callable:
+    """``jit(shard_map(fn, mesh, ...))``, donating the first argument
+    (the train state) when ``donate_first``."""
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+    return partial(jax.jit, donate_argnums=(0,) if donate_first else ())(sharded)

@@ -17,13 +17,14 @@ so the broadcast is a placement, not a collective protocol.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.parallel.common import jit_sharded_step
 
 if False:  # typing only; a runtime import would cycle through tpudist.train
     from tpudist.train.state import TrainState  # noqa: F401
@@ -76,17 +77,10 @@ def make_dp_train_step(
                    {k: lax.pmean(v, axis) for k, v in aux.items()}}
         return state.apply_gradients(grads), metrics
 
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(P(), P(axis)),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
+    stepped = jit_sharded_step(_step, mesh, (P(), P(axis)), (P(), P()), donate)
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, *batch):
-        return sharded(state, batch)
+        return stepped(state, batch)
 
     return train_step
 
@@ -113,12 +107,11 @@ def make_dp_eval_step(
         correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
         return lax.psum(correct, axis)
 
-    sharded = jax.shard_map(
-        _step, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(), check_vma=False
+    stepped = jit_sharded_step(
+        _step, mesh, (P(), P(axis)), P(), donate_first=False
     )
 
-    @jax.jit
     def eval_step(params, *batch):
-        return sharded(params, batch)
+        return stepped(params, batch)
 
     return eval_step

@@ -38,13 +38,14 @@ pytree over the stage axis (O(1/n_stages) memory) and needs no switch.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.parallel.common import jit_sharded_step
 
 # A stage is a pure function (stage_params, activations[mb, ...]) -> out[mb, ...]
 StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
@@ -235,17 +236,12 @@ def make_pipeline_train_step(
         metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
         return state.apply_gradients(grads), metrics
 
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(P(), (P(data_axis), P(data_axis))),
-        out_specs=(P(), P()),
-        check_vma=False,
+    stepped = jit_sharded_step(
+        _step, mesh, (P(), (P(data_axis), P(data_axis))), (P(), P()), donate
     )
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, x, y):
-        return sharded(state, (x, y))
+        return stepped(state, (x, y))
 
     return train_step
 
@@ -273,13 +269,9 @@ def make_pipeline_forward(
         outputs = lax.psum(outputs, stage_axis)
         return outputs.reshape(b, *outputs.shape[2:])
 
-    sharded = jax.shard_map(
-        _fwd, mesh=mesh,
-        in_specs=(P(), P(data_axis)),
-        out_specs=P(data_axis),
-        check_vma=False,
+    return jit_sharded_step(
+        _fwd, mesh, (P(), P(data_axis)), P(data_axis), donate_first=False
     )
-    return jax.jit(sharded)
 
 
 def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage"):
@@ -363,16 +355,12 @@ def make_stacked_pipeline_train_step(
         metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
         return state.apply_gradients(grads), metrics
 
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(state_specs, (P(data_axis), P(data_axis))),
-        out_specs=(state_specs, P()),
-        check_vma=False,
+    stepped = jit_sharded_step(
+        _step, mesh, (state_specs, (P(data_axis), P(data_axis))),
+        (state_specs, P()), donate,
     )
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, x, y):
-        return sharded(state, (x, y))
+        return stepped(state, (x, y))
 
     return train_step

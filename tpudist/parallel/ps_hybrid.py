@@ -31,13 +31,14 @@ differentiated path.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.parallel.common import jit_sharded_step
 
 # dense_apply(fc_params, bag[b, D]) -> logits[b, C]
 DenseApply = Callable[[Any, jnp.ndarray], jnp.ndarray]
@@ -140,17 +141,13 @@ def make_ps_hybrid_train_step(
         metrics = {"loss": lax.pmean(lax.psum(loss, model_axis), data_axis)}
         return state.apply_gradients(synced), metrics
 
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(state_specs, (P(data_axis), P(data_axis), P(data_axis))),
-        out_specs=(state_specs, P()),
-        check_vma=False,
+    stepped = jit_sharded_step(
+        _step, mesh, (state_specs, (P(data_axis), P(data_axis), P(data_axis))),
+        (state_specs, P()), donate,
     )
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, indices, mask, targets):
-        return sharded(state, (indices, mask, targets))
+        return stepped(state, (indices, mask, targets))
 
     return train_step
 
@@ -165,6 +162,11 @@ def make_ps_hybrid_forward(
     model_axis: str = "model",
 ):
     """Inference: ``fn(params, indices, mask) -> logits`` (replicated)."""
+    if num_embeddings % mesh.shape[model_axis]:
+        raise ValueError(
+            f"{num_embeddings} embedding rows not divisible by "
+            f"{model_axis}={mesh.shape[model_axis]}"
+        )
     param_specs = ps_state_specs(state_example, table_key, model_axis)
 
     def _fwd(params, indices, mask):
@@ -173,10 +175,7 @@ def make_ps_hybrid_forward(
             {k: v for k, v in params.items() if k != table_key}, bag
         )
 
-    sharded = jax.shard_map(
-        _fwd, mesh=mesh,
-        in_specs=(param_specs, P(data_axis), P(data_axis)),
-        out_specs=P(data_axis),
-        check_vma=False,
+    return jit_sharded_step(
+        _fwd, mesh, (param_specs, P(data_axis), P(data_axis)), P(data_axis),
+        donate_first=False,
     )
-    return jax.jit(sharded)
