@@ -1,0 +1,36 @@
+"""Shared launcher plumbing for the example twins.
+
+The reference's L5 layer (SURVEY.md §1) is torchrun / horovodrun /
+``mp.spawn``; on TPU one Python process per host drives every local device,
+so "launching a world" is just importing jax — plus, for laptops and CI, an
+optional CPU-simulated mesh (the ``mp.spawn``-on-localhost equivalent,
+SURVEY.md §4).
+
+``--sim-devices N`` must take effect before jax initializes, so examples call
+:func:`setup_platform` with raw ``sys.argv`` before importing jax.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Sequence
+
+
+def setup_platform(argv: Sequence[str] | None = None) -> list[str]:
+    """Consume ``--sim-devices N`` from ``argv`` (before jax import).
+
+    Returns the remaining argv.  With N > 0, forces the CPU backend with N
+    simulated devices; otherwise the ambient platform (real TPU when
+    present) is used.
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--sim-devices" in argv:
+        i = argv.index("--sim-devices")
+        n = int(argv[i + 1])
+        del argv[i : i + 2]
+        if n > 0:
+            from tpudist.runtime.simulate import force_cpu_devices
+
+            force_cpu_devices(n)
+    return argv

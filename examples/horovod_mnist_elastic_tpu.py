@@ -1,0 +1,152 @@
+"""Elastic allreduce MNIST training with commit/rollback — twin of
+``horovod/horovod_mnist_elastic.py``.
+
+The reference: AdamW lr=0.01/sqrt(world), ``@hvd.elastic.run`` around
+``train(state)``, ``TorchState(model, optimizer, batch=0, epoch=0)``,
+``state.commit()`` every 30 batches, batch-offset skip on resume, an
+``on_state_reset`` callback rescaling lr on world-size change, and a final
+accuracy test (`horovod_mnist_elastic.py:11-108`).
+
+Here :class:`tpudist.elastic.ElasticState` + :func:`elastic_run` provide the
+same contract: commit = device->host snapshot (plus optional durable
+checkpoint), rollback + reset callbacks on a world change, resume lands
+exactly on the committed (epoch, batch) — fixing the reference's off-by-one
+committed batch index (SURVEY.md §3.3 quirk).  World changes on TPU arrive
+as slice preemptions; ``--resize-at epoch:batch:new_size`` injects one for
+demonstration/testing (the reference has no fault injection, SURVEY.md §5).
+
+Run:  python examples/horovod_mnist_elastic_tpu.py --epochs 15
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from _common import setup_platform
+
+BATCHES_PER_COMMIT = 30  # `horovod_mnist_elastic.py:13`
+
+
+def main(argv=None) -> float:
+    argv = setup_platform(argv)
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--epochs", default=15, type=int,
+                        help="reference trains 15 (`horovod_mnist_elastic.py:61`)")
+    parser.add_argument("--batch-size", default=128, type=int,
+                        help="per-replica batch (`horovod_mnist_elastic.py:52`)")
+    parser.add_argument("--base-lr", default=0.01, type=float,
+                        help="lr is base/sqrt(world) (`horovod_mnist_elastic.py:41`)")
+    parser.add_argument("--commit-every", default=BATCHES_PER_COMMIT, type=int)
+    parser.add_argument("--limit", default=0, type=int)
+    parser.add_argument("--resize-at", default="",
+                        help="epoch:batch:new_world — inject one elastic resize")
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+    import optax
+
+    import tpudist
+    from tpudist.data.loader import ShardedLoader
+    from tpudist.data.mnist import load_mnist
+    from tpudist.elastic.loop import WorldChanged, elastic_run
+    from tpudist.elastic.state import ElasticState
+    from tpudist.models import ConvNet
+    from tpudist.ops.losses import nll_loss
+    from tpudist.parallel.data_parallel import (
+        broadcast_params,
+        make_dp_eval_step,
+        make_dp_train_step,
+    )
+    from tpudist.train.state import TrainState
+
+    mesh = tpudist.data_mesh()
+    world = mesh.shape["data"]
+    global_batch = args.batch_size * world
+
+    train_ds = load_mnist("train", n=args.limit or None)
+    test_ds = load_mnist("test", n=args.limit or None)
+    loader = ShardedLoader(
+        [train_ds.images, train_ds.labels], global_batch, mesh, shuffle=True
+    )
+    test_loader = ShardedLoader([test_ds.images, test_ds.labels], global_batch, mesh)
+
+    model = ConvNet()
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 28, 28, 1), np.float32)
+    )["params"]
+
+    def make_tx(world_size: int) -> optax.GradientTransformation:
+        return optax.adamw(args.base_lr / math.sqrt(world_size))
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=True, rngs={"dropout": rng})
+        return nll_loss(logits, y), {}
+
+    def predict(params, inputs):
+        return model.apply({"params": params}, *inputs)
+
+    train_step = make_dp_train_step(loss_fn, mesh, donate=False)
+    eval_step = make_dp_eval_step(predict, mesh)
+
+    state = ElasticState(
+        TrainState.create(model.apply, broadcast_params(params, mesh), make_tx(world)),
+        world_size=world,
+    )
+
+    def on_state_reset(es: ElasticState, old_world: int, new_world: int) -> None:
+        # lr rescale on world change (`horovod_mnist_elastic.py:80-82`);
+        # opt_state layout is lr-independent for adamw, so swapping the tx is
+        # the whole reset.
+        es.state = es.state.replace(tx=make_tx(new_world))
+        print(f"reset: world {old_world} -> {new_world}, "
+              f"lr -> {args.base_lr / math.sqrt(new_world):.5f}")
+
+    state.register_reset_callbacks([on_state_reset])
+
+    resize_at = None
+    if args.resize_at:
+        e, b, w = (int(v) for v in args.resize_at.split(":"))
+        resize_at = {"epoch": e, "batch": b, "world": w, "armed": True}
+
+    def train(es: ElasticState) -> None:
+        # the reference's `@hvd.elastic.run def train(state)` body
+        # (`horovod_mnist_elastic.py:55-77`): resume from committed epoch,
+        # skip batches before the committed offset, commit periodically.
+        for epoch in range(es.host.epoch, args.epochs):
+            batch_offset = es.host.batch if epoch == es.host.epoch else 0
+            for batch_idx, batch in enumerate(loader.epoch(epoch)):
+                if batch_idx < batch_offset:
+                    continue
+                if (resize_at and resize_at["armed"]
+                        and epoch == resize_at["epoch"]
+                        and batch_idx == resize_at["batch"]):
+                    resize_at["armed"] = False
+                    raise WorldChanged(resize_at["world"])
+                es.state, metrics = train_step(es.state, *batch)
+                es.host.epoch, es.host.batch = epoch, batch_idx + 1
+                if (batch_idx + 1) % args.commit_every == 0:
+                    es.commit()
+            es.host.epoch, es.host.batch = epoch + 1, 0
+            es.commit()
+            print(f"Epoch {epoch} done | loss "
+                  f"{float(jax.device_get(metrics['loss'])):.4f}")
+
+    elastic_run(train, state)
+
+    correct = 0
+    seen = 0
+    for batch in test_loader.epoch(0):
+        correct += int(jax.device_get(eval_step(state.state.params, *batch)))
+        seen += global_batch
+    accuracy = correct / max(seen, 1)
+    print(f"accuracy: {100 * accuracy:.2f}%")  # `horovod_mnist_elastic.py:102`
+    return accuracy
+
+
+if __name__ == "__main__":
+    main()

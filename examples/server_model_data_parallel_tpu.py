@@ -1,0 +1,102 @@
+"""Parameter-server hybrid parallelism — twin of
+``rpc/server_model_data_parallel.py``.
+
+The reference: a 4-role topology (master, 2 trainers, 1 parameter server)
+where an ``EmbeddingBag(100, 16, mode=sum)`` lives on the PS behind
+``RemoteModule`` RPC lookups, each trainer runs a DDP-wrapped
+``Linear(16, 8)`` over its own random ragged batches, and
+``dist_autograd`` + ``DistributedOptimizer`` (SGD lr=0.05) route embedding
+grads trainer -> ps while gloo allreduces the dense grads; 100 epochs x 10
+batches, CrossEntropy, progress print every 5 epochs
+(`server_model_data_parallel.py:71-185`).
+
+Here the 4 roles dissolve into shardings on one ``data x model`` mesh: the
+table shards row-wise over ``model`` (the PS), the dense layer replicates
+over ``data`` (the DDP trainers), and one compiled step contains the lookup
+psum (the RPC round-trip), the grad routing (the dist_autograd paths) and
+the update (`tpudist/parallel/ps_hybrid.py`).  The reference's
+``get_next_batch`` arity bug (SURVEY.md §3.5) is not reproduced — each data
+shard gets its own deterministic ragged stream, as documented intent.
+
+Run:  python examples/server_model_data_parallel_tpu.py --sim-devices 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from _common import setup_platform
+
+
+def main(argv=None) -> float:
+    argv = setup_platform(argv)
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--epochs", default=100, type=int,
+                        help="`server_model_data_parallel.py:93`")
+    parser.add_argument("--batches-per-epoch", default=10, type=int,
+                        help="`server_model_data_parallel.py:56`")
+    parser.add_argument("--batch-size", default=10, type=int,
+                        help="per data shard, like each trainer's stream")
+    parser.add_argument("--num-embeddings", default=100, type=int)
+    parser.add_argument("--embedding-dim", default=16, type=int)
+    parser.add_argument("--num-classes", default=8, type=int)
+    parser.add_argument("--model-shards", default=2, type=int)
+    parser.add_argument("--lr", default=0.05, type=float)
+    parser.add_argument("--log-every", default=5, type=int)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpudist.data.synthetic import ragged_embedding_batches
+    from tpudist.models import EmbeddingBagClassifier
+    from tpudist.ops.losses import cross_entropy
+    from tpudist.parallel.ps_hybrid import make_ps_hybrid_train_step
+    from tpudist.runtime.mesh import data_model_mesh
+    from tpudist.train.state import TrainState
+
+    mesh = data_model_mesh(args.model_shards)
+    data_shards = mesh.shape["data"]
+    global_batch = args.batch_size * data_shards
+
+    model = EmbeddingBagClassifier(
+        num_embeddings=args.num_embeddings,
+        embedding_dim=args.embedding_dim,
+        num_classes=args.num_classes,
+    )
+    probe_idx = jnp.zeros((1, 10), jnp.int32)
+    params = model.init(jax.random.key(0), probe_idx, jnp.ones((1, 10)))["params"]
+
+    def dense_apply(rest, bag):
+        return (bag @ rest["fc"]["kernel"] + rest["fc"]["bias"]).astype(jnp.float32)
+
+    state = TrainState.create(model.apply, params, optax.sgd(args.lr))
+    step = make_ps_hybrid_train_step(
+        dense_apply, cross_entropy, mesh, state,
+        num_embeddings=args.num_embeddings,
+    )
+
+    loss = float("nan")
+    for epoch in range(args.epochs):
+        stream = ragged_embedding_batches(
+            args.batches_per_epoch, batch=global_batch,
+            num_embeddings=args.num_embeddings,
+            num_classes=args.num_classes, seed=epoch,
+        )
+        for indices, mask, target in stream:
+            state, metrics = step(
+                state, jnp.asarray(indices), jnp.asarray(mask), jnp.asarray(target)
+            )
+        if epoch % args.log_every == 0:
+            loss = float(jax.device_get(metrics["loss"]))
+            # `server_model_data_parallel.py:110-111` progress print
+            print(f"Training done for epoch {epoch} | loss {loss:.4f}")
+    return float(jax.device_get(metrics["loss"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,19 +13,11 @@ the config again after importing jax — unit tests must never touch real
 hardware.
 """
 
-import os
+from tpudist.runtime.simulate import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_devices(8)
 
-import jax  # noqa: E402  (import after the env is set)
-
-jax.config.update("jax_platforms", "cpu")
-
+import jax  # noqa: E402
 import pytest  # noqa: E402
 
 

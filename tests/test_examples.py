@@ -1,0 +1,68 @@
+"""Smoke tests for the example twins — each reference script's `_tpu.py`
+sibling runs end-to-end on the CPU-simulated mesh with tiny configs (the
+single-host multi-process simulation pattern, SURVEY.md §4)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).parent.parent / "examples"
+sys.path.insert(0, str(EXAMPLES))
+
+
+def test_mnist_ddp_elastic_twin(tmp_path):
+    import mnist_ddp_elastic_tpu
+
+    snap = str(tmp_path / "snap.npz")
+    summary = mnist_ddp_elastic_tpu.main(
+        ["1", "1", "--batch_size", "32", "--limit", "1024",
+         "--snapshot-path", snap, "--features", "128", "--hidden-layers", "2"]
+    )
+    assert summary["test_accuracy"] > 0.5
+    # relaunch resumes from the snapshot (TorchElastic restart semantics)
+    resumed = mnist_ddp_elastic_tpu.main(
+        ["2", "1", "--batch_size", "32", "--limit", "1024",
+         "--snapshot-path", snap, "--features", "128", "--hidden-layers", "2"]
+    )
+    assert resumed["epoch"] == 1
+
+
+def test_mnist_horovod_twin():
+    import mnist_horovod_tpu
+
+    loss = mnist_horovod_tpu.main(
+        ["--epochs", "4", "--batch-size", "64", "--limit", "4096",
+         "--lr", "0.05", "--momentum", "0.9", "--log-every", "4"]
+    )
+    assert loss == loss and loss < 2.0  # finite, learning
+
+
+def test_horovod_elastic_twin_with_resize():
+    import horovod_mnist_elastic_tpu
+
+    acc = horovod_mnist_elastic_tpu.main(
+        ["--epochs", "3", "--batch-size", "64", "--limit", "2048",
+         "--commit-every", "2", "--resize-at", "1:1:4"]
+    )
+    assert acc > 0.5
+
+
+def test_server_model_data_parallel_twin():
+    import server_model_data_parallel_tpu
+
+    loss = server_model_data_parallel_tpu.main(
+        ["--epochs", "3", "--model-shards", "2", "--log-every", "1"]
+    )
+    assert loss == loss and loss < 5.0
+
+
+@pytest.mark.slow
+def test_model_parallel_resnet50_twin():
+    import model_parallel_resnet50_tpu
+
+    results = model_parallel_resnet50_tpu.main(
+        ["--image-size", "32", "--batch-size", "4", "--num-splits", "2",
+         "--num-batches", "1", "--stages", "2"]
+    )
+    assert all(t > 0 for t in results.values())

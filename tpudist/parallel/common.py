@@ -13,7 +13,6 @@ some of those patterns, so it stays off for all strategies uniformly.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -32,4 +31,4 @@ def jit_sharded_step(
     sharded = jax.shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
-    return partial(jax.jit, donate_argnums=(0,) if donate_first else ())(sharded)
+    return jax.jit(sharded, donate_argnums=(0,) if donate_first else ())

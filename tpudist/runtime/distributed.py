@@ -44,8 +44,18 @@ _CLUSTER_ENV_HINTS = (
     "JAX_COORDINATOR_ADDRESS",
     "COORDINATOR_ADDRESS",
     "MEGASCALE_COORDINATOR_ADDRESS",
-    "TPU_WORKER_HOSTNAMES",
 )
+
+
+def _detected_multihost() -> bool:
+    """True only for an actual multi-host topology: a coordinator address,
+    or a TPU worker list naming more than one host (a single-entry
+    ``TPU_WORKER_HOSTNAMES`` — e.g. a tunneled single-chip dev box — needs
+    no bootstrap and ``initialize`` would fail on it)."""
+    if any(os.environ.get(k) for k in _CLUSTER_ENV_HINTS):
+        return True
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h.strip()]) > 1
 
 
 def initialize(
@@ -65,7 +75,7 @@ def initialize(
     """
     global _initialized
     explicit = coordinator_address is not None or num_processes is not None
-    detected = any(os.environ.get(k) for k in _CLUSTER_ENV_HINTS)
+    detected = _detected_multihost()
     if not _initialized and (explicit or detected):
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,33 @@
+"""CPU-simulated device meshes — the single place that knows the dance.
+
+The TPU-native analog of the reference's ``mp.spawn``-on-localhost pattern
+(`model_parallel_ResNet50.py:260`, SURVEY.md §4): N fake CPU devices let
+mesh/sharding/elastic code run anywhere.  Forcing is belt-and-braces because
+ambient environments may register a real TPU backend at startup AND pin
+``jax_platforms`` via ``jax.config`` (which overrides the env var): we set
+the env vars (read at backend initialization) and update the config after
+import.  Must be called before anything initializes a JAX backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Force the CPU platform with ``n`` simulated devices."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.device_count() < n:
+        raise RuntimeError(
+            f"requested {n} simulated devices but the backend was already "
+            f"initialized with {jax.device_count()}; call force_cpu_devices "
+            "before any jax device query (XLA_FLAGS is read only once)"
+        )
