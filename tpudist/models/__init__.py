@@ -11,5 +11,19 @@ from tpudist.models.convnet import ConvNet
 from tpudist.models.embedding import EmbeddingBagClassifier
 from tpudist.models.mlp import MLP
 from tpudist.models.resnet import ResNet50, resnet50_stages
+from tpudist.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    sdpa,
+)
 
-__all__ = ["ConvNet", "EmbeddingBagClassifier", "MLP", "ResNet50", "resnet50_stages"]
+__all__ = [
+    "ConvNet",
+    "EmbeddingBagClassifier",
+    "MLP",
+    "ResNet50",
+    "TransformerConfig",
+    "TransformerLM",
+    "resnet50_stages",
+    "sdpa",
+]

@@ -1,0 +1,150 @@
+"""Decoder-only transformer LM — the long-context workload of the zoo.
+
+The reference suite has no attention model anywhere (SURVEY.md §2.3: the
+sequence-parallel family is absent; largest model is ResNet50,
+`model_parallel_ResNet50.py:43-139`).  tpudist adds one deliberately: it is
+the workload that exercises tensor parallelism
+(:mod:`tpudist.parallel.tensor_parallel`), sequence/context parallelism and
+ring attention (:mod:`tpudist.parallel.ring_attention`), and the pallas
+flash-attention kernel (:mod:`tpudist.ops.flash_attention`) — the
+capabilities a modern user of the reference's *mechanisms* (RPC model
+parallelism, DDP) actually scales with on TPU.
+
+Design notes (TPU-first):
+
+* every projection width is a multiple of 128 (MXU lane width); compute in
+  bfloat16 with float32 params via ``compute_dtype``;
+* attention is **pluggable**: any ``AttentionFn`` with the
+  ``(q, k, v, *, causal) -> out`` contract on ``[batch, seq, heads, hd]``
+  arrays can be swapped in — the default is plain softmax attention, ring
+  attention and the pallas kernel provide drop-in replacements;
+* static shapes everywhere; the layer stack is a Python loop (unrolled at
+  trace time), causality is a static flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# (q, k, v, causal) on [batch, seq, num_heads, head_dim] -> same-shape out.
+AttentionFn = Callable[..., jnp.ndarray]
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Plain scaled-dot-product attention on [B, S, H, D] arrays.
+
+    The reference semantics all pluggable attention implementations (ring,
+    pallas flash) must match.  Softmax statistics in float32 regardless of
+    the compute dtype — bfloat16 logits lose too much for long sequences.
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    embed_dim: int = 128
+    mlp_ratio: int = 4
+    max_seq_len: int = 512
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.embed_dim % self.num_heads == 0
+        return self.embed_dim // self.num_heads
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: AttentionFn = sdpa
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
+        cfg = self.cfg
+        b, s, _ = x.shape
+        qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False,
+                       dtype=cfg.compute_dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = self.attention_fn(q, k, v, causal=causal)
+        out = out.reshape(b, s, cfg.embed_dim)
+        return nn.Dense(cfg.embed_dim, use_bias=False,
+                        dtype=cfg.compute_dtype, name="proj")(out)
+
+
+class MLPBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, use_bias=False,
+                     dtype=cfg.compute_dtype, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.embed_dim, use_bias=False,
+                        dtype=cfg.compute_dtype, name="down")(h)
+
+
+class DecoderBlock(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: AttentionFn = sdpa
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
+        h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(self.cfg, self.attention_fn,
+                                    name="attn")(h, causal=causal)
+        h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
+        return x + MLPBlock(self.cfg, name="mlp")(h)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, vocab] f32."""
+
+    cfg: TransformerConfig
+    attention_fn: AttentionFn = sdpa
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        *,
+        causal: bool = True,
+        positions: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     dtype=cfg.compute_dtype, name="tok_embed")(tokens)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                         dtype=cfg.compute_dtype, name="pos_embed")(positions)
+        for i in range(cfg.num_layers):
+            x = DecoderBlock(cfg, self.attention_fn,
+                             name=f"block{i}")(x, causal=causal)
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)

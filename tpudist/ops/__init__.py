@@ -1,5 +1,21 @@
-"""Numerical ops: losses, metrics; pallas kernels live in ``ops.kernels``."""
+"""Numerical ops: losses/metrics plus the pallas TPU kernels
+(:mod:`tpudist.ops.flash_attention`)."""
 
-from tpudist.ops.losses import accuracy, cross_entropy, mse_loss, nll_loss
+from tpudist.ops.flash_attention import flash_attention, flash_attention_fn
+from tpudist.ops.losses import (
+    accuracy,
+    cross_entropy,
+    cross_entropy_per_token,
+    mse_loss,
+    nll_loss,
+)
 
-__all__ = ["accuracy", "cross_entropy", "mse_loss", "nll_loss"]
+__all__ = [
+    "accuracy",
+    "cross_entropy",
+    "cross_entropy_per_token",
+    "flash_attention",
+    "flash_attention_fn",
+    "mse_loss",
+    "nll_loss",
+]

@@ -1,0 +1,189 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the transformer workload, written as a fused Pallas kernel so
+the [S, S] score matrix never exists in HBM: per (batch, head, q-block)
+program, K/V stream through VMEM in ``block_k`` tiles with the online-
+softmax recurrence, and only the [S, D] output (plus the [S] log-sum-exp
+row statistics for the backward pass) is written back.  This is the
+single-chip counterpart of the cross-chip recurrence in
+:func:`tpudist.parallel.ring_attention_fn` — same math, the ring rotates
+blocks over ICI while this kernel rotates them through VMEM.
+
+Matmuls hit the MXU with float32 accumulation (``preferred_element_type``);
+statistics (row max / row sum) stay in 2-D [block_q, 1] layout to respect
+the (8, 128) sublane×lane tiling.  Causal grid steps strictly above the
+diagonal are skipped under ``pl.when`` — their K/V tiles are fetched by the
+grid pipeline but no FLOPs run.
+
+Training: :func:`flash_attention` carries a ``custom_vjp`` — the forward is
+the fused kernel, the backward recomputes P from the saved (q, k, v, lse)
+with the standard dS = P ∘ (dO·Vᵀ − rowsum(dO ∘ O)) identities as plain XLA
+einsums (fused well by the compiler; a dedicated backward kernel is a
+further optimisation, not a correctness need).
+
+On CPU (tests, CI) the kernel runs in interpreter mode automatically;
+numerics match :func:`tpudist.models.sdpa` to float tolerance either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  num_kb: int):
+    """One (batch, head, q-block, k-block) grid step.
+
+    The K grid dimension is innermost and sequential on TPU, so the VMEM
+    scratch accumulators (running max / sum / weighted values) carry the
+    online-softmax state across K steps while only one [block_k, D] K/V
+    tile is resident at a time.
+    """
+    qi, kj = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: q-blocks strictly above the diagonal contribute nothing.
+    live = (qi + 1) * block_q > kj * block_k if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # [bq, D]
+        kb = k_ref[0, :, 0, :].astype(jnp.float32)             # [bk, D]
+        vb = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m = m_scr[:]                                           # [bq, 1]
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
+        p = jnp.exp(s - new_m)                                 # masked → 0
+        corr = jnp.exp(m - new_m)
+        m_scr[:] = new_m
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, :, 0, :] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    num_kb = s // block_k
+    kernel = functools.partial(
+        _flash_kernel, scale=d ** -0.5, causal=causal,
+        block_q=block_q, block_k=block_k, num_kb=num_kb)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, s // block_q, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1, d), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    scale = q.shape[-1] ** -0.5
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    of, dof = out.astype(jnp.float32), dout.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])                       # [B,H,Sq,Sk]
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1).transpose(0, 2, 1)  # [B,H,Sq]
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Fused attention on [B, S, H, D] arrays; drop-in for
+    :func:`tpudist.models.sdpa` (same ``AttentionFn`` contract),
+    differentiable via ``custom_vjp``."""
+    s = q.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq_len {s}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def flash_attention_fn(
+    block_q: int = 128, block_k: int = 128, interpret: bool | None = None
+):
+    """``AttentionFn`` factory for :class:`tpudist.models.TransformerLM`:
+    ``TransformerLM(cfg, attention_fn=flash_attention_fn())``."""
+
+    def attend(q, k, v, *, causal: bool = True):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+
+    return attend

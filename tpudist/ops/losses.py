@@ -23,9 +23,19 @@ def log_softmax(logits: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean softmax cross-entropy with integer labels."""
+    return jnp.mean(cross_entropy_per_token(logits, labels))
+
+
+def cross_entropy_per_token(
+    logits: jnp.ndarray, labels: jnp.ndarray
+) -> jnp.ndarray:
+    """UNREDUCED cross-entropy, one value per row — the building block the
+    sharded strategies need so they can sum locally and normalise by the
+    *global* token count (see :func:`tpudist.parallel.make_sp_train_step`)."""
     logp = log_softmax(logits.astype(jnp.float32))
-    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
-    return jnp.mean(nll)
+    return -jnp.take_along_axis(
+        logp, labels[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
 
 
 def nll_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

@@ -26,9 +26,33 @@ from tpudist.parallel.ps_hybrid import (
     ps_state_specs,
     sharded_bag_lookup,
 )
+from tpudist.parallel.ring_attention import (
+    make_sp_train_step,
+    ring_attention_fn,
+    sp_forward,
+    ulysses_attention_fn,
+)
+from tpudist.parallel.tensor_parallel import (
+    make_spmd_train_step,
+    make_tp_state,
+    shard_batch,
+    shard_tree,
+    spec_tree_from_rules,
+    transformer_tp_rules,
+)
 
 __all__ = [
     "broadcast_params",
+    "make_sp_train_step",
+    "make_spmd_train_step",
+    "make_tp_state",
+    "ring_attention_fn",
+    "sp_forward",
+    "ulysses_attention_fn",
+    "shard_batch",
+    "shard_tree",
+    "spec_tree_from_rules",
+    "transformer_tp_rules",
     "make_dp_eval_step",
     "make_dp_train_step",
     "make_pipeline_forward",

@@ -1,0 +1,192 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Absent from the reference (SURVEY.md §2.3: no attention model, no sequence
+dimension anywhere), but first-class here: long-context training is the
+workload whose communication pattern the reference's point-to-point RPC
+transport (`model_parallel_ResNet50.py:173-174`) would have needed at scale,
+re-expressed over ICI.
+
+Two interchangeable strategies, both plugging into
+:class:`tpudist.models.TransformerLM` via its ``attention_fn`` hook:
+
+* :func:`ring_attention_fn` — blockwise attention with **online softmax**
+  (flash-attention recurrence): each device keeps its Q block resident and
+  the K/V blocks rotate around the mesh axis via ``lax.ppermute`` (one ICI
+  hop per step, ``axis_size`` steps).  Memory per device is O(S/n · S/n) per
+  block instead of O(S²); K/V transfer overlaps with the block matmuls in
+  XLA's pipelined schedule.
+* :func:`ulysses_attention_fn` — two ``lax.all_to_all``s swap the sharded
+  axis: [B, S/n, H, D] → [B, S, H/n, D] (full sequence, head subset), plain
+  attention, swap back.  Cheaper collectives on small meshes; requires
+  ``num_heads % axis_size == 0``.
+
+Both match :func:`tpudist.models.sdpa` bit-for-bit up to float tolerance —
+tested against it in ``tests/test_ring_attention.py``.
+
+Use inside any ``shard_map`` whose in_specs shard the sequence dimension;
+:func:`make_sp_train_step` packages the full DP×SP transformer train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.parallel.common import jit_sharded_step
+
+_NEG_BIG = -1e30  # finite stand-in for -inf: keeps the online-softmax
+                  # recurrence NaN-free on fully-masked rows
+
+
+def ring_attention_fn(axis_name: str = "seq") -> Callable:
+    """Return an ``AttentionFn`` computing exact attention over a
+    sequence-sharded axis by rotating K/V around the ring.
+
+    Must be called inside a ``shard_map`` over ``axis_name``; q/k/v are the
+    per-shard blocks [B, S/n, H, D] in global sequence order (shard i holds
+    positions [i·S/n, (i+1)·S/n)).
+    """
+
+    def attend(q, k, v, *, causal: bool = True):
+        n = lax.axis_size(axis_name)
+        my = lax.axis_index(axis_name)
+        b, s_loc, h, d = q.shape
+        scale = d ** -0.5
+        q_pos = my * s_loc + jnp.arange(s_loc)  # global positions of Q rows
+
+        qf = q.astype(jnp.float32)
+        m0 = jnp.full((b, h, s_loc), _NEG_BIG, jnp.float32)
+        l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+        o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+        # K/V blocks travel the ring; `src` rides along so each device knows
+        # which global block it currently holds (for the causal mask).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def body(carry, _):
+            kb, vb, src, m, l, o = carry
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                logits = jnp.where(mask[None, None], logits, -jnp.inf)
+            blk_max = jnp.max(logits, axis=-1)                 # [B,H,Sq]
+            new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
+            p = jnp.exp(logits - new_m[..., None])             # masked → 0
+            corr = jnp.exp(m - new_m)
+            l = l * corr + jnp.sum(p, axis=-1)
+            o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+            kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+            src = lax.ppermute(src, axis_name, perm)
+            return (kb, vb, src, new_m, l, o), None
+
+        init = (k, v, my, m0, l0, o0)
+        (_, _, _, _, l, o), _ = lax.scan(body, init, None, length=n)
+        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return (o / denom).astype(q.dtype)
+
+    return attend
+
+
+def ulysses_attention_fn(axis_name: str = "seq") -> Callable:
+    """All-to-all sequence parallelism: trade the sharded sequence axis for
+    a sharded head axis around an exact full-sequence attention."""
+
+    def attend(q, k, v, *, causal: bool = True):
+        from tpudist.models.transformer import sdpa
+
+        def gather_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+            return lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+        def scatter_heads(x):  # inverse
+            return lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+        out = sdpa(gather_heads(q), gather_heads(k), gather_heads(v),
+                   causal=causal)
+        return scatter_heads(out)
+
+    return attend
+
+
+def make_sp_train_step(
+    model,
+    loss_per_token: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    total_tokens: int,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    donate: bool = True,
+):
+    """DP×SP transformer train step: batch sharded over ``data``, sequence
+    sharded over ``seq``, params replicated.
+
+    ``model`` is a :class:`TransformerLM` **constructed with the matching
+    attention_fn** (``ring_attention_fn(seq_axis)`` or
+    ``ulysses_attention_fn(seq_axis)``); this helper wires positions, the
+    global-mean loss, and the grad psum over both axes.
+
+    ``loss_per_token(logits, targets) -> [tokens]`` returns UNREDUCED per-
+    token losses; the step sums locally and normalises by ``total_tokens``
+    so the cross-shard ``psum`` of gradients is exactly the global-batch
+    gradient.
+    """
+
+    def _step(state, batch):
+        tokens, targets = batch  # local views [B/nd, S/ns]
+        s_loc = tokens.shape[1]
+        seq_idx = lax.axis_index(seq_axis)
+        positions = seq_idx * s_loc + jnp.arange(s_loc)[None, :]
+
+        def local_loss(params):
+            logits = model.apply({"params": params}, tokens,
+                                 positions=positions)
+            per_tok = loss_per_token(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1))
+            return jnp.sum(per_tok) / total_tokens
+
+        loss, grads = jax.value_and_grad(local_loss)(state.params)
+        grads = lax.psum(grads, (data_axis, seq_axis))
+        loss = lax.psum(loss, (data_axis, seq_axis))
+        return state.apply_gradients(grads), {"loss": loss}
+
+    stepped = jit_sharded_step(
+        _step, mesh,
+        (P(), (P(data_axis, seq_axis), P(data_axis, seq_axis))),
+        (P(), P()),
+        donate,
+    )
+
+    def train_step(state, tokens, targets):
+        return stepped(state, (tokens, targets))
+
+    return train_step
+
+
+def sp_forward(
+    model,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+):
+    """Sequence-parallel forward pass: ``fn(params, tokens) -> logits``
+    with tokens/logits sharded [data, seq]."""
+
+    def _fwd(params, tokens):
+        s_loc = tokens.shape[1]
+        positions = lax.axis_index(seq_axis) * s_loc + \
+            jnp.arange(s_loc)[None, :]
+        return model.apply({"params": params}, tokens, positions=positions)
+
+    return jit_sharded_step(
+        _fwd, mesh,
+        (P(), P(data_axis, seq_axis)),
+        P(data_axis, seq_axis),
+        donate_first=False,
+    )

@@ -1,0 +1,150 @@
+"""Tensor (intra-layer model) parallelism via GSPMD sharding rules.
+
+The reference has no tensor parallelism (SURVEY.md §2.3: "falls out of GSPMD
+if wanted") — its model parallelism is inter-layer RPC placement
+(`model_parallel_ResNet50.py:152-165`).  On TPU, intra-layer sharding is the
+idiomatic way to scale a single layer past one chip, and it requires no
+runtime mechanism at all: annotate each parameter with a
+:class:`~jax.sharding.NamedSharding` over the ``model`` mesh axis and jit —
+XLA/GSPMD partitions every matmul and inserts the all-reduces
+(Megatron-style column→row pairing becomes a *layout choice*, not code).
+
+The rule language here is path-pattern → :class:`PartitionSpec`.  For the
+transformer zoo model the canonical Megatron layout ships as
+:func:`transformer_tp_rules`:
+
+* ``qkv``/``up`` kernels: column-sharded ``P(None, "model")`` (heads / mlp
+  width split across chips; no communication in forward);
+* ``proj``/``down`` kernels: row-sharded ``P("model", None)`` (the matching
+  all-reduce after the second matmul of each pair);
+* embeddings / lm_head: vocab-sharded;
+* norms and everything unmatched: replicated.
+
+Combined with a ``data``-sharded batch this gives hybrid DP×TP from a single
+jit — the 2-D-mesh generalisation of the reference's hybrid example
+(`server_model_data_parallel.py:34-46`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudist.train.state import TrainState
+
+# loss_fn(params, batch, rng) -> (scalar_loss, aux_dict) over the GLOBAL batch
+LossFn = Callable[[Any, tuple, jax.Array], tuple[jnp.ndarray, dict]]
+
+Rules = Sequence[tuple[str, P]]
+
+
+def spec_tree_from_rules(params: Any, rules: Rules) -> Any:
+    """Map a pytree of params to a pytree of PartitionSpecs.
+
+    Each leaf's key-path is rendered as ``"a/b/c"`` and matched against the
+    ``rules`` patterns (``re.search``, first match wins); unmatched leaves
+    replicate (``P()``).
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path, leaf) -> P:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def transformer_tp_rules(axis: str = "model") -> Rules:
+    """Megatron-style layout for :class:`tpudist.models.TransformerLM`."""
+    return [
+        (r"attn/qkv/kernel", P(None, axis)),
+        (r"attn/proj/kernel", P(axis, None)),
+        (r"mlp/up/kernel", P(None, axis)),
+        (r"mlp/down/kernel", P(axis, None)),
+        (r"tok_embed/embedding", P(axis, None)),
+        (r"pos_embed/embedding", P()),
+        (r"lm_head/kernel", P(None, axis)),
+    ]
+
+
+def shard_tree(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """``device_put`` each leaf with its NamedSharding (copying device-array
+    leaves first so later buffer donation cannot free a caller's array)."""
+
+    def put(x, spec):
+        if isinstance(x, jax.Array):
+            x = jnp.array(x, copy=True)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, tree, specs)
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """Shard every batch array along its leading (batch) dimension."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(axis))), batch
+    )
+
+
+def make_spmd_train_step(
+    loss_fn: LossFn,
+    mesh: Mesh,
+    param_specs: Any,
+    donate: bool = True,
+):
+    """Build ``train_step(state, *batch) -> (state, metrics)`` in GSPMD mode.
+
+    Unlike the shard_map strategies (explicit per-shard code + collectives),
+    this step is written as a GLOBAL program: ``loss_fn`` sees the full
+    logical batch and full logical params; the compiler partitions it over
+    the mesh from the shardings attached to the inputs.  Data-parallel
+    gradient sync is the batch-mean's cross-shard reduce; tensor-parallel
+    activation all-reduces come from the column→row kernel layouts.  One jit
+    covers DP, TP, and DP×TP — the sharding rules are the strategy.
+
+    ``param_specs`` is re-asserted inside the step (``with_sharding_constraint``)
+    so the layout survives any optimizer re-sharding temptation XLA has.
+    """
+
+    def _step(state: TrainState, batch: tuple):
+        params = jax.lax.with_sharding_constraint(state.params, param_specs)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, state.rng
+        )
+        grads = jax.lax.with_sharding_constraint(grads, param_specs)
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": loss, **aux}
+
+    with mesh:
+        stepped = jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    def train_step(state, *batch):
+        with mesh:
+            return stepped(state, batch)
+
+    return train_step
+
+
+def make_tp_state(
+    model_apply: Callable,
+    params: Any,
+    tx,
+    mesh: Mesh,
+    rules: Rules | None = None,
+    rng: jax.Array | int = 0,
+) -> tuple[TrainState, Any]:
+    """Shard ``params`` by ``rules`` and build a TrainState whose optimizer
+    state inherits the same shardings (``zeros_like`` of a committed sharded
+    array keeps its sharding).  Returns ``(state, param_specs)``."""
+    specs = spec_tree_from_rules(params, rules or transformer_tp_rules())
+    sharded = shard_tree(params, mesh, specs)
+    state = TrainState.create(model_apply, sharded, tx, rng=rng)
+    return state, specs
