@@ -1,0 +1,553 @@
+// tpudist native coordination service.
+//
+// Host-side control plane for elastic multi-host training: a TCP key-value
+// store with blocking waits, named barriers, atomic counters, and
+// heartbeat-based liveness — the TPU-native equivalent of the capabilities
+// the reference suite gets from external native libraries:
+//   * c10d TCPStore / torchrun rendezvous (pytorch_elastic/mnist_ddp_elastic.py:5-6)
+//   * Horovod's C++ elastic controller: membership tracking, worker
+//     blacklist/discovery (horovod/horovod_mnist_elastic.py:108)
+// Data-plane traffic (gradients, activations) never touches this service —
+// that rides ICI via XLA collectives; this is control-plane only, so a
+// simple thread-per-connection TCP server is the right scale (O(hosts)).
+//
+// Exposed as a C ABI (tcs_*) consumed from Python via ctypes
+// (tpudist/runtime/coord.py).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+enum Op : uint8_t {
+  OP_SET = 1,
+  OP_GET = 2,
+  OP_ADD = 3,
+  OP_WAIT = 4,
+  OP_BARRIER = 5,
+  OP_HEARTBEAT = 6,
+  OP_LIVE = 7,
+  OP_DEL = 8,
+  OP_KEYS = 9,
+};
+
+// ---- wire helpers (length-prefixed frames) --------------------------------
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_frame(int fd, std::string* out) {
+  uint32_t len_be;
+  if (!read_exact(fd, &len_be, 4)) return false;
+  uint32_t len = ntohl(len_be);
+  if (len > (64u << 20)) return false;  // 64 MiB sanity cap
+  out->resize(len);
+  return len == 0 || read_exact(fd, &(*out)[0], len);
+}
+
+bool write_frame(int fd, const std::string& payload) {
+  uint32_t len_be = htonl(static_cast<uint32_t>(payload.size()));
+  if (!write_exact(fd, &len_be, 4)) return false;
+  return payload.empty() || write_exact(fd, payload.data(), payload.size());
+}
+
+// Cursor over a request payload.
+struct Reader {
+  const std::string& s;
+  size_t pos = 0;
+  explicit Reader(const std::string& s_) : s(s_) {}
+  bool u8(uint8_t* v) {
+    if (pos + 1 > s.size()) return false;
+    *v = static_cast<uint8_t>(s[pos++]);
+    return true;
+  }
+  bool u32(uint32_t* v) {
+    if (pos + 4 > s.size()) return false;
+    uint32_t be;
+    std::memcpy(&be, s.data() + pos, 4);
+    pos += 4;
+    *v = ntohl(be);
+    return true;
+  }
+  bool i64(int64_t* v) {
+    if (pos + 8 > s.size()) return false;
+    uint64_t be;
+    std::memcpy(&be, s.data() + pos, 8);
+    pos += 8;
+    uint64_t hi = ntohl(static_cast<uint32_t>(be & 0xffffffffu));
+    uint64_t lo = ntohl(static_cast<uint32_t>(be >> 32));
+    *v = static_cast<int64_t>((hi << 32) | lo);
+    return true;
+  }
+  bool str(std::string* v) {
+    uint32_t len;
+    if (!u32(&len) || pos + len > s.size()) return false;
+    v->assign(s, pos, len);
+    pos += len;
+    return true;
+  }
+};
+
+void put_u8(std::string* s, uint8_t v) { s->push_back(static_cast<char>(v)); }
+void put_u32(std::string* s, uint32_t v) {
+  uint32_t be = htonl(v);
+  s->append(reinterpret_cast<const char*>(&be), 4);
+}
+void put_i64(std::string* s, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  put_u32(s, static_cast<uint32_t>(u >> 32));
+  put_u32(s, static_cast<uint32_t>(u & 0xffffffffu));
+}
+void put_str(std::string* s, const std::string& v) {
+  put_u32(s, static_cast<uint32_t>(v.size()));
+  s->append(v);
+}
+
+// ---- server state ---------------------------------------------------------
+
+struct Barrier {
+  int64_t arrived = 0;
+  int64_t generation = 0;  // bumped when a barrier round completes
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;  // live connection fds, for shutdown on stop
+  std::mutex conn_mu;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, Barrier> barriers;
+  std::map<std::string, Clock::time_point> heartbeats;  // worker -> expiry
+
+  void serve(int fd);
+  void run_accept();
+};
+
+void Server::serve(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string req, resp;
+  while (!stopping.load() && read_frame(fd, &req)) {
+    resp.clear();
+    Reader r(req);
+    uint8_t op;
+    if (!r.u8(&op)) break;
+    switch (op) {
+      case OP_SET: {
+        std::string key, val;
+        if (!r.str(&key) || !r.str(&val)) goto done;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        put_u8(&resp, 1);
+        break;
+      }
+      case OP_GET: {
+        std::string key;
+        if (!r.str(&key)) goto done;
+        std::lock_guard<std::mutex> lk(mu);
+        auto it = kv.find(key);
+        if (it == kv.end()) {
+          put_u8(&resp, 0);
+        } else {
+          put_u8(&resp, 1);
+          put_str(&resp, it->second);
+        }
+        break;
+      }
+      case OP_ADD: {
+        std::string key;
+        int64_t delta;
+        if (!r.str(&key) || !r.i64(&delta)) goto done;
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string stored(8, '\0');
+          std::memcpy(&stored[0], &now, 8);
+          kv[key] = std::move(stored);
+        }
+        cv.notify_all();
+        put_u8(&resp, 1);
+        put_i64(&resp, now);
+        break;
+      }
+      case OP_WAIT: {
+        std::string key;
+        int64_t timeout_ms;
+        if (!r.str(&key) || !r.i64(&timeout_ms)) goto done;
+        std::unique_lock<std::mutex> lk(mu);
+        bool ok = cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return stopping.load() || kv.count(key) > 0;
+        });
+        put_u8(&resp, ok && !stopping.load() ? 1 : 0);
+        break;
+      }
+      case OP_BARRIER: {
+        std::string name;
+        int64_t count, timeout_ms;
+        if (!r.str(&name) || !r.i64(&count) || !r.i64(&timeout_ms)) goto done;
+        std::unique_lock<std::mutex> lk(mu);
+        Barrier& b = barriers[name];
+        int64_t my_gen = b.generation;
+        if (++b.arrived >= count) {
+          b.arrived = 0;
+          ++b.generation;
+          cv.notify_all();
+          put_u8(&resp, 1);
+        } else {
+          bool ok = cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+            return stopping.load() || barriers[name].generation != my_gen;
+          });
+          if (!ok) --barriers[name].arrived;  // timed out: withdraw arrival
+          put_u8(&resp, ok && !stopping.load() ? 1 : 0);
+        }
+        break;
+      }
+      case OP_HEARTBEAT: {
+        std::string worker;
+        int64_t ttl_ms;
+        if (!r.str(&worker) || !r.i64(&ttl_ms)) goto done;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          if (ttl_ms <= 0)
+            heartbeats.erase(worker);  // explicit graceful leave
+          else
+            heartbeats[worker] = Clock::now() + std::chrono::milliseconds(ttl_ms);
+        }
+        cv.notify_all();
+        put_u8(&resp, 1);
+        break;
+      }
+      case OP_LIVE: {
+        std::string joined;
+        auto now = Clock::now();
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          for (auto it = heartbeats.begin(); it != heartbeats.end();) {
+            if (it->second < now) {
+              it = heartbeats.erase(it);
+            } else {
+              if (!joined.empty()) joined.push_back(',');
+              joined += it->first;
+              ++it;
+            }
+          }
+        }
+        put_u8(&resp, 1);
+        put_str(&resp, joined);
+        break;
+      }
+      case OP_DEL: {
+        std::string key;
+        if (!r.str(&key)) goto done;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          kv.erase(key);
+        }
+        put_u8(&resp, 1);
+        break;
+      }
+      case OP_KEYS: {
+        std::string prefix, joined;
+        if (!r.str(&prefix)) goto done;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          for (auto it = kv.lower_bound(prefix);
+               it != kv.end() && it->first.compare(0, prefix.size(), prefix) == 0;
+               ++it) {
+            if (!joined.empty()) joined.push_back(',');
+            joined += it->first;
+          }
+        }
+        put_u8(&resp, 1);
+        put_str(&resp, joined);
+        break;
+      }
+      default:
+        goto done;
+    }
+    if (!write_frame(fd, resp)) break;
+  }
+done:
+  {
+    // Deregister before close so stop() never shutdowns a recycled fd.
+    std::lock_guard<std::mutex> lk(conn_mu);
+    for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+      if (*it == fd) {
+        conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void Server::run_accept() {
+  while (!stopping.load()) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(conn_mu);
+    conn_fds.push_back(fd);
+    conn_threads.emplace_back([this, fd] { serve(fd); });
+  }
+}
+
+// ---- client ---------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request/response in flight per connection
+
+  bool call(const std::string& req, std::string* resp) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (fd < 0) return false;
+    if (!write_frame(fd, req)) return false;
+    return read_frame(fd, resp);
+  }
+};
+
+}  // namespace
+
+// ---- C ABI ----------------------------------------------------------------
+
+extern "C" {
+
+void* tcs_server_start(uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->run_accept(); });
+  return s;
+}
+
+int tcs_server_port(void* h) {
+  return h ? static_cast<Server*>(h)->port : -1;
+}
+
+void tcs_server_stop(void* h) {
+  if (!h) return;
+  Server* s = static_cast<Server*>(h);
+  s->stopping.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->accept_thread.join();
+  {
+    // Unblock connection threads parked in recv on idle clients.
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads) t.join();
+  delete s;
+}
+
+void* tcs_connect(const char* host, uint16_t port, int timeout_ms) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // Not a numeric literal: resolve the hostname (coordinator addresses on
+    // multi-host slices are DNS names, e.g. "t1v-n-xxxxxx-w-0").
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+      return nullptr;
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  // Retry-with-deadline: the server may not be up yet (rendezvous races).
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    if (Clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Client* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+int tcs_set(void* h, const char* key, const void* val, uint32_t len) {
+  std::string req, resp;
+  put_u8(&req, OP_SET);
+  put_str(&req, key);
+  put_str(&req, std::string(static_cast<const char*>(val), len));
+  if (!static_cast<Client*>(h)->call(req, &resp) || resp.empty()) return -1;
+  return 0;
+}
+
+// 0 = ok, 1 = not found, 2 = buffer too small (*out_len = needed), -1 = error.
+int tcs_get(void* h, const char* key, void* buf, uint32_t cap, uint32_t* out_len) {
+  std::string req, resp;
+  put_u8(&req, OP_GET);
+  put_str(&req, key);
+  if (!static_cast<Client*>(h)->call(req, &resp)) return -1;
+  Reader r(resp);
+  uint8_t found;
+  if (!r.u8(&found)) return -1;
+  if (!found) return 1;
+  std::string val;
+  if (!r.str(&val)) return -1;
+  *out_len = static_cast<uint32_t>(val.size());
+  if (val.size() > cap) return 2;
+  std::memcpy(buf, val.data(), val.size());
+  return 0;
+}
+
+long long tcs_add(void* h, const char* key, long long delta) {
+  std::string req, resp;
+  put_u8(&req, OP_ADD);
+  put_str(&req, key);
+  put_i64(&req, delta);
+  if (!static_cast<Client*>(h)->call(req, &resp)) return INT64_MIN;
+  Reader r(resp);
+  uint8_t ok;
+  int64_t v;
+  if (!r.u8(&ok) || !ok || !r.i64(&v)) return INT64_MIN;
+  return v;
+}
+
+int tcs_wait(void* h, const char* key, int timeout_ms) {
+  std::string req, resp;
+  put_u8(&req, OP_WAIT);
+  put_str(&req, key);
+  put_i64(&req, timeout_ms);
+  if (!static_cast<Client*>(h)->call(req, &resp) || resp.empty()) return -1;
+  return resp[0] ? 0 : 1;  // 0 = key present, 1 = timeout
+}
+
+int tcs_barrier(void* h, const char* name, int count, int timeout_ms) {
+  std::string req, resp;
+  put_u8(&req, OP_BARRIER);
+  put_str(&req, name);
+  put_i64(&req, count);
+  put_i64(&req, timeout_ms);
+  if (!static_cast<Client*>(h)->call(req, &resp) || resp.empty()) return -1;
+  return resp[0] ? 0 : 1;  // 0 = released, 1 = timeout
+}
+
+int tcs_heartbeat(void* h, const char* worker, int ttl_ms) {
+  std::string req, resp;
+  put_u8(&req, OP_HEARTBEAT);
+  put_str(&req, worker);
+  put_i64(&req, ttl_ms);
+  if (!static_cast<Client*>(h)->call(req, &resp) || resp.empty()) return -1;
+  return 0;
+}
+
+static int joined_query(void* h, uint8_t op, const char* arg, char* buf,
+                        uint32_t cap, uint32_t* out_len) {
+  std::string req, resp;
+  put_u8(&req, op);
+  if (op == OP_KEYS) put_str(&req, arg);
+  if (!static_cast<Client*>(h)->call(req, &resp)) return -1;
+  Reader r(resp);
+  uint8_t ok;
+  std::string joined;
+  if (!r.u8(&ok) || !ok || !r.str(&joined)) return -1;
+  *out_len = static_cast<uint32_t>(joined.size());
+  if (joined.size() > cap) return 2;
+  std::memcpy(buf, joined.data(), joined.size());
+  return 0;
+}
+
+int tcs_live(void* h, char* buf, uint32_t cap, uint32_t* out_len) {
+  return joined_query(h, OP_LIVE, "", buf, cap, out_len);
+}
+
+int tcs_keys(void* h, const char* prefix, char* buf, uint32_t cap,
+             uint32_t* out_len) {
+  return joined_query(h, OP_KEYS, prefix, buf, cap, out_len);
+}
+
+int tcs_del(void* h, const char* key) {
+  std::string req, resp;
+  put_u8(&req, OP_DEL);
+  put_str(&req, key);
+  if (!static_cast<Client*>(h)->call(req, &resp) || resp.empty()) return -1;
+  return 0;
+}
+
+void tcs_close(void* h) {
+  if (!h) return;
+  Client* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,247 @@
+// tpudist native data-loader core.
+//
+// The host-side heavy lifting of the input pipeline: multi-threaded
+// row-gather (dataset[indices] -> contiguous batch buffer) executed
+// asynchronously on a worker pool, plus an IDX (MNIST container format)
+// file parser. This is the TPU-native equivalent of the native machinery
+// behind the reference's input path — torch's DataLoader worker processes +
+// pinned-memory copy loop feeding DistributedSampler-sharded batches
+// (pytorch_elastic/mnist_ddp_elastic.py:178-189). Python computes *which*
+// indices go in a batch (sampler semantics stay in one place,
+// tpudist/data/sampler.py); this library makes materializing the batch
+// parallel and overlappable with device compute.
+//
+// C ABI (tdl_*) consumed via ctypes (tpudist/data/native.py).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct GatherJob {
+  // One job = fill n_arrays destination buffers from their sources.
+  struct Part {
+    const char* src;
+    char* dst;
+    int64_t row_bytes;
+  };
+  std::vector<Part> parts;
+  std::vector<int64_t> idx;
+  int64_t id = 0;
+  std::atomic<int64_t> chunks_left{0};
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv;       // wakes workers (new chunks)
+  std::condition_variable done_cv;  // wakes waiters (job finished)
+  bool stopping = false;
+  int64_t next_id = 1;
+
+  struct Chunk {
+    GatherJob* job;
+    int64_t lo, hi;  // row range within job->idx
+  };
+  std::deque<Chunk> queue;
+  std::deque<GatherJob*> finished;  // completed, not yet reaped
+  std::vector<GatherJob*> live;     // all unreaped jobs (for wait lookup)
+
+  void work() {
+    for (;;) {
+      Chunk c;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return stopping || !queue.empty(); });
+        if (stopping) return;
+        c = queue.front();
+        queue.pop_front();
+      }
+      for (const auto& p : c.job->parts) {
+        for (int64_t i = c.lo; i < c.hi; ++i) {
+          std::memcpy(p.dst + i * p.row_bytes,
+                      p.src + c.job->idx[i] * p.row_bytes,
+                      static_cast<size_t>(p.row_bytes));
+        }
+      }
+      if (c.job->chunks_left.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        finished.push_back(c.job);
+        done_cv.notify_all();
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tdl_pool_create(int threads) {
+  if (threads <= 0) threads = 4;
+  Pool* p = new Pool();
+  for (int i = 0; i < threads; ++i)
+    p->workers.emplace_back([p] { p->work(); });
+  return p;
+}
+
+// Queue an asynchronous gather: for each array a, dst[a][i] = src[a][idx[i]].
+// Copies `idx` internally; src/dst must stay valid until the job is waited.
+// Returns the job id (>0), or -1 on error.
+long long tdl_submit(void* h, int n_arrays, const void** src,
+                     const long long* row_bytes, const long long* idx,
+                     long long count, void** dst) {
+  Pool* p = static_cast<Pool*>(h);
+  if (n_arrays <= 0 || count < 0) return -1;
+  GatherJob* job = new GatherJob();
+  job->parts.resize(n_arrays);
+  for (int a = 0; a < n_arrays; ++a) {
+    job->parts[a] = {static_cast<const char*>(src[a]),
+                     static_cast<char*>(dst[a]), row_bytes[a]};
+  }
+  job->idx.assign(idx, idx + count);
+  // Chunk rows so all workers participate on big batches without
+  // fragmenting small ones (min 256 rows per chunk).
+  int64_t n_chunks =
+      std::max<int64_t>(1, std::min<int64_t>(
+          static_cast<int64_t>(p->workers.size()), count / 256));
+  job->chunks_left.store(n_chunks);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    job->id = p->next_id++;
+    p->live.push_back(job);
+    int64_t per = (count + n_chunks - 1) / n_chunks;
+    for (int64_t c = 0; c < n_chunks; ++c) {
+      int64_t lo = c * per;
+      int64_t hi = std::min<int64_t>(count, lo + per);
+      p->queue.push_back({job, lo, hi});
+    }
+  }
+  p->cv.notify_all();
+  return job->id;
+}
+
+// Block until job `id` completes (and reap it). 0 = done, 1 = timeout, -1 = unknown id.
+int tdl_wait(void* h, long long id, int timeout_ms) {
+  Pool* p = static_cast<Pool*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  auto known = [&] {
+    for (auto* j : p->live)
+      if (j->id == id) return true;
+    return false;
+  };
+  if (!known()) return -1;
+  auto is_finished = [&] {
+    for (auto* j : p->finished)
+      if (j->id == id) return true;
+    return false;
+  };
+  bool ok = p->done_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                [&] { return p->stopping || is_finished(); });
+  if (!ok) return 1;
+  if (p->stopping) return -1;
+  for (auto it = p->finished.begin(); it != p->finished.end(); ++it) {
+    if ((*it)->id == id) {
+      GatherJob* j = *it;
+      p->finished.erase(it);
+      for (auto lit = p->live.begin(); lit != p->live.end(); ++lit)
+        if (*lit == j) {
+          p->live.erase(lit);
+          break;
+        }
+      delete j;
+      return 0;
+    }
+  }
+  return -1;
+}
+
+void tdl_pool_destroy(void* h) {
+  Pool* p = static_cast<Pool*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->cv.notify_all();
+  p->done_cv.notify_all();
+  for (auto& t : p->workers) t.join();
+  for (auto* j : p->live) delete j;
+  delete p;
+}
+
+// ---- IDX (MNIST container) parsing ---------------------------------------
+// Format: [0x00 0x00 dtype ndim] then ndim big-endian u32 dims, then data.
+// dtype 0x08=u8 0x09=i8 0x0B=i16 0x0C=i32 0x0D=f32 0x0E=f64.
+
+int tdl_idx_info(const char* path, int* dtype, int* ndim, long long* dims8) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  *dtype = hdr[2];
+  *ndim = hdr[3];
+  if (*ndim <= 0 || *ndim > 8) {
+    std::fclose(f);
+    return -1;
+  }
+  for (int i = 0; i < *ndim; ++i) {
+    unsigned char d[4];
+    if (std::fread(d, 1, 4, f) != 4) {
+      std::fclose(f);
+      return -1;
+    }
+    dims8[i] = (static_cast<long long>(d[0]) << 24) | (d[1] << 16) |
+               (d[2] << 8) | d[3];
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// Read the payload (post-header) into buf; element byte-swap for multi-byte
+// dtypes (IDX is big-endian). Returns bytes written, or -1.
+long long tdl_idx_read(const char* path, void* buf, long long cap) {
+  int dtype, ndim;
+  long long dims[8];
+  if (tdl_idx_info(path, &dtype, &ndim, dims) != 0) return -1;
+  long long elems = 1;
+  for (int i = 0; i < ndim; ++i) elems *= dims[i];
+  int esize;
+  switch (dtype) {
+    case 0x08: case 0x09: esize = 1; break;
+    case 0x0B: esize = 2; break;
+    case 0x0C: case 0x0D: esize = 4; break;
+    case 0x0E: esize = 8; break;
+    default: return -1;
+  }
+  long long total = elems * esize;
+  if (total > cap) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 4 + 4 * ndim, SEEK_SET);
+  long long got = static_cast<long long>(std::fread(buf, 1, total, f));
+  std::fclose(f);
+  if (got != total) return -1;
+  if (esize > 1) {  // big-endian -> host (assumed little-endian)
+    char* b = static_cast<char*>(buf);
+    for (long long e = 0; e < elems; ++e) {
+      for (int i = 0; i < esize / 2; ++i)
+        std::swap(b[e * esize + i], b[e * esize + esize - 1 - i]);
+    }
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,232 @@
+"""Native coordination service: KV, counters, barriers, heartbeats,
+rendezvous, and elastic membership-change detection.
+
+Exercises the C++ store (native/coord.cpp) the way a multi-host elastic job
+would — N worker threads standing in for N hosts, the localhost analog of
+the reference's torchrun/c10d rendezvous and Horovod elastic controller
+(SURVEY.md §2.2, §5)."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from tpudist.runtime.coord import (
+    CoordClient,
+    CoordServer,
+    ElasticMonitor,
+    Rendezvous,
+)
+
+
+@pytest.fixture()
+def server():
+    s = CoordServer(0)
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = CoordClient("127.0.0.1", server.port)
+    yield c
+    c.close()
+
+
+def test_set_get_del(client):
+    assert client.get("missing") is None
+    client.set("k", b"value-bytes")
+    assert client.get("k") == b"value-bytes"
+    client.set("k", "overwritten")
+    assert client.get("k") == b"overwritten"
+    client.delete("k")
+    assert client.get("k") is None
+
+
+def test_connect_resolves_hostnames(server):
+    with CoordClient("localhost", server.port) as c:  # DNS path, not inet_pton
+        c.set("via-hostname", b"1")
+        assert c.get("via-hostname") == b"1"
+
+
+def test_values_larger_than_default_buffer(client):
+    big = bytes(range(256)) * (8 * 1024)  # 2 MiB > 1 MiB default read buffer
+    client.set("big", big)
+    assert client.get("big") == big
+
+
+def test_counter_is_atomic_across_connections(server):
+    n_threads, n_incs = 8, 50
+
+    def bump():
+        with CoordClient("127.0.0.1", server.port) as c:
+            for _ in range(n_incs):
+                c.add("ctr", 1)
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with CoordClient("127.0.0.1", server.port) as c:
+        # counters are stored as raw little-endian i64
+        assert struct.unpack("<q", c.get("ctr"))[0] == n_threads * n_incs
+        assert c.add("ctr", 0) == n_threads * n_incs
+
+
+def test_wait_blocks_until_set(server, client):
+    t0 = time.monotonic()
+    assert not client.wait("later", timeout_s=0.2)  # times out, key absent
+    assert time.monotonic() - t0 >= 0.2
+
+    def setter():
+        time.sleep(0.15)
+        with CoordClient("127.0.0.1", server.port) as c:
+            c.set("later", b"1")
+
+    threading.Thread(target=setter).start()
+    assert client.wait("later", timeout_s=5.0)
+
+
+def test_keys_prefix(client):
+    for k in ("a/1", "a/2", "b/1"):
+        client.set(k, b"x")
+    assert client.keys("a/") == ["a/1", "a/2"]
+    assert set(client.keys("")) == {"a/1", "a/2", "b/1"}
+
+
+def test_barrier_releases_all_and_reuses(server):
+    world = 4
+    released = []
+
+    def worker(i):
+        with CoordClient("127.0.0.1", server.port) as c:
+            for round in range(3):  # same name is reusable across rounds
+                assert c.barrier("b", world, timeout_s=10.0)
+            released.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(released) == list(range(world))
+
+
+def test_barrier_timeout_withdraws_arrival(server, client):
+    assert not client.barrier("lonely", 2, timeout_s=0.2)
+    # The timed-out arrival must not linger: a fresh pair releases cleanly.
+    ok = []
+
+    def arrive():
+        with CoordClient("127.0.0.1", server.port) as c:
+            ok.append(c.barrier("lonely", 2, timeout_s=5.0))
+
+    threads = [threading.Thread(target=arrive) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ok == [True, True]
+
+
+def test_heartbeat_liveness_and_expiry(client):
+    client.heartbeat("w0", ttl_s=10.0)
+    client.heartbeat("w1", ttl_s=0.15)
+    assert client.live() == {"w0", "w1"}
+    time.sleep(0.3)
+    assert client.live() == {"w0"}  # w1's lease expired
+    client.heartbeat("w0", ttl_s=0)  # graceful leave
+    assert client.live() == set()
+
+
+def test_rendezvous_assigns_dense_ranks(server):
+    world = 5
+    ranks = []
+    lock = threading.Lock()
+
+    def join():
+        with CoordClient("127.0.0.1", server.port) as c:
+            r = Rendezvous(c).join(round=0, world_size=world, timeout_s=10.0)
+            with lock:
+                ranks.append(r)
+
+    threads = [threading.Thread(target=join) for _ in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(ranks) == list(range(world))
+
+
+def test_elastic_monitor_detects_world_change(server):
+    from tpudist.elastic.loop import WorldChanged
+
+    c0 = CoordClient("127.0.0.1", server.port)
+    c1 = CoordClient("127.0.0.1", server.port)
+    m0 = ElasticMonitor(c0, "w0", ttl_s=0.5, interval_s=0.1)
+    m1 = ElasticMonitor(c1, "w1", ttl_s=0.5, interval_s=0.1)
+    m0.start(expected_world=2)
+    m1.start(expected_world=2)
+    time.sleep(0.2)
+    m0.check()  # both alive: no exception
+
+    m1.stop(graceful=True)  # worker 1 leaves -> membership shrinks
+    with pytest.raises(WorldChanged) as e:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            m0.check()
+            time.sleep(0.05)
+    assert e.value.new_world_size == 1
+    m0.resize(1)
+    m0.check()  # re-based expectation: healthy again
+    m0.stop()
+    c0.close()
+    c1.close()
+
+
+def test_elastic_rendezvous_restart_cycle(server):
+    """Full elastic cycle: 3 workers train, one dies, survivors detect the
+    change, re-rendezvous as a 2-world, and get fresh dense ranks."""
+    from tpudist.elastic.loop import WorldChanged
+
+    results = {}
+    lock = threading.Lock()
+
+    def worker(wid, dies):
+        c = CoordClient("127.0.0.1", server.port)
+        rdzv = Rendezvous(c)
+        mon = ElasticMonitor(c, f"w{wid}", ttl_s=0.4, interval_s=0.1)
+        rank = rdzv.join(0, 3, timeout_s=10.0)
+        mon.start(expected_world=3)
+        if dies:
+            time.sleep(0.2)
+            mon.stop(graceful=True)  # simulated preemption
+            c.close()
+            return
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                mon.check()
+                time.sleep(0.05)
+            raise AssertionError("membership change never detected")
+        except WorldChanged as e:
+            new_world = e.new_world_size
+        mon.resize(new_world)
+        new_rank = rdzv.join(1, new_world, timeout_s=10.0)
+        with lock:
+            results[wid] = (rank, new_rank, new_world)
+        mon.stop()
+        c.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i, i == 2)) for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert set(results) == {0, 1}
+    assert {r for _, r, _ in results.values()} == {0, 1}  # dense new ranks
+    assert all(w == 2 for _, _, w in results.values())

@@ -1,0 +1,126 @@
+"""Loader for the native (C++) runtime library.
+
+``native/`` holds the C++ sources for the host-side runtime components (the
+coordination/rendezvous service and the data-loader core — the TPU-native
+equivalents of the reference suite's external native dependencies: c10d
+TCPStore, Horovod's C++ elastic controller, DataLoader workers; SURVEY.md
+§2.2).  This module builds the shared library on demand (``g++`` is assumed
+present, as on any TPU VM image) and exposes it via ctypes.
+
+All callers must tolerate ``load() is None`` — every native component has a
+pure-Python fallback so the framework degrades gracefully rather than
+hard-failing on exotic hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+_NATIVE_DIR = _REPO / "native"
+_SOURCES = ("coord.cpp", "dataload.cpp")
+_LIB = _NATIVE_DIR / "build" / "libtpudist_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _stale() -> bool:
+    if not _LIB.exists():
+        return True
+    lib_mtime = _LIB.stat().st_mtime
+    return any(
+        (_NATIVE_DIR / s).exists() and (_NATIVE_DIR / s).stat().st_mtime > lib_mtime
+        for s in _SOURCES
+    )
+
+
+def _build() -> bool:
+    srcs = [str(_NATIVE_DIR / s) for s in _SOURCES if (_NATIVE_DIR / s).exists()]
+    if not srcs:
+        return False
+    _LIB.parent.mkdir(parents=True, exist_ok=True)
+    # Compile to a process-unique temp path, then atomically rename: a
+    # concurrent process must never dlopen a half-written library.
+    tmp = _LIB.with_suffix(f".so.tmp.{os.getpid()}")
+    cmd = ["g++", "-O2", "-g", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", "-o", str(tmp), *srcs]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if res.returncode != 0:
+            return False
+        os.replace(tmp, _LIB)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        tmp.unlink(missing_ok=True)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    sigs = {
+        # coord.cpp
+        "tcs_server_start": ([c.c_uint16], c.c_void_p),
+        "tcs_server_port": ([c.c_void_p], c.c_int),
+        "tcs_server_stop": ([c.c_void_p], None),
+        "tcs_connect": ([c.c_char_p, c.c_uint16, c.c_int], c.c_void_p),
+        "tcs_set": ([c.c_void_p, c.c_char_p, c.c_void_p, c.c_uint32], c.c_int),
+        "tcs_get": ([c.c_void_p, c.c_char_p, c.c_void_p, c.c_uint32,
+                     c.POINTER(c.c_uint32)], c.c_int),
+        "tcs_add": ([c.c_void_p, c.c_char_p, c.c_longlong], c.c_longlong),
+        "tcs_wait": ([c.c_void_p, c.c_char_p, c.c_int], c.c_int),
+        "tcs_barrier": ([c.c_void_p, c.c_char_p, c.c_int, c.c_int], c.c_int),
+        "tcs_heartbeat": ([c.c_void_p, c.c_char_p, c.c_int], c.c_int),
+        "tcs_live": ([c.c_void_p, c.c_char_p, c.c_uint32,
+                      c.POINTER(c.c_uint32)], c.c_int),
+        "tcs_keys": ([c.c_void_p, c.c_char_p, c.c_char_p, c.c_uint32,
+                      c.POINTER(c.c_uint32)], c.c_int),
+        "tcs_del": ([c.c_void_p, c.c_char_p], c.c_int),
+        "tcs_close": ([c.c_void_p], None),
+        # dataload.cpp
+        "tdl_pool_create": ([c.c_int], c.c_void_p),
+        "tdl_submit": ([c.c_void_p, c.c_int, c.POINTER(c.c_void_p),
+                        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+                        c.c_longlong, c.POINTER(c.c_void_p)], c.c_longlong),
+        "tdl_wait": ([c.c_void_p, c.c_longlong, c.c_int], c.c_int),
+        "tdl_pool_destroy": ([c.c_void_p], None),
+        "tdl_idx_info": ([c.c_char_p, c.POINTER(c.c_int), c.POINTER(c.c_int),
+                          c.POINTER(c.c_longlong)], c.c_int),
+        "tdl_idx_read": ([c.c_char_p, c.c_void_p, c.c_longlong], c.c_longlong),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+
+
+def load() -> ctypes.CDLL | None:
+    """Return the bound native library, building it first if needed; None if
+    the toolchain or sources are unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if _stale() and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+            _bind(lib)
+        except OSError:
+            _load_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

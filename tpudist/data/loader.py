@@ -30,6 +30,11 @@ class ShardedLoader:
         loader yields host numpy arrays (useful for tests and host-only eval).
       shuffle / seed / drop_last: sampler behavior (DistributedSampler
         semantics, see :mod:`tpudist.data.sampler`).
+      prefetch: batches to materialize ahead on the native (C++) gather pool
+        (:mod:`tpudist.data.native`), overlapping host batch assembly with
+        device compute — the DataLoader-worker/pin-memory role
+        (`mnist_ddp_elastic.py:185-189`). 0 = synchronous numpy gather;
+        silently degrades to 0 when the native library is unavailable.
     """
 
     def __init__(
@@ -41,6 +46,7 @@ class ShardedLoader:
         shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
+        prefetch: int = 0,
     ) -> None:
         n = len(arrays[0])
         for a in arrays:
@@ -62,6 +68,15 @@ class ShardedLoader:
             for s in range(self.num_shards)
         ]
         self.drop_last = drop_last
+        self._pool = None
+        if prefetch > 0:
+            from tpudist.data import native as _dnative
+
+            if _dnative.available():
+                self._pool = _dnative.GatherPool()
+                # The C++ gather computes offsets from shape, not strides.
+                self.arrays = [np.ascontiguousarray(a) for a in self.arrays]
+        self.prefetch = prefetch if self._pool is not None else 0
         self._shardings = None
         if mesh is not None:
             self._shardings = [
@@ -83,15 +98,47 @@ class ShardedLoader:
         """Yield one epoch of batches; ``epoch`` seeds the shuffle
         (the ``sampler.set_epoch`` contract, `mnist_ddp_elastic.py:84`)."""
         per_shard = [s.indices(epoch) for s in self.samplers]
-        for step in range(self.steps_per_epoch):
+
+        def batch_idx(step: int) -> np.ndarray:
             lo = step * self.local_batch
-            idx = np.concatenate([p[lo : lo + self.local_batch] for p in per_shard])
-            batch = tuple(a[idx] for a in self.arrays)
+            return np.concatenate(
+                [p[lo : lo + self.local_batch] for p in per_shard]
+            )
+
+        def emit(batch: tuple) -> tuple:
             if self._shardings is not None:
                 batch = tuple(
                     jax.device_put(b, s) for b, s in zip(batch, self._shardings)
                 )
-            yield batch
+            return batch
+
+        steps = self.steps_per_epoch
+        if self._pool is None:
+            for step in range(steps):
+                yield emit(tuple(a[batch_idx(step)] for a in self.arrays))
+            return
+
+        # Native path: keep `prefetch` gather jobs in flight on the C++ pool.
+        def submit(step: int) -> int:
+            idx = batch_idx(step)
+            out = [np.empty((len(idx),) + a.shape[1:], a.dtype) for a in self.arrays]
+            return self._pool.submit(self.arrays, idx, out)
+
+        jobs = [submit(s) for s in range(min(self.prefetch, steps))]
+        try:
+            for step in range(steps):
+                ahead = step + self.prefetch
+                if ahead < steps:
+                    jobs.append(submit(ahead))
+                yield emit(tuple(self._pool.wait(jobs.pop(0))))
+        finally:
+            # Abandoned epoch (break / exception): reap in-flight jobs so
+            # neither Python buffers nor C++ job objects leak.
+            for job in jobs:
+                try:
+                    self._pool.wait(job)
+                except Exception:
+                    pass
 
     def __iter__(self) -> Iterator[tuple]:
         return self.epoch(0)

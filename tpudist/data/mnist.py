@@ -54,6 +54,12 @@ class Dataset:
 
 
 def _read_idx(path: Path) -> np.ndarray:
+    if path.suffix != ".gz":  # raw files: native C++ parser when available
+        from tpudist.data.native import read_idx_native
+
+        arr = read_idx_native(path)
+        if arr is not None:
+            return arr
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:
         zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))

@@ -1,0 +1,292 @@
+"""Rendezvous / coordination over the native (C++) store.
+
+The control plane the reference delegates to external native libraries —
+c10d TCPStore + torchrun rendezvous (`pytorch_elastic/mnist_ddp_elastic.py:5-6`)
+and Horovod's C++ elastic controller with host discovery / blacklisting
+(`horovod/horovod_mnist_elastic.py:108`) — re-built TPU-native: a small C++
+TCP service (``native/coord.cpp``, loaded via :mod:`tpudist._native`)
+offering a key-value store, blocking waits, named barriers, atomic counters,
+and TTL heartbeats.  On top of it:
+
+* :class:`CoordServer` / :class:`CoordClient` — thin ctypes handles.
+* :class:`Rendezvous` — round-based worker assembly: every participant gets
+  a dense rank and the round releases only when ``world_size`` workers have
+  arrived (the c10d rendezvous contract).
+* :class:`ElasticMonitor` — heartbeat publisher + liveness probe; its
+  :meth:`check` raises :class:`~tpudist.elastic.loop.WorldChanged` when
+  membership shifts, which :func:`~tpudist.elastic.loop.elastic_run` turns
+  into rollback + re-rendezvous (Horovod-elastic semantics).
+
+Only control-plane metadata moves through this service; tensors ride ICI via
+XLA collectives (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from tpudist import _native
+
+_VALUE_CAP = 1 << 20
+
+
+class NativeUnavailable(RuntimeError):
+    """The native coordination library could not be built/loaded."""
+
+
+def _lib():
+    lib = _native.load()
+    if lib is None:
+        raise NativeUnavailable(
+            "libtpudist_native.so unavailable (no g++ or build failed)"
+        )
+    return lib
+
+
+class CoordServer:
+    """In-process coordination server; run one per job (usually on the
+    coordinator host, or standalone via ``python -m tpudist.runtime.coord``)."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._lib = _lib()
+        self._h = self._lib.tcs_server_start(port)
+        if not self._h:
+            raise OSError(f"could not bind coordination server on port {port}")
+        self.port: int = self._lib.tcs_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.tcs_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self) -> "CoordServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class CoordClient:
+    """Client connection to a :class:`CoordServer` (possibly on another host;
+    numeric IPs and hostnames both resolve)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_ms: int = 10_000) -> None:
+        self._lib = _lib()
+        self.host, self.port, self._timeout_ms = host, port, timeout_ms
+        self._h = self._lib.tcs_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise ConnectionError(f"could not reach coordination server {host}:{port}")
+
+    def clone(self) -> "CoordClient":
+        """A fresh connection to the same server (one request is in flight
+        per connection, so background threads need their own)."""
+        return CoordClient(self.host, self.port, self._timeout_ms)
+
+    # -- kv ----------------------------------------------------------------
+    def set(self, key: str, value: bytes | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._lib.tcs_set(self._h, key.encode(), value, len(value)) != 0:
+            raise ConnectionError("set failed")
+
+    def get(self, key: str) -> bytes | None:
+        cap = _VALUE_CAP
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            out_len = ctypes.c_uint32()
+            rc = self._lib.tcs_get(self._h, key.encode(), buf, cap,
+                                   ctypes.byref(out_len))
+            if rc == 1:
+                return None
+            if rc == 2:  # buffer too small; out_len holds the needed size
+                cap = out_len.value
+                continue
+            if rc != 0:
+                raise ConnectionError("get failed")
+            return buf.raw[: out_len.value]
+
+    def add(self, key: str, delta: int) -> int:
+        v = self._lib.tcs_add(self._h, key.encode(), delta)
+        if v == -(2**63):
+            raise ConnectionError("add failed")
+        return int(v)
+
+    def wait(self, key: str, timeout_s: float = 30.0) -> bool:
+        rc = self._lib.tcs_wait(self._h, key.encode(), int(timeout_s * 1000))
+        if rc < 0:
+            raise ConnectionError("wait failed")
+        return rc == 0
+
+    def delete(self, key: str) -> None:
+        if self._lib.tcs_del(self._h, key.encode()) != 0:
+            raise ConnectionError("del failed")
+
+    def keys(self, prefix: str = "") -> list[str]:
+        joined = self._joined(
+            lambda buf, cap, out: self._lib.tcs_keys(
+                self._h, prefix.encode(), buf, cap, out)
+        )
+        return joined.split(",") if joined else []
+
+    def _joined(self, call) -> str:
+        cap = _VALUE_CAP
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            out_len = ctypes.c_uint32()
+            rc = call(buf, cap, ctypes.byref(out_len))
+            if rc == 2:
+                cap = out_len.value
+                continue
+            if rc != 0:
+                raise ConnectionError("query failed")
+            return buf.raw[: out_len.value].decode()
+
+    # -- synchronization ---------------------------------------------------
+    def barrier(self, name: str, count: int, timeout_s: float = 60.0) -> bool:
+        """Block until ``count`` participants arrive at ``name``.  Returns
+        False on timeout (the arrival is withdrawn server-side)."""
+        rc = self._lib.tcs_barrier(self._h, name.encode(), count,
+                                   int(timeout_s * 1000))
+        if rc < 0:
+            raise ConnectionError("barrier failed")
+        return rc == 0
+
+    # -- liveness ----------------------------------------------------------
+    def heartbeat(self, worker: str, ttl_s: float) -> None:
+        """Refresh ``worker``'s liveness lease; ``ttl_s <= 0`` leaves."""
+        if self._lib.tcs_heartbeat(self._h, worker.encode(),
+                                   int(ttl_s * 1000)) != 0:
+            raise ConnectionError("heartbeat failed")
+
+    def live(self) -> set[str]:
+        joined = self._joined(
+            lambda buf, cap, out: self._lib.tcs_live(self._h, buf, cap, out)
+        )
+        return set(joined.split(",")) if joined else set()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tcs_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "CoordClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class Rendezvous:
+    """Round-based rendezvous: dense rank assignment + all-arrived barrier.
+
+    Each elastic restart is a new ``round``; all surviving/new workers call
+    :meth:`join` with the same round number and world size, get back a dense
+    rank in ``[0, world)``, and return together (the torchrun
+    restart-on-membership-change contract, SURVEY.md §5)."""
+
+    def __init__(self, client: CoordClient, namespace: str = "rdzv") -> None:
+        self.client = client
+        self.ns = namespace
+
+    def join(self, round: int, world_size: int, timeout_s: float = 60.0) -> int:
+        rank = self.client.add(f"{self.ns}/{round}/rank", 1) - 1
+        if rank >= world_size:
+            raise RuntimeError(
+                f"rendezvous round {round} overflow: rank {rank} >= world {world_size}"
+            )
+        if not self.client.barrier(f"{self.ns}/{round}/barrier", world_size,
+                                   timeout_s):
+            raise TimeoutError(
+                f"rendezvous round {round}: {world_size} workers did not arrive"
+            )
+        return rank
+
+
+class ElasticMonitor:
+    """Heartbeat publisher + membership watcher for one worker.
+
+    A daemon thread refreshes this worker's TTL lease over its OWN
+    connection — the caller's connection may sit inside a long blocking
+    barrier (rendezvous for a slow-restarting peer), which must not starve
+    heartbeats past the TTL.  :meth:`check` (called at commit points, the
+    moral twin of Horovod's per-batch membership poll) raises
+    :class:`WorldChanged` when the live set no longer matches the expected
+    world."""
+
+    def __init__(
+        self,
+        client: CoordClient,
+        worker_id: str,
+        ttl_s: float = 3.0,
+        interval_s: float = 1.0,
+    ) -> None:
+        self.client = client
+        self.worker_id = worker_id
+        self.ttl_s = ttl_s
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._beat_client: CoordClient | None = None
+        self.expected_world: int | None = None
+
+    def start(self, expected_world: int) -> None:
+        self.expected_world = expected_world
+        self._beat_client = self.client.clone()
+        self._beat_client.heartbeat(self.worker_id, self.ttl_s)
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._beat_client.heartbeat(self.worker_id, self.ttl_s)
+            except ConnectionError:
+                return
+
+    def check(self) -> None:
+        """Raise ``WorldChanged(new_size)`` if membership shifted."""
+        from tpudist.elastic.loop import WorldChanged
+
+        live = self.client.live()
+        if self.expected_world is not None and len(live) != self.expected_world:
+            raise WorldChanged(len(live))
+
+    def resize(self, new_world: int) -> None:
+        self.expected_world = new_world
+
+    def stop(self, graceful: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if graceful:
+            try:
+                self.client.heartbeat(self.worker_id, 0)  # leave
+            except ConnectionError:
+                pass
+        if self._beat_client is not None:
+            self._beat_client.close()
+            self._beat_client = None
+
+
+def main() -> None:  # pragma: no cover - CLI utility
+    """Run a standalone coordination server: ``python -m tpudist.runtime.coord``."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(description="tpudist coordination server")
+    ap.add_argument("--port", type=int, default=29400)
+    args = ap.parse_args()
+    server = CoordServer(args.port)
+    print(f"tpudist coordination server listening on :{server.port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    while not stop.wait(1.0):
+        pass
+    server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
