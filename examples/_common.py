@@ -16,6 +16,10 @@ import os
 import sys
 from typing import Sequence
 
+# Examples are runnable from anywhere: `python examples/foo_tpu.py` puts only
+# examples/ on sys.path, so add the repo root for the tpudist package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def setup_platform(argv: Sequence[str] | None = None) -> list[str]:
     """Consume ``--sim-devices N`` from ``argv`` (before jax import).
@@ -25,12 +29,19 @@ def setup_platform(argv: Sequence[str] | None = None) -> list[str]:
     present) is used.
     """
     argv = list(sys.argv[1:] if argv is None else argv)
+    sim = False
     if "--sim-devices" in argv:
         i = argv.index("--sim-devices")
         n = int(argv[i + 1])
         del argv[i : i + 2]
         if n > 0:
+            sim = True
             from tpudist.runtime.simulate import force_cpu_devices
 
             force_cpu_devices(n)
+    if not sim:
+        # Real backends pay multi-minute first compiles; cache persistently.
+        from tpudist.runtime.cache import enable_compilation_cache
+
+        enable_compilation_cache()
     return argv

@@ -58,3 +58,14 @@ def test_trainer_resumes_from_snapshot(tmp_path):
     # training further continues from epoch 2
     resumed.train(max_epochs=3)
     assert resumed.epochs_run == 3
+
+
+def test_trainer_profile_dir_writes_trace(tmp_path):
+    """profile_dir captures a jax.profiler trace of the first trained epoch
+    (SURVEY.md §5: tracing the reference never had)."""
+    trainer, _ = _make_trainer(tmp_path, epochs=1, n=128)
+    trace_dir = tmp_path / "trace"
+    trainer.config.profile_dir = str(trace_dir)
+    trainer.config.eval_every_epoch = False
+    trainer.train()
+    assert any(p.is_file() for p in trace_dir.rglob("*")), "no trace files written"

@@ -1,0 +1,110 @@
+"""Worker for the end-to-end elastic test: a 2-process DP training job that
+checkpoints, gets killed mid-run, and resumes across a gang restart — the
+TorchElastic lifecycle (`mnist_ddp_elastic.py:5-6` + snapshot/resume
+`:54-68`) over real process boundaries.
+
+Each gang attempt:
+* joins the world via the launcher's TPUDIST_* env (jax.distributed),
+* restores the newest durable checkpoint (fresh start if none),
+* trains to TOTAL_STEPS, rank 0 checkpointing every CKPT_EVERY steps,
+* on attempt 0, rank 1 exits(7) at FAIL_AT_STEP — the launcher tears the
+  gang down and restarts it; attempt 1 must resume from the last commit,
+  not from scratch.
+"""
+
+import os
+import sys
+
+from tpudist.runtime.simulate import force_cpu_devices
+
+force_cpu_devices(1)  # launcher's XLA_FLAGS already fix the device count
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tpudist.elastic.checkpoint import Checkpointer  # noqa: E402
+from tpudist.models import MLP  # noqa: E402
+from tpudist.ops.losses import cross_entropy  # noqa: E402
+from tpudist.parallel.data_parallel import (  # noqa: E402
+    broadcast_params,
+    make_dp_train_step,
+)
+from tpudist.runtime import distributed  # noqa: E402
+from tpudist.train.state import TrainState  # noqa: E402
+
+TOTAL_STEPS = 20
+CKPT_EVERY = 5
+FAIL_AT_STEP = 12
+
+
+def batch_for(step: int, mesh: Mesh, ctx):
+    """Deterministic per-step global batch, assembled from per-process
+    shards (the DistributedSampler contract: same epoch seed everywhere,
+    disjoint slices per rank)."""
+    rng = np.random.default_rng(1000 + step)
+    gx = rng.standard_normal((8, 28 * 28)).astype(np.float32)
+    gy = rng.integers(0, 10, 8)
+    n = ctx.process_count
+    lo = ctx.process_index * (8 // n)
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), gx[lo : lo + 8 // n], (8, 28 * 28)
+    )
+    y = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), gy[lo : lo + 8 // n], (8,)
+    )
+    return x, y
+
+
+def main() -> int:
+    ctx = distributed.initialize()
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    attempt = int(os.environ["TPUDIST_RESTART_ATTEMPT"])
+
+    model = MLP(hidden_layers=1, features=32)
+    params = model.init(jax.random.key(0), np.zeros((1, 28 * 28), np.float32))["params"]
+
+    def loss_fn(p, batch, rng):
+        x, y = batch
+        return cross_entropy(model.apply({"params": p}, x), y), {}
+
+    state = TrainState.create(
+        model.apply, broadcast_params(params, mesh), optax.sgd(0.1), rng=0
+    )
+    ckpt = Checkpointer(os.environ["WORKER_CKPT_DIR"], keep=2)
+    template = {"params": state.params, "opt_state": state.opt_state}
+    restored = ckpt.restore_latest(template)
+    start_step = 0
+    if restored is not None:
+        start_step, tree, _meta = restored
+        state = state.replace(
+            params=broadcast_params(tree["params"], mesh),
+            opt_state=broadcast_params(tree["opt_state"], mesh),
+        )
+    if ctx.process_index == 0:
+        with open(os.path.join(os.environ["WORKER_CKPT_DIR"],
+                               f"start_attempt{attempt}.txt"), "w") as fh:
+            fh.write(str(start_step))
+
+    step_fn = make_dp_train_step(loss_fn, mesh, donate=False)
+    for step in range(start_step, TOTAL_STEPS):
+        state, metrics = step_fn(state, *batch_for(step, mesh, ctx))
+        done = step + 1
+        if done % CKPT_EVERY == 0 and ctx.process_index == 0:
+            ckpt.save(done, {"params": state.params, "opt_state": state.opt_state})
+        if (attempt == 0 and done == FAIL_AT_STEP
+                and os.environ.get("WORKER_INJECT_FAILURE") == "1"
+                and ctx.process_index == 1):
+            print("rank 1 simulating preemption", flush=True)
+            return 7
+
+    if ctx.process_index == 0:
+        loss = float(jax.device_get(metrics["loss"]))
+        with open(os.path.join(os.environ["WORKER_CKPT_DIR"], "final.txt"), "w") as fh:
+            fh.write(f"{TOTAL_STEPS} {loss}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Worker script for launcher tests: join the multi-process world spawned by
+``tpudist.runtime.launch``, run one cross-process ``psum``, verify it, and
+report success via exit code — the smallest real multi-host program.
+
+Standalone (not collected by pytest): runs in a fresh interpreter per
+worker, so it does its own platform forcing (the ambient environment may
+register a real TPU backend; workers must stay on simulated CPU devices).
+"""
+
+import os
+import sys
+
+from tpudist.runtime.simulate import force_cpu_devices
+
+force_cpu_devices(1)  # launcher's XLA_FLAGS already fix the device count
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tpudist.runtime import distributed  # noqa: E402
+
+
+def main() -> int:
+    ctx = distributed.initialize()  # reads the TPUDIST_* launcher env
+    nprocs = int(os.environ["TPUDIST_NUM_PROCESSES"])
+    assert ctx.process_count == nprocs, (ctx, nprocs)
+    assert ctx.global_device_count == nprocs * ctx.local_device_count
+
+    # One global psum: every process contributes (rank + 1); the total must
+    # be identical everywhere — the DDP gradient-allreduce shape.
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    f = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                              in_specs=P("data"), out_specs=P()))
+    local = np.full((ctx.local_device_count,), ctx.process_index + 1, np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, (ctx.global_device_count,)
+    )
+    total = float(jax.device_get(f(arr).addressable_data(0)))
+    expected = ctx.local_device_count * nprocs * (nprocs + 1) / 2
+    assert total == expected, (total, expected)
+
+    # Optional: fail on the first gang attempt to exercise restart logic.
+    if os.environ.get("WORKER_FAIL_ON_ATTEMPT") == os.environ.get(
+            "TPUDIST_RESTART_ATTEMPT") and ctx.process_index == 0:
+        print("worker 0 injecting failure", flush=True)
+        return 17
+
+    out_dir = os.environ.get("WORKER_OUT_DIR")
+    if out_dir:
+        with open(os.path.join(out_dir, f"rank{ctx.process_index}.txt"), "w") as fh:
+            fh.write(f"{total}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

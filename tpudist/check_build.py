@@ -1,0 +1,141 @@
+"""Installation sanity check — the ``horovodrun --check-build`` twin.
+
+The reference's build environment proves itself with ``horovodrun
+--check-build`` plus per-framework import checks (`horovod/Dockerfile:79-85`).
+``python -m tpudist.check_build`` is the equivalent for this framework: it
+reports which subsystems are actually usable in this environment and exits
+non-zero if a required one is broken.
+
+Checked, in dependency order:
+* jax + backend (platform, device count),
+* the compute stack: one jitted ``psum`` over every local device,
+* pallas (TPU kernels; reported, optional on CPU hosts),
+* the native (C++) runtime library: builds/loads, coordination server
+  round-trip, threaded gather round-trip,
+* multi-host bootstrap configuration (reported only).
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+
+def _check(name: str, fn, required: bool, results: list) -> None:
+    try:
+        detail = fn()
+        results.append((name, True, detail or "ok", required))
+    except Exception as e:  # noqa: BLE001 - report, don't crash
+        tb = traceback.format_exc().strip().rsplit("\n", 1)[-1]
+        results.append((name, False, f"{type(e).__name__}: {e or tb}", required))
+
+
+def _jax_backend() -> str:
+    import jax
+
+    devs = jax.devices()
+    return f"{len(devs)} x {devs[0].platform} ({jax.__version__})"
+
+
+def _collectives() -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    f = jax.shard_map(
+        lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(),
+    )
+    x = jnp.ones((len(devs),), jnp.float32)
+    got = jax.jit(f)(jax.device_put(x, NamedSharding(mesh, P("data"))))
+    assert float(got[0]) == len(devs)
+    return f"psum over {len(devs)} devices"
+
+
+def _pallas() -> str:
+    from jax.experimental import pallas  # noqa: F401
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        return f"importable (kernels need TPU; backend is {platform})"
+    from tpudist.ops.flash_attention import flash_attention  # noqa: F401
+
+    return "importable, TPU backend present"
+
+
+def _native_lib() -> str:
+    from tpudist import _native
+
+    lib = _native.load()
+    if lib is None:
+        raise RuntimeError("libtpudist_native.so failed to build/load")
+    return "built + loaded"
+
+
+def _native_coord() -> str:
+    from tpudist.runtime.coord import CoordClient, CoordServer
+
+    with CoordServer(0) as s, CoordClient("127.0.0.1", s.port) as c:
+        c.set("k", b"v")
+        assert c.get("k") == b"v"
+        assert c.add("n", 2) == 2
+    return "kv round-trip on localhost"
+
+
+def _native_gather() -> str:
+    import numpy as np
+
+    from tpudist.data.native import GatherPool
+
+    pool = GatherPool(2)
+    try:
+        data = np.arange(100, dtype=np.float32).reshape(20, 5)
+        idx = np.asarray([3, 1, 4, 1, 5])
+        (got,) = pool.gather([data], idx)
+        np.testing.assert_array_equal(got, data[idx])
+    finally:
+        pool.close()
+    return "threaded gather round-trip"
+
+
+def _multihost() -> str:
+    from tpudist.runtime.distributed import world_info
+
+    w = world_info()
+    return (f"process {w.process_index}/{w.process_count}, "
+            f"{w.local_device_count} local / {w.global_device_count} global devices")
+
+
+def main(argv: list[str] | None = None) -> int:
+    del argv
+    results: list = []
+    _check("jax backend", _jax_backend, True, results)
+    _check("XLA collectives", _collectives, True, results)
+    _check("pallas", _pallas, False, results)
+    _check("native library", _native_lib, False, results)
+    if any(n == "native library" and ok for n, ok, *_ in results):
+        _check("native coordination service", _native_coord, False, results)
+        _check("native data loader", _native_gather, False, results)
+    _check("multi-host bootstrap", _multihost, False, results)
+
+    width = max(len(n) for n, *_ in results)
+    failed_required = False
+    for name, ok, detail, required in results:
+        mark = "OK  " if ok else ("FAIL" if required else "WARN")
+        print(f"[{mark}] {name:<{width}}  {detail}")
+        failed_required |= required and not ok
+    print()
+    if failed_required:
+        print("tpudist check-build: FAILED (required subsystem broken)")
+        return 1
+    print("tpudist check-build: all required subsystems available")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

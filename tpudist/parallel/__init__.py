@@ -12,6 +12,7 @@ counterpart here because ``jax.grad`` + optax work through shardings natively
 from tpudist.parallel.data_parallel import (
     broadcast_params,
     make_dp_eval_step,
+    make_dp_train_loop,
     make_dp_train_step,
 )
 from tpudist.parallel.pipeline import (
@@ -54,6 +55,7 @@ __all__ = [
     "spec_tree_from_rules",
     "transformer_tp_rules",
     "make_dp_eval_step",
+    "make_dp_train_loop",
     "make_dp_train_step",
     "make_pipeline_forward",
     "make_pipeline_train_step",

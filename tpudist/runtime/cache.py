@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache.
+
+First-compile latency on a real TPU backend can reach minutes for scanned
+train loops (conv nets under ``lax.scan``); a persistent on-disk cache makes
+every subsequent process start warm.  The reference has no analog (eager
+PyTorch compiles nothing); for tpudist the cache is what keeps the
+compile-once-run-everywhere contract cheap across process restarts — which
+elastic training does constantly (SURVEY.md §5 "failure detection":
+recovery = process restart + re-jit).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/tpudist_xla")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    Honors ``TPUDIST_CACHE_DIR``; pass ``cache_dir`` to override.  Returns
+    the directory in use.
+    """
+    cache_dir = (cache_dir or os.environ.get("TPUDIST_CACHE_DIR")
+                 or DEFAULT_CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache everything that took meaningful compile time; the default
+    # threshold (1s) skips tiny programs that are cheap to rebuild.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

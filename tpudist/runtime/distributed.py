@@ -47,6 +47,16 @@ _CLUSTER_ENV_HINTS = (
 )
 
 
+def _launcher_env() -> tuple[str, int, int] | None:
+    """World description exported by :mod:`tpudist.runtime.launch` (the
+    RANK/WORLD_SIZE env contract, `mnist_ddp_elastic.py:44-45`)."""
+    addr = os.environ.get("TPUDIST_COORDINATOR")
+    if not addr:
+        return None
+    return (addr, int(os.environ["TPUDIST_NUM_PROCESSES"]),
+            int(os.environ["TPUDIST_PROCESS_ID"]))
+
+
 def _detected_multihost() -> bool:
     """True only for an actual multi-host topology: a coordinator address,
     or a TPU worker list naming more than one host (a single-entry
@@ -74,6 +84,9 @@ def initialize(
     (`mnist_ddp_elastic.py:26`).
     """
     global _initialized
+    launcher = _launcher_env()
+    if launcher is not None and coordinator_address is None:
+        coordinator_address, num_processes, process_id = launcher
     explicit = coordinator_address is not None or num_processes is not None
     detected = _detected_multihost()
     if not _initialized and (explicit or detected):

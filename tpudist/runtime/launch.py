@@ -1,0 +1,181 @@
+"""Multi-process launcher — the torchrun / horovodrun / ``mp.spawn`` twin.
+
+The reference boots its worlds three ways (SURVEY.md §1 L5): ``torchrun``
+with c10d rendezvous + restart-on-failure (`mnist_ddp_elastic.py:5-6`),
+``horovodrun`` over MPI (`horovod_mnist_elastic.py:108`), and in-process
+``torch.multiprocessing.spawn`` (`model_parallel_ResNet50.py:257-260`).  On
+TPU pods the platform launches one process per host, so in production none
+of this is needed — but the *capability* (spawn an N-process world on one
+machine, supervise it, restart the gang on failure) is what makes
+multi-host code testable without a pod.  ``python -m tpudist.runtime.launch``
+provides it:
+
+* spawns ``-n`` worker processes, each with ``TPUDIST_COORDINATOR`` /
+  ``TPUDIST_NUM_PROCESSES`` / ``TPUDIST_PROCESS_ID`` env vars that
+  :func:`tpudist.runtime.distributed.initialize` consumes (the
+  RANK/WORLD_SIZE contract, `mnist_ddp_elastic.py:44-45`),
+* starts the native coordination service and exports ``TPUDIST_COORD_ADDR``
+  so workers can rendezvous / heartbeat through it,
+* supervises the gang: if any worker dies, the rest are terminated and the
+  whole gang restarts (TorchElastic semantics), up to ``--max-restarts``.
+
+Workers default to the CPU backend (each process owns a slice of a
+simulated world) — real TPU jobs don't go through this launcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from tpudist.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(
+    cmd: list[str],
+    nprocs: int,
+    max_restarts: int = 0,
+    env: dict | None = None,
+    platform: str = "cpu",
+    devices_per_proc: int = 1,
+    coord_server: bool = True,
+) -> int:
+    """Run ``cmd`` as an ``nprocs``-process gang; returns the gang's exit
+    code (0 only if every worker of some attempt exited 0)."""
+    server = None
+    base_env = dict(os.environ)
+    # Workers must resolve the same tpudist the launcher runs from, however
+    # the launcher itself was put on sys.path (pytest rootdir, pip -e, ...).
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [pkg_root] + ([base_env["PYTHONPATH"]] if base_env.get("PYTHONPATH") else [])
+    )
+    if env:
+        base_env.update(env)
+    if coord_server:
+        try:
+            from tpudist.runtime.coord import CoordServer
+
+            server = CoordServer(0)
+            base_env["TPUDIST_COORD_ADDR"] = f"127.0.0.1:{server.port}"
+        except Exception as e:  # noqa: BLE001 - control plane is optional
+            log.warning("coordination server unavailable (%s); continuing", e)
+
+    try:
+        for attempt in range(max_restarts + 1):
+            coordinator = f"127.0.0.1:{_free_port()}"
+            procs: list[subprocess.Popen] = []
+            for rank in range(nprocs):
+                wenv = dict(base_env)
+                wenv.update({
+                    "TPUDIST_COORDINATOR": coordinator,
+                    "TPUDIST_NUM_PROCESSES": str(nprocs),
+                    "TPUDIST_PROCESS_ID": str(rank),
+                    "TPUDIST_LOCAL_RANK": str(rank),
+                    "TPUDIST_RESTART_ATTEMPT": str(attempt),
+                })
+                if platform:
+                    wenv["JAX_PLATFORMS"] = platform
+                    if platform == "cpu":
+                        # Each worker owns exactly devices_per_proc simulated
+                        # devices; drop any inherited host-device-count flag.
+                        flags = [f for f in wenv.get("XLA_FLAGS", "").split()
+                                 if not f.startswith(
+                                     "--xla_force_host_platform_device_count")]
+                        flags.append("--xla_force_host_platform_device_count"
+                                     f"={devices_per_proc}")
+                        wenv["XLA_FLAGS"] = " ".join(flags)
+                procs.append(subprocess.Popen(cmd, env=wenv))
+            codes = _supervise(procs)
+            if all(c == 0 for c in codes):
+                return 0
+            log.warning(
+                "gang attempt %d failed (exit codes %s)%s", attempt, codes,
+                "; restarting" if attempt < max_restarts else "",
+            )
+        # Survivors torn down by _supervise exit with the termination
+        # signal; report the code of the worker that actually failed.
+        failing = [c for c in codes
+                   if c not in (0, -signal.SIGTERM, -signal.SIGKILL)]
+        return failing[0] if failing else next(c for c in codes if c != 0)
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def _supervise(procs: list[subprocess.Popen]) -> list[int]:
+    """Wait for the gang; on first failure, terminate the survivors (the
+    torchrun gang-failure contract)."""
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                return codes  # type: ignore[return-value]
+            if any(c not in (None, 0) for c in codes):
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                deadline = time.monotonic() + 10
+                for p in procs:
+                    timeout = max(0.1, deadline - time.monotonic())
+                    try:
+                        p.wait(timeout=timeout)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                return [p.returncode for p in procs]
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        raise
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpudist.runtime.launch",
+        description="Spawn and supervise an N-process tpudist world on this host",
+    )
+    ap.add_argument("-n", "--nprocs", type=int, required=True)
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="gang restarts on worker failure (torchrun semantics)")
+    ap.add_argument("--platform", default="cpu",
+                    help="JAX_PLATFORMS for workers ('' = inherit)")
+    ap.add_argument("--devices-per-proc", type=int, default=1,
+                    help="simulated CPU devices per worker")
+    ap.add_argument("--no-coord", action="store_true",
+                    help="skip the native coordination server")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command, e.g. script.py arg1 arg2")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("missing worker command")
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable, *cmd]
+    return launch(
+        cmd, args.nprocs, max_restarts=args.max_restarts,
+        platform=args.platform, devices_per_proc=args.devices_per_proc,
+        coord_server=not args.no_coord,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,7 @@ from tpudist.parallel.data_parallel import (
 from tpudist.train.state import TrainState
 from tpudist.utils.config import config_field
 from tpudist.utils.logging import get_logger
-from tpudist.utils.metrics import MetricLogger, ThroughputMeter
+from tpudist.utils.metrics import MetricLogger, ThroughputMeter, maybe_profile
 
 log = get_logger(__name__)
 
@@ -50,6 +50,9 @@ class TrainerConfig:
     snapshot_path: str = config_field("snapshot.npz", "snapshot file")
     log_every: int = config_field(50, "log every N steps")
     eval_every_epoch: bool = config_field(True, "run test() after every epoch")
+    profile_dir: str = config_field(
+        "", "write a jax.profiler trace of epoch 0 here (XProf/TensorBoard)"
+    )
 
 
 class Trainer:
@@ -157,8 +160,11 @@ class Trainer:
     def train(self, max_epochs: int | None = None) -> dict:
         max_epochs = max_epochs or self.config.total_epochs
         summary: dict = {}
-        for epoch in range(self.epochs_run, max_epochs):
-            epoch_metrics = self._run_epoch(epoch)
+        start_epoch = self.epochs_run
+        for epoch in range(start_epoch, max_epochs):
+            profiling = self.config.profile_dir and epoch == start_epoch
+            with maybe_profile(self.config.profile_dir if profiling else None):
+                epoch_metrics = self._run_epoch(epoch)
             summary = {"epoch": epoch, **epoch_metrics}
             if self.config.eval_every_epoch and self.test_loader is not None:
                 summary["test_accuracy"] = self.test()
