@@ -1,0 +1,111 @@
+"""FSDP/ZeRO-3: params+optimizer state sharded over the data axis, same
+numerics as plain DP (SURVEY.md §2.3 'FSDP — NO' → deliberately exceeded)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from tpudist.models import MLP, TransformerConfig, TransformerLM
+from tpudist.ops.losses import cross_entropy
+from tpudist.parallel.data_parallel import broadcast_params, make_dp_train_step
+from tpudist.parallel.fsdp import fsdp_specs, make_fsdp_state, make_fsdp_train_step
+from tpudist.parallel.tensor_parallel import shard_batch, transformer_tp_rules
+from tpudist.runtime.mesh import data_mesh, data_model_mesh
+from tpudist.train.state import TrainState
+
+
+def _mlp_setup():
+    model = MLP(hidden_layers=2, features=64)
+    x = np.random.default_rng(0).standard_normal((32, 784)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, (32,))
+    params = model.init(jax.random.key(0), x[:1])["params"]
+
+    def loss_fn(p, batch, rng):
+        bx, by = batch
+        return cross_entropy(model.apply({"params": p}, bx), by), {}
+
+    return model, params, loss_fn, x, y
+
+
+def test_fsdp_specs_shard_every_divisible_leaf():
+    mesh = data_mesh(8)
+    _, params, _, _, _ = _mlp_setup()
+    specs = fsdp_specs(params, mesh)
+    # every leaf with a dim divisible by 8 is sharded; the rest replicate
+    for path, spec in jax.tree_util.tree_flatten_with_path(specs)[0]:
+        leaf = params
+        for k in path:
+            leaf = leaf[k.key]
+        name = jax.tree_util.keystr(path)
+        if any(d % 8 == 0 and d >= 8 for d in leaf.shape):
+            assert "data" in tuple(spec), (name, spec, leaf.shape)
+        else:
+            assert tuple(spec) == () or all(s is None for s in spec), (name, spec)
+
+
+def test_fsdp_matches_dp_numerics():
+    mesh = data_mesh(8)
+    model, params, loss_fn, x, y = _mlp_setup()
+
+    dp_state = TrainState.create(
+        model.apply, broadcast_params(params, mesh), optax.adam(1e-3))
+    dp_step = make_dp_train_step(loss_fn, mesh, donate=False)
+    dp_state, dp_metrics = dp_step(dp_state, jnp.asarray(x), jnp.asarray(y))
+
+    fsdp_state, specs = make_fsdp_state(
+        model.apply, params, optax.adam(1e-3), mesh)
+    step = make_fsdp_train_step(loss_fn, mesh, specs, donate=False)
+    batch = shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+    fsdp_state, metrics = step(fsdp_state, *batch)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(dp_metrics["loss"]), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+        fsdp_state.params, dp_state.params)
+
+
+def test_fsdp_actually_shards_params_and_opt_state():
+    mesh = data_mesh(8)
+    model, params, loss_fn, _, _ = _mlp_setup()
+    state, specs = make_fsdp_state(model.apply, params, optax.adam(1e-3), mesh)
+
+    kernel = state.params["Dense_0"]["kernel"]  # [784, 64] → largest dim sharded
+    assert kernel.addressable_shards[0].data.shape[0] == kernel.shape[0] // 8
+    # Adam moments inherit the sharding (ZeRO: optimizer state sharded too)
+    mu_kernel = state.opt_state[0].mu["Dense_0"]["kernel"]
+    assert mu_kernel.addressable_shards[0].data.shape[0] == kernel.shape[0] // 8
+    nu_kernel = state.opt_state[0].nu["Dense_0"]["kernel"]
+    assert nu_kernel.addressable_shards[0].data.shape[0] == kernel.shape[0] // 8
+
+
+def test_fsdp_composes_with_tp_rules():
+    mesh = data_model_mesh(model=2, n=8)  # 4-way fsdp × 2-way tp
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                            embed_dim=32, max_seq_len=16)
+    model = TransformerLM(cfg)
+    tokens = np.zeros((4, 16), np.int32)
+    params = model.init(jax.random.key(0), jnp.asarray(tokens))["params"]
+    specs = fsdp_specs(params, mesh, axis="data",
+                       tp_rules=transformer_tp_rules("model"))
+    qkv = specs["block0"]["attn"]["qkv"]["kernel"]
+    assert "model" in tuple(qkv) and "data" in tuple(qkv), qkv
+    # and the model still runs one step under the combined layout
+    from tpudist.parallel.tensor_parallel import shard_tree
+
+    sharded = shard_tree(params, mesh, specs)
+    state = TrainState.create(model.apply, sharded, optax.sgd(0.1))
+
+    def loss_fn(p, batch, rng):
+        (toks,) = batch
+        logits = model.apply({"params": p}, toks)
+        return cross_entropy(
+            logits[:, :-1].reshape(-1, cfg.vocab_size),
+            toks[:, 1:].reshape(-1)), {}
+
+    step = make_fsdp_train_step(loss_fn, mesh, specs, donate=False)
+    state, metrics = step(state, shard_batch(jnp.asarray(tokens), mesh))
+    assert np.isfinite(float(metrics["loss"]))

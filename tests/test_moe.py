@@ -1,0 +1,140 @@
+"""Mixture-of-Experts + expert parallelism (SURVEY.md §2.3 'EP — NO' →
+deliberately exceeded): routing correctness against a dense reference,
+capacity semantics, and the DP×EP sharded train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from tpudist.models import MoEConfig, MoETransformerLM, TransformerConfig
+from tpudist.models.moe import MoEMLP
+from tpudist.ops.losses import cross_entropy
+from tpudist.parallel.expert_parallel import (
+    make_ep_state,
+    make_ep_train_step,
+    moe_ep_rules,
+)
+from tpudist.parallel.tensor_parallel import shard_batch
+from tpudist.runtime.mesh import make_mesh
+from tpudist.train.state import TrainState
+
+
+def _mlp(t=16, d=8, f=16, e=4, top_k=2, cf=2.0):
+    layer = MoEMLP(d_model=d, d_ff=f,
+                   moe=MoEConfig(num_experts=e, top_k=top_k,
+                                 capacity_factor=cf))
+    x = jax.random.normal(jax.random.key(1), (t, d), jnp.float32)
+    params = layer.init(jax.random.key(0), x)["params"]
+    return layer, params, x
+
+
+def test_moe_all_experts_matches_dense_mixture():
+    """top_k = num_experts with ample capacity ≡ the dense soft mixture
+    Σ_e gate_e · MLP_e(x) — routing must lose nothing."""
+    e = 4
+    layer, params, x = _mlp(t=8, e=e, top_k=e, cf=float(e) * 2)
+    out, aux = layer.apply({"params": params}, x)
+
+    gates = jax.nn.softmax(x @ params["router"]["kernel"])
+    expect = np.zeros_like(np.asarray(x))
+    for j in range(e):
+        h = jax.nn.gelu(x @ params["w_up"][j])
+        expect += np.asarray(gates[:, j:j + 1] * (h @ params["w_down"][j]))
+    np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """With capacity 1 per expert, most tokens overflow; their combine mass
+    is zero (residual carries them) and the layer stays finite."""
+    layer, params, x = _mlp(t=16, e=4, top_k=1, cf=0.25)  # capacity = 1
+    out, _ = layer.apply({"params": params}, x)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # at most  e × capacity  tokens can have non-zero output
+    nonzero = np.sum(np.any(np.abs(np.asarray(out)) > 0, axis=-1))
+    assert nonzero <= 4
+
+
+def test_moe_routing_is_top_k():
+    """With big capacity every token lands on exactly its top-k experts."""
+    layer, params, x = _mlp(t=8, e=4, top_k=2, cf=8.0)
+    gates = jax.nn.softmax(x @ params["router"]["kernel"])
+    from tpudist.models.moe import _top_k_routing
+
+    dispatch, combine, _ = _top_k_routing(gates, 2, capacity=16)
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    np.testing.assert_array_equal(per_token, np.full(8, 2.0))
+    # combine mass per token sums to 1 (renormalised top-k gates)
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(combine, axis=(1, 2))), np.ones(8), atol=1e-6)
+
+
+def test_moe_respects_compute_dtype():
+    """bfloat16 compute must stay bfloat16 through the MoE block (f32
+    params, bf16 activations — the same contract as nn.Dense(dtype=...))."""
+    layer, params, x = _mlp(t=8, e=4, top_k=2, cf=4.0)
+    out, _ = layer.apply({"params": params}, x.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16, out.dtype
+    assert params["w_up"].dtype == jnp.float32  # master weights stay f32
+
+
+def test_moe_lm_ep_train_step_on_mesh():
+    """DP×EP: experts sharded over the expert axis, batch over data; the
+    jitted step runs, loss decreases, expert weights stay sharded."""
+    mesh = make_mesh({"data": 2, "expert": 4})
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                            embed_dim=16, max_seq_len=8)
+    model = MoETransformerLM(cfg, MoEConfig(num_experts=4, top_k=2))
+    tokens = np.random.default_rng(0).integers(0, 32, (8, 8)).astype(np.int32)
+    params = model.init(jax.random.key(0), jnp.asarray(tokens))["params"]
+
+    state, specs = make_ep_state(
+        model.apply, params, optax.adam(1e-2), mesh)
+    w_up_spec = specs["block0"]["moe"]["w_up"]
+    assert tuple(w_up_spec)[0] == "expert", w_up_spec
+    w_up = state.params["block0"]["moe"]["w_up"]
+    assert w_up.addressable_shards[0].data.shape[0] == w_up.shape[0] // 4
+
+    def loss_fn(p, batch, rng):
+        (toks,) = batch
+        logits, aux = model.apply({"params": p}, toks)
+        ce = cross_entropy(
+            logits[:, :-1].reshape(-1, cfg.vocab_size), toks[:, 1:].reshape(-1))
+        return ce + aux, {"aux": aux}
+
+    step = make_ep_train_step(loss_fn, mesh, specs, donate=False)
+    batch = shard_batch(jnp.asarray(tokens), mesh)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_ep_matches_single_device():
+    """The sharded DP×EP step computes the same loss as an unsharded jit of
+    the identical program on one device."""
+    mesh = make_mesh({"data": 2, "expert": 4})
+    cfg = TransformerConfig(vocab_size=16, num_layers=1, num_heads=2,
+                            embed_dim=16, max_seq_len=8)
+    model = MoETransformerLM(cfg, MoEConfig(num_experts=4, top_k=2))
+    tokens = np.random.default_rng(3).integers(0, 16, (4, 8)).astype(np.int32)
+    params = model.init(jax.random.key(0), jnp.asarray(tokens))["params"]
+
+    def loss_fn(p, batch, rng):
+        (toks,) = batch
+        logits, aux = model.apply({"params": p}, toks)
+        ce = cross_entropy(
+            logits[:, :-1].reshape(-1, cfg.vocab_size), toks[:, 1:].reshape(-1))
+        return ce + aux, {}
+
+    ref_loss, _ = jax.jit(loss_fn)(params, (jnp.asarray(tokens),), jax.random.key(0))
+
+    state, specs = make_ep_state(model.apply, params, optax.sgd(0.1), mesh)
+    step = make_ep_train_step(loss_fn, mesh, specs, donate=False)
+    _, metrics = step(state, shard_batch(jnp.asarray(tokens), mesh))
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_loss), rtol=1e-5)

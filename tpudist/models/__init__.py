@@ -10,6 +10,7 @@ EmbeddingBag+Linear hybrid (`server_model_data_parallel.py:34-46`).
 from tpudist.models.convnet import ConvNet
 from tpudist.models.embedding import EmbeddingBagClassifier
 from tpudist.models.mlp import MLP
+from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
 from tpudist.models.resnet import ResNet50, resnet50_stages
 from tpudist.models.transformer import (
     TransformerConfig,
@@ -21,6 +22,9 @@ __all__ = [
     "ConvNet",
     "EmbeddingBagClassifier",
     "MLP",
+    "MoEConfig",
+    "MoEMLP",
+    "MoETransformerLM",
     "ResNet50",
     "TransformerConfig",
     "TransformerLM",

@@ -1,0 +1,166 @@
+"""Mixture-of-Experts layers — the expert-parallel workload of the zoo.
+
+Absent from the reference (SURVEY.md §2.3: "EP — NO"), added so the
+framework covers the full parallelism alphabet.  The design follows the
+GShard/Switch dense-dispatch formulation, which is the TPU-idiomatic one:
+routing is expressed as einsums against a static-shaped one-hot dispatch
+tensor (no gather/scatter, no dynamic shapes), so the whole layer lowers to
+MXU matmuls, and sharding the expert dimension over an ``expert`` mesh axis
+turns the two dispatch einsums into the all-to-alls of expert parallelism
+(see :mod:`tpudist.parallel.expert_parallel`).
+
+Capacity semantics: each expert processes at most ``capacity`` tokens per
+batch (``capacity_factor × tokens/num_experts``); overflow tokens are
+dropped from that expert's contribution (their combine weight is zero), the
+residual connection carries them through — standard Switch behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpudist.models.transformer import (
+    AttentionFn,
+    CausalSelfAttention,
+    TransformerConfig,
+    sdpa,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 1e-2
+
+
+def _top_k_routing(gates: jnp.ndarray, top_k: int, capacity: int):
+    """GShard routing: from router probabilities ``gates [T, E]`` build
+
+    * ``dispatch [T, E, C]`` — one-hot: token t goes to expert e at slot c,
+    * ``combine  [T, E, C]`` — dispatch weighted by the (renormalised) gate,
+    * ``aux`` — the load-balancing loss (mean fraction·mean gate × E²).
+    """
+    t, e = gates.shape
+    # [T, k] indices of the chosen experts, gate mass renormalised over them.
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)
+    top_vals = top_vals / jnp.maximum(
+        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((t, e, capacity), gates.dtype)
+    combine = jnp.zeros((t, e, capacity), gates.dtype)
+    # Slots are assigned in token order per expert, k-th choices after the
+    # (k-1)-th (Switch/GShard priority), tracked by a running per-expert count.
+    counts = jnp.zeros((e,), jnp.int32)
+    for k in range(top_k):
+        onehot = jax.nn.one_hot(top_idx[:, k], e, dtype=jnp.int32)  # [T, E]
+        pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # slot idx
+        keep = (pos < capacity) & (onehot > 0)
+        slot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)      # [T, E, C]
+        sel = slot * keep[..., None].astype(gates.dtype)
+        dispatch = dispatch + sel
+        combine = combine + sel * top_vals[:, k, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+
+    # Load-balancing aux loss (Switch eq. 4): encourages uniform routing.
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], e, dtype=gates.dtype), axis=0)
+    mean_gates = jnp.mean(gates, axis=0)
+    aux = jnp.sum(frac_tokens * mean_gates) * e
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP: router + ``num_experts`` gelu MLPs.
+
+    Input/output ``[tokens, d_model]``; expert weights are single stacked
+    arrays ``[E, d, f]`` / ``[E, f, d]`` so the expert dim is shardable.
+    Returns ``(out, aux_loss)``.
+    """
+
+    d_model: int
+    d_ff: int
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        t = x.shape[0]
+        e = self.moe.num_experts
+        capacity = max(
+            1, int(self.moe.capacity_factor * t * self.moe.top_k / e))
+        gates = jax.nn.softmax(
+            nn.Dense(e, use_bias=False, name="router")(x).astype(jnp.float32))
+        dispatch, combine, aux = _top_k_routing(
+            gates, self.moe.top_k, capacity)
+
+        # Params in float32, compute in the input dtype (the same f32-params/
+        # bf16-compute contract nn.Dense(dtype=...) gives the dense layers).
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(),
+            (e, self.d_model, self.d_ff)).astype(x.dtype)
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(),
+            (e, self.d_ff, self.d_model)).astype(x.dtype)
+
+        # dispatch: [T,E,C] × [T,d] -> per-expert batches [E,C,d] (the EP
+        # all-to-all when T is data-sharded and E expert-sharded) ...
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_up))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
+        # ... and the return all-to-all, weighted by the combine gates.
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+        return out, aux.astype(jnp.float32)
+
+
+class MoEDecoderBlock(nn.Module):
+    cfg: TransformerConfig
+    moe: MoEConfig
+    attention_fn: AttentionFn = sdpa
+
+    @nn.compact
+    def __call__(self, x, *, causal: bool = True):
+        h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(self.cfg, self.attention_fn,
+                                    name="attn")(h, causal=causal)
+        h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
+        b, s, d = h.shape
+        out, aux = MoEMLP(d_model=self.cfg.embed_dim,
+                          d_ff=self.cfg.mlp_ratio * self.cfg.embed_dim,
+                          moe=self.moe, name="moe")(h.reshape(b * s, d))
+        return x + out.reshape(b, s, d), aux
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with an MoE MLP in every block.
+
+    ``tokens [B, S] -> (logits [B, S, vocab] f32, aux_loss scalar)``; add
+    ``aux_loss`` (already weighted) to the training loss.
+    """
+
+    cfg: TransformerConfig
+    moe: MoEConfig
+    attention_fn: AttentionFn = sdpa
+
+    @nn.compact
+    def __call__(self, tokens, *, causal: bool = True, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     dtype=cfg.compute_dtype, name="tok_embed")(tokens)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                         dtype=cfg.compute_dtype, name="pos_embed")(positions)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            x, aux = MoEDecoderBlock(cfg, self.moe, self.attention_fn,
+                                     name=f"block{i}")(x, causal=causal)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32), self.moe.aux_loss_weight * aux_total

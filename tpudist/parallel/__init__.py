@@ -15,6 +15,16 @@ from tpudist.parallel.data_parallel import (
     make_dp_train_loop,
     make_dp_train_step,
 )
+from tpudist.parallel.expert_parallel import (
+    make_ep_state,
+    make_ep_train_step,
+    moe_ep_rules,
+)
+from tpudist.parallel.fsdp import (
+    fsdp_specs,
+    make_fsdp_state,
+    make_fsdp_train_step,
+)
 from tpudist.parallel.pipeline import (
     make_pipeline_forward,
     make_pipeline_train_step,
@@ -44,6 +54,12 @@ from tpudist.parallel.tensor_parallel import (
 
 __all__ = [
     "broadcast_params",
+    "fsdp_specs",
+    "make_ep_state",
+    "make_ep_train_step",
+    "make_fsdp_state",
+    "make_fsdp_train_step",
+    "moe_ep_rules",
     "make_sp_train_step",
     "make_spmd_train_step",
     "make_tp_state",

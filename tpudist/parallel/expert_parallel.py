@@ -1,0 +1,68 @@
+"""Expert parallelism: MoE expert weights sharded over an ``expert`` axis.
+
+Absent from the reference (SURVEY.md §2.3: "EP — NO"); included so the
+parallelism alphabet is complete.  Like FSDP and tensor parallelism this is
+a *layout* on TPU: the stacked expert weights ``[E, d, f]`` get
+``P("expert", None, None)``, the router stays replicated, and GSPMD lowers
+the two dispatch einsums of :class:`tpudist.models.moe.MoEMLP` into the
+all-to-alls that define expert parallelism — the same collectives a
+parameter server (`server_model_data_parallel.py:134-139`) emulated with
+RPC, riding ICI instead.
+
+Composes with data parallelism on a 2-D ``(data, expert)`` mesh in one jit:
+batch sharded over ``data``, experts over ``expert``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.parallel.tensor_parallel import (
+    Rules,
+    make_spmd_train_step,
+    make_tp_state,
+)
+from tpudist.train.state import TrainState
+
+
+def moe_ep_rules(axis: str = "expert") -> Rules:
+    """Partition rules for :class:`~tpudist.models.moe.MoETransformerLM`:
+    expert weight stacks sharded on their expert dim, router replicated,
+    everything else (attention, embeddings, norms) replicated — compose with
+    :func:`~tpudist.parallel.tensor_parallel.transformer_tp_rules` or
+    :func:`~tpudist.parallel.fsdp.fsdp_specs` for richer layouts."""
+    return [
+        (r"moe/w_up", P(axis, None, None)),
+        (r"moe/w_down", P(axis, None, None)),
+        (r"moe/router", P()),
+    ]
+
+
+def make_ep_state(
+    model_apply: Callable,
+    params: Any,
+    tx,
+    mesh: Mesh,
+    axis: str = "expert",
+    extra_rules: Rules = (),
+    rng: jax.Array | int = 0,
+) -> tuple[TrainState, Any]:
+    """Shard MoE params over ``axis`` (plus any ``extra_rules``, which win
+    on conflict) and build the TrainState; optimizer state inherits the
+    shardings.  Returns ``(state, param_specs)``."""
+    rules = list(extra_rules) + list(moe_ep_rules(axis))
+    return make_tp_state(model_apply, params, tx, mesh, rules=rules, rng=rng)
+
+
+def make_ep_train_step(
+    loss_fn,
+    mesh: Mesh,
+    param_specs: Any,
+    donate: bool = True,
+):
+    """DP×EP train step — one GSPMD program; the expert-dim shardings in
+    ``param_specs`` make the dispatch/return einsums all-to-alls."""
+    return make_spmd_train_step(loss_fn, mesh, param_specs, donate)

@@ -1,0 +1,104 @@
+"""FSDP / ZeRO-3: parameters and optimizer state sharded over the data axis.
+
+Absent from the reference (SURVEY.md §2.3: "FSDP / ZeRO — NO"), but a
+framework a DDP user (`mnist_ddp_elastic.py:58`) migrates to must offer the
+memory-scaled variant of data parallelism.  On TPU it is a *layout*, not a
+runtime: shard every parameter leaf across the ``data`` axis and jit — GSPMD
+inserts the all-gather before each use and turns the gradient reduction into
+a reduce-scatter, which is exactly the ZeRO-3 schedule.  Optimizer state
+created from sharded params inherits the sharding, so Adam moments are
+sharded too (ZeRO-1/2 come for free).
+
+Composable with tensor parallelism: pass ``tp_rules`` and leaves matching a
+TP pattern keep their model-axis sharding while the FSDP axis shards one of
+the remaining dims — the standard 2-D (fsdp × model) layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudist.parallel.tensor_parallel import (
+    Rules,
+    make_spmd_train_step,
+    shard_tree,
+    spec_tree_from_rules,
+)
+from tpudist.train.state import TrainState
+
+
+def _shard_leaf_spec(shape: tuple[int, ...], axis: str, axis_size: int,
+                     taken: P | None = None) -> P:
+    """Spec sharding the largest not-yet-taken dim divisible by ``axis_size``
+    over ``axis``; replicated when nothing divides (e.g. small biases)."""
+    base = list(taken) if taken is not None else [None] * len(shape)
+    base += [None] * (len(shape) - len(base))
+    candidates = [
+        (shape[d], d) for d in range(len(shape))
+        if base[d] is None and shape[d] % axis_size == 0 and shape[d] >= axis_size
+    ]
+    if not candidates:
+        return P(*base) if taken is not None else P()
+    _, dim = max(candidates)
+    base[dim] = axis
+    return P(*base)
+
+
+def fsdp_specs(
+    params: Any,
+    mesh: Mesh,
+    axis: str = "data",
+    tp_rules: Optional[Rules] = None,
+) -> Any:
+    """PartitionSpec tree sharding every leaf over ``axis``.
+
+    Each leaf gets its largest ``axis_size``-divisible dimension sharded;
+    indivisible leaves replicate.  With ``tp_rules``, leaves matching a rule
+    start from that model-axis spec and the FSDP axis takes a remaining dim.
+    """
+    axis_size = mesh.shape[axis]
+    tp_specs = (
+        spec_tree_from_rules(params, tp_rules) if tp_rules is not None else None
+    )
+
+    def spec_for(leaf, tp_spec):
+        taken = tp_spec if tp_spec is not None and tuple(tp_spec) else None
+        return _shard_leaf_spec(leaf.shape, axis, axis_size, taken)
+
+    if tp_specs is None:
+        return jax.tree.map(lambda leaf: spec_for(leaf, None), params)
+    return jax.tree.map(spec_for, params, tp_specs)
+
+
+def make_fsdp_state(
+    model_apply: Callable,
+    params: Any,
+    tx,
+    mesh: Mesh,
+    axis: str = "data",
+    tp_rules: Optional[Rules] = None,
+    rng: jax.Array | int = 0,
+) -> tuple[TrainState, Any]:
+    """Shard ``params`` FSDP-style and build a TrainState whose optimizer
+    state inherits the shardings.  Returns ``(state, param_specs)``."""
+    specs = fsdp_specs(params, mesh, axis, tp_rules)
+    sharded = shard_tree(params, mesh, specs)
+    state = TrainState.create(model_apply, sharded, tx, rng=rng)
+    return state, specs
+
+
+def make_fsdp_train_step(
+    loss_fn,
+    mesh: Mesh,
+    param_specs: Any,
+    donate: bool = True,
+):
+    """ZeRO-3 train step: identical GSPMD program to
+    :func:`make_spmd_train_step`; with ``param_specs`` from
+    :func:`fsdp_specs` the compiler's partitioning IS the FSDP schedule
+    (all-gather params per use, reduce-scatter grads, local optimizer
+    update on each shard)."""
+    return make_spmd_train_step(loss_fn, mesh, param_specs, donate)
