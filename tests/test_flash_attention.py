@@ -76,3 +76,25 @@ def test_transformer_with_flash_attention():
     got = flash_model.apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_auto_block_defaults():
+    """No block args: _auto_block picks the largest power-of-two divisor
+    ≤ 1024, and the kernel matches sdpa with those defaults."""
+    from tpudist.ops.flash_attention import _auto_block
+
+    assert _auto_block(2048) == 1024
+    assert _auto_block(8192) == 1024
+    assert _auto_block(384) == 128   # 384 = 3·128
+    assert _auto_block(96) == 32
+    assert _auto_block(7) == 1
+    for s in (64, 384, 2048):
+        assert s % _auto_block(s) == 0
+
+    q, k, v = (
+        jax.random.normal(jax.random.key(i), (2, 384, 2, 64), jnp.float32)
+        for i in range(3)
+    )
+    got = flash_attention(q, k, v, causal=True)  # defaults, interpret on CPU
+    want = sdpa(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)

@@ -63,9 +63,24 @@ def _pallas() -> str:
     platform = jax.devices()[0].platform
     if platform != "tpu":
         return f"importable (kernels need TPU; backend is {platform})"
-    from tpudist.ops.flash_attention import flash_attention  # noqa: F401
+    # Compile + run the flash kernel on the live backend and check numerics
+    # against the plain-XLA reference (interpreter mode can't catch Mosaic
+    # lowering regressions; this can).
+    import jax.numpy as jnp
 
-    return "importable, TPU backend present"
+    from tpudist.models.transformer import sdpa
+    from tpudist.ops.flash_attention import flash_attention
+
+    q, k, v = (
+        jax.random.normal(jax.random.key(i), (1, 256, 2, 128), jnp.bfloat16)
+        for i in range(3)
+    )
+    got = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    want = sdpa(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
+    if err >= 0.05:
+        raise RuntimeError(f"flash kernel numerics off: max err {err}")
+    return f"flash kernel runs on tpu, max err {err:.4f} vs reference"
 
 
 def _native_lib() -> str:
@@ -116,7 +131,14 @@ def main(argv: list[str] | None = None) -> int:
     results: list = []
     _check("jax backend", _jax_backend, True, results)
     _check("XLA collectives", _collectives, True, results)
-    _check("pallas", _pallas, False, results)
+    try:
+        import jax
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 - backend failure already reported above
+        on_tpu = False
+    # With a TPU present, a broken kernel stack must FAIL the build check.
+    _check("pallas", _pallas, on_tpu, results)
     _check("native library", _native_lib, False, results)
     if any(n == "native library" and ok for n, ok, *_ in results):
         _check("native coordination service", _native_coord, False, results)
