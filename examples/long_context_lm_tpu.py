@@ -1,0 +1,177 @@
+"""Long-context transformer LM training — the beyond-parity flagship.
+
+The reference suite has no attention model anywhere (SURVEY.md §2.3): its
+largest workload is ResNet50 over RPC (`model_parallel_ResNet50.py:43-139`).
+This example is the workload a user of those mechanisms scales to on TPU —
+a decoder-only LM over long sequences — wired to every relevant strategy in
+the framework:
+
+* ``--attn flash``    fused pallas flash-attention kernel (single chip hot op)
+* ``--attn ring``     ring attention: K/V rotate over the ``seq`` mesh axis
+                      via ``ppermute`` (sequence/context parallelism)
+* ``--attn ulysses``  all-to-all sequence parallelism (head-sharded attention)
+* ``--tp N``          Megatron-style tensor parallelism over a ``model`` axis
+* plain data parallelism otherwise (``lax.pmean`` grad sync)
+
+Run (single chip):    python examples/long_context_lm_tpu.py --steps 20
+Run (8 simulated devices, ring attention over 4-way sequence sharding):
+    python examples/long_context_lm_tpu.py --sim-devices 8 --sp 4 \
+        --attn ring --seq-len 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from _common import setup_platform
+
+
+def main(argv=None) -> float:
+    argv = setup_platform(argv)
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--seq-len", default=2048, type=int)
+    parser.add_argument("--batch-size", default=8, type=int,
+                        help="global batch in sequences")
+    parser.add_argument("--steps", default=50, type=int)
+    parser.add_argument("--layers", default=4, type=int)
+    parser.add_argument("--heads", default=8, type=int)
+    parser.add_argument("--embed-dim", default=512, type=int)
+    parser.add_argument("--vocab", default=256, type=int)
+    parser.add_argument("--lr", default=3e-4, type=float)
+    parser.add_argument("--attn", default="auto",
+                        choices=["auto", "flash", "sdpa", "ring", "ulysses"])
+    parser.add_argument("--sp", default=0, type=int,
+                        help="sequence shards (>1 selects ring/ulysses)")
+    parser.add_argument("--tp", default=0, type=int,
+                        help="tensor-parallel shards over a model axis")
+    parser.add_argument("--bf16", action="store_true",
+                        help="bfloat16 compute (f32 params)")
+    parser.add_argument("--log-every", default=10, type=int)
+    args = parser.parse_args(argv)
+    if args.sp > 1 and args.tp > 1:
+        parser.error("--sp and --tp are separate strategies; pick one")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import tpudist
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.ops.flash_attention import flash_attention_fn
+    from tpudist.ops.losses import cross_entropy, cross_entropy_per_token
+    from tpudist.parallel.data_parallel import broadcast_params, make_dp_train_step
+    from tpudist.parallel.ring_attention import (
+        make_sp_train_step,
+        ring_attention_fn,
+        ulysses_attention_fn,
+    )
+    from tpudist.parallel.tensor_parallel import (
+        make_spmd_train_step,
+        make_tp_state,
+        shard_batch,
+    )
+    from tpudist.train.state import TrainState
+
+    attn = args.attn
+    if attn == "auto":
+        attn = ("ring" if args.sp > 1
+                else "flash" if jax.default_backend() == "tpu" else "sdpa")
+    if args.sp > 1 and attn not in ("ring", "ulysses"):
+        parser.error(f"--sp needs ring/ulysses attention, got {attn}")
+    if attn in ("ring", "ulysses") and args.sp <= 1:
+        parser.error(f"--attn {attn} is sequence-parallel; pass --sp N (N>1)")
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+        embed_dim=args.embed_dim, max_seq_len=args.seq_len,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch_size, args.seq_len)), jnp.int32)
+    init_params = TransformerLM(cfg).init(
+        jax.random.key(0), tokens[:1, : min(args.seq_len, 128)])["params"]
+    n_tokens = args.batch_size * (args.seq_len - 1)
+
+    if args.sp > 1:
+        mesh = tpudist.make_mesh({"data": -1, "seq": args.sp})
+        attn_fn = (ring_attention_fn("seq") if attn == "ring"
+                   else ulysses_attention_fn("seq"))
+        model = TransformerLM(cfg, attention_fn=attn_fn)
+        # next-token prediction with the final position masked out
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.full((args.batch_size, 1), -1, jnp.int32)], 1)
+
+        def loss_per_token(logits, tgt):
+            mask = (tgt >= 0).astype(jnp.float32)
+            return cross_entropy_per_token(logits, jnp.maximum(tgt, 0)) * mask
+
+        state = TrainState.create(
+            model.apply, broadcast_params(init_params, mesh),
+            optax.adam(args.lr))
+        step = make_sp_train_step(model, loss_per_token, mesh,
+                                  total_tokens=n_tokens)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("data", "seq"))
+        batch = (jax.device_put(tokens, sharding),
+                 jax.device_put(targets, sharding))
+        run = lambda s: step(s, *batch)
+        strategy = f"dp{mesh.shape['data']}×sp{args.sp} ({attn})"
+    else:
+        attn_fn = (flash_attention_fn() if attn == "flash" else None)
+        model = (TransformerLM(cfg, attention_fn=attn_fn) if attn_fn
+                 else TransformerLM(cfg))
+
+        def loss_fn(p, batch, _rng):
+            (toks,) = batch
+            logits = model.apply({"params": p}, toks)
+            return cross_entropy(
+                logits[:, :-1].reshape(-1, args.vocab),
+                toks[:, 1:].reshape(-1)), {}
+
+        if args.tp > 1:
+            mesh = tpudist.data_model_mesh(model=args.tp)
+            with mesh:
+                state, specs = make_tp_state(
+                    model.apply, init_params, optax.adam(args.lr), mesh)
+                step = make_spmd_train_step(loss_fn, mesh, specs)
+                batch = shard_batch((tokens,), mesh)
+            run = lambda s: step(s, *batch)
+            strategy = f"dp{mesh.shape['data']}×tp{args.tp} ({attn})"
+        else:
+            mesh = tpudist.data_mesh()
+            state = TrainState.create(
+                model.apply, broadcast_params(init_params, mesh),
+                optax.adam(args.lr))
+            step = make_dp_train_step(loss_fn, mesh)
+            run = lambda s: step(s, tokens)
+            strategy = f"dp{mesh.shape['data']} ({attn})"
+
+    print(f"strategy: {strategy}, seq_len={args.seq_len}, "
+          f"params on {len(jax.devices())} device(s)")
+    loss = float("nan")
+    t0 = None
+    for i in range(args.steps):
+        state, metrics = run(state)
+        if i == 0:
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.perf_counter()
+            steady_from = 1
+        if i % args.log_every == 0 or i == args.steps - 1:
+            loss = float(jax.device_get(metrics["loss"]))
+            print(f"step {i}: loss {loss:.4f}")
+    if args.steps > 1:
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        tps = (args.steps - steady_from) * tokens.size / dt
+        print(f"throughput: {tps:,.0f} tokens/sec")
+    return loss
+
+
+if __name__ == "__main__":
+    main()

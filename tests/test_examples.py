@@ -66,3 +66,20 @@ def test_model_parallel_resnet50_twin():
          "--num-batches", "1", "--stages", "2"]
     )
     assert all(t > 0 for t in results.values())
+
+
+@pytest.mark.parametrize("extra", [
+    ["--attn", "sdpa"],                                  # plain DP
+    ["--sp", "4", "--attn", "ring"],                     # DP×SP ring
+    ["--sp", "2", "--attn", "ulysses"],                  # DP×SP all-to-all
+    ["--tp", "2", "--attn", "sdpa"],                     # DP×TP Megatron
+])
+def test_long_context_lm_twin(extra):
+    import long_context_lm_tpu
+
+    loss = long_context_lm_tpu.main(
+        ["--seq-len", "128", "--batch-size", "8", "--steps", "3",
+         "--layers", "1", "--heads", "4", "--embed-dim", "64",
+         "--log-every", "10", *extra]
+    )
+    assert loss == loss and loss < 7.0  # finite, sane
