@@ -38,8 +38,16 @@ def main() -> None:
     enable_compilation_cache()  # first TPU compile is minutes; later runs warm
     n_chips = len(jax.devices())
     mesh = data_mesh()
-    global_batch = 1024 * mesh.shape["data"]  # reference batch per replica
-    steps_per_call = 10  # optimizer steps fused per dispatch (lax.scan)
+    on_tpu = jax.default_backend() == "tpu"
+    # Reference batch per replica on TPU; CPU runs are a smoke of the same
+    # program at a size a host core can turn around.
+    global_batch = (1024 if on_tpu else 128) * mesh.shape["data"]
+    # Optimizer steps fused per dispatch (lax.scan): enough that on-chip
+    # compute (~5 ms / 10 steps) dominates the host round-trip (~80 ms over
+    # the tunnel), so the RTT correction below is a small adjustment rather
+    # than the bulk of the window.
+    steps_per_call = 100 if on_tpu else 4
+    n_windows = 8 if on_tpu else 2
 
     model = ConvNet()
     ds = synthetic_mnist("train", n=steps_per_call * global_batch)
@@ -62,19 +70,34 @@ def main() -> None:
     # small-model training stays MXU-bound instead of dispatch-bound.
     train_loop = make_dp_train_loop(loss_fn, mesh)
 
-    # Warmup (compile + first dispatch), then steady-state measurement.
-    state, metrics = train_loop(state, images, labels)
-    jax.block_until_ready(metrics["loss"])
-
-    calls = 5
-    t0 = time.perf_counter()
-    for _ in range(calls):
+    # Warmup (compile + first dispatches).  Syncs are host fetches of the
+    # loss (``float(...)``) throughout: on tunneled/experimental backends
+    # ``block_until_ready`` can return before execution finishes, which
+    # silently turns the measurement into a dispatch-rate benchmark.
+    for _ in range(2):
         state, metrics = train_loop(state, images, labels)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    float(metrics["loss"][-1])
 
-    steps = calls * steps_per_call
-    images_per_sec_per_chip = steps * global_batch / dt / n_chips
+    # Straight wall clock over a long window: ``calls_per_window`` chained
+    # loop invocations (the donated state serializes them) with one hard
+    # sync at the end, so host round-trip latency amortizes the way it does
+    # in a real training run instead of being counted once per step.  The
+    # chip is time-shared, so take the best of a few windows — the
+    # estimator of unpreempted throughput; no latency subtraction, directly
+    # comparable to the wall-clock CPU reference.
+    calls_per_window = 5
+    window_times = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        for _ in range(calls_per_window):
+            state, metrics = train_loop(state, images, labels)
+        float(metrics["loss"][-1])
+        window_times.append(time.perf_counter() - t0)
+
+    images_per_sec_per_chip = (
+        calls_per_window * steps_per_call * global_batch
+        / min(window_times) / n_chips
+    )
 
     baseline = None
     baseline_path = Path(__file__).parent / "BASELINE.json"
