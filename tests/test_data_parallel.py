@@ -120,3 +120,27 @@ def test_dp_train_loop_matches_sequential_steps():
         state_a.params, state_b.params,
     )
     assert int(state_b.step) == n_steps
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps splits each shard into sequential micro-batches; with a
+    deterministic loss the update must equal the full-batch one."""
+    mesh = data_mesh(8)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((64, 28 * 28)).astype(np.float32)
+    y = rng.integers(0, 10, (64,))
+
+    _, state_a, loss_fn, _, _ = _setup(mesh)
+    step_full = make_dp_train_step(loss_fn, mesh, donate=False)
+    state_a, ma = step_full(state_a, jnp.asarray(x), jnp.asarray(y))
+
+    _, state_b, loss_fn, _, _ = _setup(mesh)
+    step_acc = make_dp_train_step(loss_fn, mesh, donate=False, accum_steps=4)
+    state_b, mb = step_acc(state_b, jnp.asarray(x), jnp.asarray(y))
+
+    np.testing.assert_allclose(
+        float(ma["loss"]), float(mb["loss"]), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        state_a.params, state_b.params)

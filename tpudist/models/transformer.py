@@ -35,6 +35,23 @@ import jax.numpy as jnp
 AttentionFn = Callable[..., jnp.ndarray]
 
 
+def _masked_attend(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """The one copy of the attention numerics every path shares: scaled
+    f32-accumulated QKᵀ, finfo-min mask fill, f32 softmax, cast back.
+    ``mask`` is boolean, broadcastable to [B, H, Sq, Sk] (True = attend)."""
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def sdpa(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -48,16 +65,11 @@ def sdpa(
     pallas flash) must match.  Softmax statistics in float32 regardless of
     the compute dtype — bfloat16 logits lose too much for long sequences.
     """
-    dtype = q.dtype
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
-        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        s_q, s_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return _masked_attend(q, k, v, mask)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +91,7 @@ class TransformerConfig:
 class CausalSelfAttention(nn.Module):
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
@@ -88,10 +101,40 @@ class CausalSelfAttention(nn.Module):
                        dtype=cfg.compute_dtype, name="qkv")(x)
         qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = self.attention_fn(q, k, v, causal=causal)
+        if self.decode:
+            out = self._cached_attend(q, k, v)
+        else:
+            out = self.attention_fn(q, k, v, causal=causal)
         out = out.reshape(b, s, cfg.embed_dim)
         return nn.Dense(cfg.embed_dim, use_bias=False,
                         dtype=cfg.compute_dtype, name="proj")(out)
+
+    def _cached_attend(self, q, k, v):
+        """One-token decoding against a KV cache of ``max_seq_len`` slots
+        (the standard flax ``cache`` collection pattern): fixed-shape
+        buffers + ``dynamic_update_slice`` keep the whole autoregressive
+        loop jittable as a ``lax.scan``."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        assert s == 1, "cached decoding feeds one token at a time"
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, cfg.max_seq_len, h, d), cfg.compute_dtype)
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, cfg.max_seq_len, h, d), cfg.compute_dtype)
+        idx_var = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        idx = idx_var.value
+        k_all = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype), (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype), (0, idx, 0, 0))
+        cached_k.value, cached_v.value = k_all, v_all
+        idx_var.value = idx + 1
+
+        mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
+        return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
 
 
 class MLPBlock(nn.Module):
@@ -110,21 +153,29 @@ class MLPBlock(nn.Module):
 class DecoderBlock(nn.Module):
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln1")(x)
         x = x + CausalSelfAttention(self.cfg, self.attention_fn,
+                                    decode=self.decode,
                                     name="attn")(h, causal=causal)
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
         return x + MLPBlock(self.cfg, name="mlp")(h)
 
 
 class TransformerLM(nn.Module):
-    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, vocab] f32."""
+    """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, vocab] f32.
+
+    With ``decode=True`` the attention layers keep a KV cache in the flax
+    ``cache`` collection and expect one token per call — see
+    :func:`tpudist.models.generate.greedy_generate`.
+    """
 
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
+    decode: bool = False
 
     @nn.compact
     def __call__(
@@ -142,7 +193,7 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim,
                          dtype=cfg.compute_dtype, name="pos_embed")(positions)
         for i in range(cfg.num_layers):
-            x = DecoderBlock(cfg, self.attention_fn,
+            x = DecoderBlock(cfg, self.attention_fn, decode=self.decode,
                              name=f"block{i}")(x, causal=causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
