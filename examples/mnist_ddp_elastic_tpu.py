@@ -41,6 +41,9 @@ def main(argv=None) -> dict:
     parser.add_argument("--limit", default=0, type=int, help="cap dataset size (0 = full)")
     parser.add_argument("--features", default=1024, type=int)
     parser.add_argument("--hidden-layers", default=5, type=int)
+    parser.add_argument("--steps-per-dispatch", default=1, type=int,
+                        help="optimizer steps fused per device dispatch "
+                             "(lax.scan); numerics identical to stepwise")
     args = parser.parse_args(argv)
 
     import jax
@@ -73,6 +76,7 @@ def main(argv=None) -> dict:
         save_every=args.save_every,
         batch_size=global_batch,
         snapshot_path=args.snapshot_path,
+        steps_per_dispatch=args.steps_per_dispatch,
     )
     train_loader = ShardedLoader(
         [train_ds.images, train_ds.labels], cfg.batch_size, mesh, shuffle=False

@@ -106,3 +106,53 @@ class TestShardedLoader:
         e1 = np.concatenate([b[0] for b in loader.epoch(1)])
         assert not np.array_equal(e0, e1)
         assert set(e0.ravel()) == set(e1.ravel())
+
+
+def test_epoch_stacked_matches_single_steps():
+    """epoch_stacked groups == the same steps from epoch(), stacked."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudist.data.mnist import synthetic_mnist
+    from tpudist.runtime.mesh import data_mesh
+
+    mesh = data_mesh(8)
+    ds = synthetic_mnist("train", n=448)  # 7 steps of 64
+    loader = ShardedLoader([ds.images, ds.labels], 64, mesh, shuffle=True)
+    singles = list(loader.epoch(3))
+    stacked = list(loader.epoch_stacked(3, n_steps=3))
+    assert len(stacked) == 2  # 7 // 3 full groups
+    for g, group in enumerate(stacked):
+        for arr_i, arr in enumerate(group):
+            assert arr.shape[0] == 3
+            spec = arr.sharding.spec
+            assert spec[1] == "data" and spec[0] is None
+            for s in range(3):
+                np.testing.assert_array_equal(
+                    np.asarray(arr[s]),
+                    np.asarray(singles[g * 3 + s][arr_i]))
+    # the tail resumes exactly where the groups stopped
+    tail = list(loader.epoch(3, start_step=6))
+    assert len(tail) == 1
+    np.testing.assert_array_equal(
+        np.asarray(tail[0][1]), np.asarray(singles[6][1]))
+
+
+def test_epoch_stacked_with_partial_tail():
+    """drop_last=False with a partial final batch: stacked groups cover only
+    full batches; the tail (incl. the partial batch) comes via epoch()."""
+    from tpudist.data.mnist import synthetic_mnist
+    from tpudist.runtime.mesh import data_mesh
+
+    mesh = data_mesh(8)
+    ds = synthetic_mnist("train", n=480)  # shard 60, local 8: 7 full + 1 partial
+    loader = ShardedLoader([ds.images, ds.labels], 64, mesh, drop_last=False)
+    assert loader.steps_per_epoch == 8
+    assert loader.stacked_groups(3) == 2  # 7 full batches // 3
+    stacked = list(loader.epoch_stacked(1, n_steps=3))
+    assert len(stacked) == 2
+    assert all(arr.shape[:2] == (3, 64) for group in stacked for arr in group)
+    tail = list(loader.epoch(1, start_step=6))
+    assert len(tail) == 2
+    assert tail[0][0].shape[0] == 64
+    assert tail[1][0].shape[0] == 32  # the partial batch

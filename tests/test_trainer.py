@@ -12,7 +12,7 @@ from tpudist.runtime.mesh import data_mesh
 from tpudist.train.trainer import Trainer, TrainerConfig
 
 
-def _make_trainer(tmp_path, epochs=2, n=512):
+def _make_trainer(tmp_path, epochs=2, n=512, **config_overrides):
     mesh = data_mesh(8)
     train_ds = synthetic_mnist("train", n=n)
     test_ds = synthetic_mnist("test", n=256)
@@ -28,6 +28,7 @@ def _make_trainer(tmp_path, epochs=2, n=512):
         batch_size=64,
         snapshot_path=str(tmp_path / "snapshot.npz"),
         log_every=1000,
+        **config_overrides,
     )
     return Trainer(
         config, model.apply, params, optax.adam(1e-3), mesh, train_loader, test_loader
@@ -69,3 +70,28 @@ def test_trainer_profile_dir_writes_trace(tmp_path):
     trainer.config.eval_every_epoch = False
     trainer.train()
     assert any(p.is_file() for p in trace_dir.rglob("*")), "no trace files written"
+
+
+def test_trainer_fused_dispatch_matches_stepwise(tmp_path):
+    """steps_per_dispatch>1 (lax.scan fused loop + tail steps) must produce
+    the same trained params as the stepwise path."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    # n=448, batch 64 -> 7 steps/epoch: with steps_per_dispatch=3 that is
+    # 2 fused groups + 1 stepwise tail step per epoch.
+    trainer_a, _ = _make_trainer(tmp_path / "a", epochs=2, n=448)
+    summary_a = trainer_a.train()
+
+    trainer_b, _ = _make_trainer(
+        tmp_path / "b", epochs=2, n=448, steps_per_dispatch=3)
+    assert trainer_b.train_loop is not None
+    summary_b = trainer_b.train()
+
+    # epoch-mean metrics weight every optimizer step equally on both paths
+    np.testing.assert_allclose(summary_a["loss"], summary_b["loss"], rtol=1e-6)
+
+    assert int(trainer_a.state.step) == int(trainer_b.state.step) == 14
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        trainer_a.state.params, trainer_b.state.params)

@@ -94,16 +94,21 @@ class ShardedLoader:
             return shard_len // self.local_batch
         return -(-shard_len // self.local_batch)
 
-    def epoch(self, epoch: int) -> Iterator[tuple]:
+    def _batch_indices(self, per_shard: list, step: int) -> np.ndarray:
+        lo = step * self.local_batch
+        return np.concatenate(
+            [p[lo : lo + self.local_batch] for p in per_shard]
+        )
+
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[tuple]:
         """Yield one epoch of batches; ``epoch`` seeds the shuffle
-        (the ``sampler.set_epoch`` contract, `mnist_ddp_elastic.py:84`)."""
+        (the ``sampler.set_epoch`` contract, `mnist_ddp_elastic.py:84`).
+        ``start_step`` skips the first batches (resume / tail-after-stacked
+        iteration)."""
         per_shard = [s.indices(epoch) for s in self.samplers]
 
         def batch_idx(step: int) -> np.ndarray:
-            lo = step * self.local_batch
-            return np.concatenate(
-                [p[lo : lo + self.local_batch] for p in per_shard]
-            )
+            return self._batch_indices(per_shard, step)
 
         def emit(batch: tuple) -> tuple:
             if self._shardings is not None:
@@ -114,7 +119,7 @@ class ShardedLoader:
 
         steps = self.steps_per_epoch
         if self._pool is None:
-            for step in range(steps):
+            for step in range(start_step, steps):
                 yield emit(tuple(a[batch_idx(step)] for a in self.arrays))
             return
 
@@ -124,9 +129,10 @@ class ShardedLoader:
             out = [np.empty((len(idx),) + a.shape[1:], a.dtype) for a in self.arrays]
             return self._pool.submit(self.arrays, idx, out)
 
-        jobs = [submit(s) for s in range(min(self.prefetch, steps))]
+        jobs = [submit(s) for s in
+                range(start_step, min(start_step + self.prefetch, steps))]
         try:
-            for step in range(steps):
+            for step in range(start_step, steps):
                 ahead = step + self.prefetch
                 if ahead < steps:
                     jobs.append(submit(ahead))
@@ -134,6 +140,80 @@ class ShardedLoader:
         finally:
             # Abandoned epoch (break / exception): reap in-flight jobs so
             # neither Python buffers nor C++ job objects leak.
+            for job in jobs:
+                try:
+                    self._pool.wait(job)
+                except Exception:
+                    pass
+
+    def stacked_groups(self, n_steps: int) -> int:
+        """How many FULL ``n_steps`` groups :meth:`epoch_stacked` yields.
+
+        Only full-size batches can stack (a ``drop_last=False`` partial
+        final batch has a different shape), so groups count over
+        ``shard_size // local_batch`` regardless of ``drop_last``.
+        """
+        full_batches = self.samplers[0].shard_size // self.local_batch
+        return full_batches // n_steps
+
+    def epoch_stacked(self, epoch: int, n_steps: int) -> Iterator[tuple]:
+        """Yield FULL groups of ``n_steps`` consecutive batches stacked on a
+        leading steps dimension — ``[n_steps, global_batch, ...]`` per
+        array, placed under ``P(None, data_axis)`` — the input shape of
+        :func:`tpudist.parallel.data_parallel.make_dp_train_loop`.
+
+        Yields :meth:`stacked_groups` groups; drive the remaining batches
+        (including any ``drop_last=False`` partial one) with
+        ``epoch(epoch, start_step=stacked_groups(n) * n)``.  Group gathers
+        ride the native prefetch pool when the loader has one.
+        """
+        per_shard = [s.indices(epoch) for s in self.samplers]
+        groups = self.stacked_groups(n_steps)
+        shardings = None
+        if self.mesh is not None:
+            shardings = [
+                NamedSharding(
+                    self.mesh,
+                    P(None, self.data_axis, *([None] * (a.ndim - 1))))
+                for a in self.arrays
+            ]
+
+        def group_idx(g: int) -> np.ndarray:
+            return np.concatenate([
+                self._batch_indices(per_shard, s)
+                for s in range(g * n_steps, (g + 1) * n_steps)
+            ])
+
+        def emit(arrs: tuple) -> tuple:
+            batch = tuple(
+                a.reshape(n_steps, self.global_batch, *a.shape[1:])
+                for a in arrs
+            )
+            if shardings is not None:
+                batch = tuple(
+                    jax.device_put(b, s) for b, s in zip(batch, shardings))
+            return batch
+
+        if self._pool is None:
+            for g in range(groups):
+                idx = group_idx(g)
+                yield emit(tuple(a[idx] for a in self.arrays))
+            return
+
+        def submit(g: int) -> int:
+            idx = group_idx(g)
+            out = [np.empty((len(idx),) + a.shape[1:], a.dtype)
+                   for a in self.arrays]
+            return self._pool.submit(self.arrays, idx, out)
+
+        jobs = [submit(g) for g in range(min(self.prefetch, groups))]
+        try:
+            for g in range(groups):
+                ahead = g + self.prefetch
+                if ahead < groups:
+                    jobs.append(submit(ahead))
+                yield emit(tuple(self._pool.wait(jobs.pop(0))))
+        finally:
             for job in jobs:
                 try:
                     self._pool.wait(job)

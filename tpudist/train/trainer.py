@@ -29,6 +29,7 @@ from tpudist.ops.losses import cross_entropy
 from tpudist.parallel.data_parallel import (
     broadcast_params,
     make_dp_eval_step,
+    make_dp_train_loop,
     make_dp_train_step,
 )
 from tpudist.train.state import TrainState
@@ -52,6 +53,12 @@ class TrainerConfig:
     eval_every_epoch: bool = config_field(True, "run test() after every epoch")
     profile_dir: str = config_field(
         "", "write a jax.profiler trace of epoch 0 here (XProf/TensorBoard)"
+    )
+    steps_per_dispatch: int = config_field(
+        1,
+        "optimizer steps fused per device dispatch (lax.scan); >1 keeps "
+        "small models compute-bound instead of dispatch-bound, numerics "
+        "identical to stepwise",
     )
 
 
@@ -100,6 +107,10 @@ class Trainer:
         )
         self._maybe_load_snapshot()
         self.train_step = make_dp_train_step(dp_loss, mesh)
+        self.train_loop = (
+            make_dp_train_loop(dp_loss, mesh)
+            if config.steps_per_dispatch > 1 else None
+        )
         self.eval_step = make_dp_eval_step(dp_predict, mesh)
         self.metrics = MetricLogger()
         self.throughput = ThroughputMeter(warmup_steps=2)
@@ -145,7 +156,25 @@ class Trainer:
 
     def _run_epoch(self, epoch: int) -> dict:
         self.throughput.start()
-        for step, batch in enumerate(self.train_loader.epoch(epoch)):
+        n = self.config.steps_per_dispatch
+        start_step = 0
+        if self.train_loop is not None:
+            # Fused path: n optimizer steps per compiled dispatch.
+            groups = self.train_loader.stacked_groups(n)
+            start_step = groups * n
+            for g, batch in enumerate(
+                    self.train_loader.epoch_stacked(epoch, n)):
+                self.state, metrics = self.train_loop(self.state, *batch)
+                # stacked [n] metrics accumulate lazily; MetricLogger
+                # weights every optimizer step equally
+                self.metrics.update(**metrics)
+                self.throughput.step(n * self.train_loader.global_batch)
+                if (g * n) % self.config.log_every < n:
+                    log.info("epoch %d step %d loss %.4f", epoch,
+                             g * n + n - 1, float(metrics["loss"][-1]))
+        for step, batch in enumerate(
+                self.train_loader.epoch(epoch, start_step=start_step),
+                start=start_step):
             self.state, metrics = self.train_step(self.state, *batch)
             # device scalars accumulate lazily; the host sync happens once per
             # epoch (and at log points), not per step

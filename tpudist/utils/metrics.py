@@ -13,6 +13,7 @@ import time
 from collections import defaultdict
 
 import jax
+import numpy as np
 
 
 class Stopwatch:
@@ -83,7 +84,14 @@ class MetricLogger:
 
     def means(self) -> dict[str, float]:
         host = jax.device_get(dict(self._values))
-        return {k: float(sum(map(float, vs)) / len(vs)) for k, vs in host.items()}
+        out = {}
+        for k, vs in host.items():
+            # entries may be scalars or stacked [n]-step arrays (the fused
+            # train loop); flattening weights every step equally
+            flat = np.concatenate(
+                [np.atleast_1d(np.asarray(v, np.float64)) for v in vs])
+            out[k] = float(flat.mean())
+        return out
 
     def reset(self) -> dict[str, float]:
         out = self.means()
