@@ -49,6 +49,9 @@ def main(argv=None) -> float:
                         help="tensor-parallel shards over a model axis")
     parser.add_argument("--bf16", action="store_true",
                         help="bfloat16 compute (f32 params)")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize block activations "
+                             "(jax.checkpoint): HBM for FLOPs")
     parser.add_argument("--log-every", default=10, type=int)
     args = parser.parse_args(argv)
     if args.sp > 1 and args.tp > 1:
@@ -101,7 +104,7 @@ def main(argv=None) -> float:
         mesh = tpudist.make_mesh({"data": -1, "seq": args.sp})
         attn_fn = (ring_attention_fn("seq") if attn == "ring"
                    else ulysses_attention_fn("seq"))
-        model = TransformerLM(cfg, attention_fn=attn_fn)
+        model = TransformerLM(cfg, attention_fn=attn_fn, remat=args.remat)
         # next-token prediction with the final position masked out
         targets = jnp.concatenate(
             [tokens[:, 1:], jnp.full((args.batch_size, 1), -1, jnp.int32)], 1)
@@ -124,8 +127,10 @@ def main(argv=None) -> float:
         strategy = f"dp{mesh.shape['data']}×sp{args.sp} ({attn})"
     else:
         attn_fn = (flash_attention_fn() if attn == "flash" else None)
-        model = (TransformerLM(cfg, attention_fn=attn_fn) if attn_fn
-                 else TransformerLM(cfg))
+        from tpudist.models import sdpa
+
+        model = TransformerLM(cfg, attention_fn=attn_fn or sdpa,
+                              remat=args.remat)
 
         def loss_fn(p, batch, _rng):
             (toks,) = batch

@@ -68,3 +68,30 @@ def test_embedding_bag_classifier():
     # masked positions must not contribute: zero mask -> bias-only logits
     z = model.apply(params, idx, jnp.zeros_like(mask))
     np.testing.assert_allclose(np.asarray(z), np.asarray(z[0:1]).repeat(5, 0), rtol=1e-6)
+
+
+def test_transformer_remat_matches_plain():
+    """remat=True recomputes activations in backward; outputs and grads
+    must match the plain model exactly."""
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.ops.losses import cross_entropy
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=2,
+                            embed_dim=32, max_seq_len=16)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (2, 16)), jnp.int32)
+    plain = TransformerLM(cfg)
+    remat = TransformerLM(cfg, remat=True)
+    params = plain.init(jax.random.key(0), toks)["params"]
+
+    def loss(model, p):
+        logits = model.apply({"params": p}, toks)
+        return cross_entropy(logits[:, :-1].reshape(-1, 32),
+                             toks[:, 1:].reshape(-1))
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(plain, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), g1, g2)

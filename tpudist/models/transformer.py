@@ -156,7 +156,9 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, causal: bool = True) -> jnp.ndarray:
+        # NOTE: ``causal`` is positional (arg 2) so nn.remat can mark it
+        # static (static_argnums) — keyword args would be traced.
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln1")(x)
         x = x + CausalSelfAttention(self.cfg, self.attention_fn,
                                     decode=self.decode,
@@ -176,6 +178,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
     decode: bool = False
+    remat: bool = False
 
     @nn.compact
     def __call__(
@@ -192,9 +195,16 @@ class TransformerLM(nn.Module):
                      dtype=cfg.compute_dtype, name="tok_embed")(tokens)
         x = x + nn.Embed(cfg.max_seq_len, cfg.embed_dim,
                          dtype=cfg.compute_dtype, name="pos_embed")(positions)
+        # remat: recompute each block's activations in backward instead of
+        # storing them — the jax.checkpoint memory/FLOPs trade that makes
+        # long-context training fit in HBM.  Default prevent_cse=True:
+        # under plain jit XLA could otherwise CSE the recomputation back
+        # into the stored forward and silently undo the memory savings.
+        block_cls = (nn.remat(DecoderBlock, static_argnums=(2,))
+                     if self.remat else DecoderBlock)
         for i in range(cfg.num_layers):
-            x = DecoderBlock(cfg, self.attention_fn, decode=self.decode,
-                             name=f"block{i}")(x, causal=causal)
+            x = block_cls(cfg, self.attention_fn, decode=self.decode,
+                          name=f"block{i}")(x, causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=cfg.compute_dtype, name="lm_head")(x)
