@@ -62,7 +62,7 @@ def test_model_parallel_resnet50_twin():
     import model_parallel_resnet50_tpu
 
     results = model_parallel_resnet50_tpu.main(
-        ["--image-size", "32", "--batch-size", "4", "--num-splits", "2",
+        ["--image-size", "32", "--batch-size", "16", "--num-splits", "2",
          "--num-batches", "1", "--stages", "2"]
     )
     assert all(t > 0 for t in results.values())

@@ -17,7 +17,9 @@ import sys
 
 from tpudist.runtime.simulate import force_cpu_devices
 
-force_cpu_devices(1)  # launcher's XLA_FLAGS already fix the device count
+# check=False: the probe would initialize the backend before
+# distributed.initialize below, which jax forbids
+force_cpu_devices(1, check=False)
 import jax  # noqa: E402
 
 import numpy as np  # noqa: E402

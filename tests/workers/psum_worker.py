@@ -12,7 +12,9 @@ import sys
 
 from tpudist.runtime.simulate import force_cpu_devices
 
-force_cpu_devices(1)  # launcher's XLA_FLAGS already fix the device count
+# check=False: the probe would initialize the backend before
+# distributed.initialize below, which jax forbids
+force_cpu_devices(1, check=False)
 import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
@@ -36,7 +38,7 @@ def main() -> int:
     arr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("data")), local, (ctx.global_device_count,)
     )
-    total = float(jax.device_get(f(arr).addressable_data(0)))
+    total = float(np.asarray(jax.device_get(f(arr).addressable_data(0)))[0])
     expected = ctx.local_device_count * nprocs * (nprocs + 1) / 2
     assert total == expected, (total, expected)
 

@@ -14,8 +14,13 @@ from __future__ import annotations
 import os
 
 
-def force_cpu_devices(n: int = 8) -> None:
-    """Force the CPU platform with ``n`` simulated devices."""
+def force_cpu_devices(n: int = 8, check: bool = True) -> None:
+    """Force the CPU platform with ``n`` simulated devices.
+
+    ``check=False`` skips the device-count probe — required in processes
+    that will call ``jax.distributed.initialize`` afterwards (the probe
+    itself initializes the XLA backend, which must not happen first).
+    """
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -25,7 +30,7 @@ def force_cpu_devices(n: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    if jax.device_count() < n:
+    if check and jax.device_count() < n:
         raise RuntimeError(
             f"requested {n} simulated devices but the backend was already "
             f"initialized with {jax.device_count()}; call force_cpu_devices "
