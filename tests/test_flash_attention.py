@@ -52,6 +52,65 @@ def test_flash_gradients_match(causal):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_uneven_blocks(causal):
+    """The Pallas backward's dQ and dK/dV passes walk transposed grids;
+    block_q != block_k exercises their causal-liveness predicates."""
+    q, k, v = _qkv(b=1, s=64, h=2, d=16, seed=3)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(sdpa(q, k, v, causal=causal)))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=causal, block_q=16, block_k=32)))
+
+    ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_got in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_gradients_bfloat16():
+    """bf16 training path: backward kernels contract P/dS on the MXU in
+    bf16 with f32 accumulation, like the forward."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(b=1, s=32, h=2, d=8))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(sdpa(q, k, v, causal=True)))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=True, block_q=16, block_k=16)))
+
+    ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_got in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(
+            np.asarray(g_got, np.float32), np.asarray(g_ref, np.float32),
+            atol=5e-2, rtol=5e-2)
+
+
+def test_flash_backward_memory_is_linear():
+    """The jaxpr of the backward must not contain an [S, S]-shaped
+    intermediate — the whole point of the kernelized backward."""
+    s = 256
+    q, k, v = _qkv(b=1, s=s, h=1, d=8, seed=5)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=64, block_k=64))
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            shape = getattr(var.aval, "shape", ())
+            assert not (len(shape) >= 2 and shape[-1] == s
+                        and shape[-2] == s), (
+                f"quadratic [{s}, {s}] intermediate: {eqn.primitive}")
+
+
 def test_flash_matches_sdpa_bfloat16():
     """The three attention impls share f32 softmax statistics even when
     inputs are bf16 (sdpa uses preferred_element_type=f32)."""

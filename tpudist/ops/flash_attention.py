@@ -17,10 +17,12 @@ diagonal are skipped under ``pl.when`` — their K/V tiles are fetched by the
 grid pipeline but no FLOPs run.
 
 Training: :func:`flash_attention` carries a ``custom_vjp`` — the forward is
-the fused kernel, the backward recomputes P from the saved (q, k, v, lse)
-with the standard dS = P ∘ (dO·Vᵀ − rowsum(dO ∘ O)) identities as plain XLA
-einsums (fused well by the compiler; a dedicated backward kernel is a
-further optimisation, not a correctness need).
+the fused kernel; the backward is two more Pallas kernels (a dQ pass and a
+dK/dV pass) that recompute P block-by-block from the saved (q, k, v, lse)
+with the standard dS = P ∘ (dO·Vᵀ − rowsum(dO ∘ O)) identities, so the
+[S, S] score matrix never exists in HBM in either direction and training
+memory stays linear in sequence length.  The per-row
+Δ = rowsum(dO ∘ O) is an O(S·D) elementwise reduction left to XLA.
 
 On CPU (tests, CI) the kernel runs in interpreter mode automatically;
 numerics match :func:`tpudist.models.sdpa` to float tolerance either way.
@@ -36,6 +38,35 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
+
+
+def _block_live(qi, kj, block_q: int, block_k: int, causal: bool):
+    """Whether (q-block ``qi``, k-block ``kj``) intersects the causal
+    lower triangle; ``True`` when not causal.  Shared by the forward and
+    both backward kernels so a masking change cannot desynchronize them."""
+    return (qi + 1) * block_q > kj * block_k if causal else True
+
+
+def _causal_mask(s, qi, kj, block_q: int, block_k: int):
+    """Mask scores above the diagonal to -inf within a (qi, kj) tile."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+
+def _fuse(x):
+    """[B, S, H, D] → [B·H, S, D]: every block's minor dims become
+    (seq_block, D), the (8, 128)-tileable shape Mosaic requires."""
+    b, s, h, d = x.shape
+    return x.swapaxes(1, 2).reshape(b * h, s, d)
+
+
+def _unfuse(x, b: int, h: int):
+    """[B·H, S, D] → [B, S, H, D] (inverse of :func:`_fuse`)."""
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).swapaxes(1, 2)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -58,9 +89,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # Causal: q-blocks strictly above the diagonal contribute nothing.
-    live = (qi + 1) * block_q > kj * block_k if causal else True
-
-    @pl.when(live)
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
     def _compute():
         # Matmuls run in the input dtype (bf16 hits the MXU at full rate)
         # with float32 accumulation; only the softmax math is f32.
@@ -69,11 +98,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         m = m_scr[:]                                           # [bq, 1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
@@ -98,8 +123,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     not lowerable on real TPUs)."""
     b, s, h, d = q.shape
     num_kb = s // block_k
-    q3, k3, v3 = (
-        x.swapaxes(1, 2).reshape(b * h, s, d) for x in (q, k, v))
+    q3, k3, v3 = (_fuse(x) for x in (q, k, v))
     kernel = functools.partial(
         _flash_kernel, scale=d ** -0.5, causal=causal,
         block_q=block_q, block_k=block_k, num_kb=num_kb)
@@ -128,8 +152,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
-    out = out.reshape(b, h, s, d).swapaxes(1, 2)
-    return out, lse.reshape(b, h, s)
+    return _unfuse(out, b, h), lse.reshape(b, h, s)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -143,24 +166,137 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, *,
+               scale, causal, block_q, block_k):
+    """Shared per-(q-block, k-block) backward math: recompute P from the
+    saved log-sum-exp, then ds = P ∘ (dO·Vᵀ − Δ).  Returns (p, ds) in
+    float32; callers contract them onto the MXU in the input dtype."""
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, qi, kj, block_q, block_k)
+    p = jnp.exp(s - lse_col)                               # masked → 0
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_col)
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale: float, causal: bool,
+                         block_q: int, block_k: int, num_kb: int):
+    """Grid (B·H, q-block, k-block); K innermost/sequential accumulates
+    dQ = scale · Σ_k dS·K in a VMEM scratch."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
+    def _compute():
+        q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        _, ds = _bwd_block(
+            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                          causal: bool, block_q: int, block_k: int,
+                          num_qb: int):
+    """Grid (B·H, k-block, q-block); Q innermost/sequential accumulates
+    dK = scale · Σ_q dSᵀ·Q and dV = Σ_q Pᵀ·dO in VMEM scratches."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
+    def _compute():
+        q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p, ds = _bwd_block(
+            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
-    scale = q.shape[-1] ** -0.5
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    of, dof = out.astype(jnp.float32), dout.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if causal:
-        s_q, s_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
-        s = jnp.where(mask, s, -jnp.inf)
-    p = jnp.exp(s - lse[..., None])                       # [B,H,Sq,Sk]
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
-    delta = jnp.sum(dof * of, axis=-1).transpose(0, 2, 1)  # [B,H,Sq]
-    ds = p * (dp - delta[..., None])
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    b, s, h, d = q.shape
+    scale = d ** -0.5
+    num_qb, num_kb = s // block_q, s // block_k
+    q3, k3, v3, do3, o3 = (_fuse(x) for x in (q, k, v, dout, out))
+    lse3 = lse.reshape(b * h, 1, s)
+    delta3 = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    ).reshape(b * h, 1, s)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g, 0, i))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0))
+    # dK/dV pass walks the transposed grid (k-block major, q-block minor).
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0))
+    row_spec_t = pl.BlockSpec((1, 1, block_q), lambda g, j, i: (g, 0, i))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0))
+    semantics = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kb=num_kb),
+        grid=(b * h, num_qb, num_kb),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=semantics,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_qb=num_qb),
+        grid=(b * h, num_kb, num_qb),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+                  row_spec_t, row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=semantics,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return _unfuse(dq, b, h), _unfuse(dk, b, h), _unfuse(dv, b, h)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
