@@ -26,6 +26,8 @@ from tpudist.parallel.fsdp import (
     make_fsdp_train_step,
 )
 from tpudist.parallel.pipeline import (
+    interleave_params,
+    make_interleaved_pipeline_train_step,
     make_pipeline_forward,
     make_pipeline_train_step,
     make_stacked_pipeline_train_step,
@@ -73,6 +75,8 @@ __all__ = [
     "make_dp_eval_step",
     "make_dp_train_loop",
     "make_dp_train_step",
+    "interleave_params",
+    "make_interleaved_pipeline_train_step",
     "make_pipeline_forward",
     "make_pipeline_train_step",
     "make_ps_hybrid_forward",
