@@ -42,7 +42,8 @@ def main(argv=None) -> float:
     parser.add_argument("--vocab", default=256, type=int)
     parser.add_argument("--lr", default=3e-4, type=float)
     parser.add_argument("--attn", default="auto",
-                        choices=["auto", "flash", "sdpa", "ring", "ulysses"])
+                        choices=["auto", "flash", "sdpa", "ring",
+                                 "ring_flash", "ulysses"])
     parser.add_argument("--sp", default=0, type=int,
                         help="sequence shards (>1 selects ring/ulysses)")
     parser.add_argument("--tp", default=0, type=int,
@@ -70,6 +71,7 @@ def main(argv=None) -> float:
     from tpudist.parallel.ring_attention import (
         make_sp_train_step,
         ring_attention_fn,
+        ring_flash_attention_fn,
         ulysses_attention_fn,
     )
     from tpudist.parallel.tensor_parallel import (
@@ -81,11 +83,11 @@ def main(argv=None) -> float:
 
     attn = args.attn
     if attn == "auto":
-        attn = ("ring" if args.sp > 1
+        attn = ("ring_flash" if args.sp > 1
                 else "flash" if jax.default_backend() == "tpu" else "sdpa")
-    if args.sp > 1 and attn not in ("ring", "ulysses"):
-        parser.error(f"--sp needs ring/ulysses attention, got {attn}")
-    if attn in ("ring", "ulysses") and args.sp <= 1:
+    if args.sp > 1 and attn not in ("ring", "ring_flash", "ulysses"):
+        parser.error(f"--sp needs ring/ring_flash/ulysses attention, got {attn}")
+    if attn in ("ring", "ring_flash", "ulysses") and args.sp <= 1:
         parser.error(f"--attn {attn} is sequence-parallel; pass --sp N (N>1)")
 
     cfg = TransformerConfig(
@@ -103,6 +105,7 @@ def main(argv=None) -> float:
     if args.sp > 1:
         mesh = tpudist.make_mesh({"data": -1, "seq": args.sp})
         attn_fn = (ring_attention_fn("seq") if attn == "ring"
+                   else ring_flash_attention_fn("seq") if attn == "ring_flash"
                    else ulysses_attention_fn("seq"))
         model = TransformerLM(cfg, attention_fn=attn_fn, remat=args.remat)
         # next-token prediction with the final position masked out

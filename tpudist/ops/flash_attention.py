@@ -40,18 +40,22 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_BIG = -1e30
 
 
-def _block_live(qi, kj, block_q: int, block_k: int, causal: bool):
+def _block_live(qi, kj, block_q: int, block_k: int, causal: bool, q0, k0):
     """Whether (q-block ``qi``, k-block ``kj``) intersects the causal
-    lower triangle; ``True`` when not causal.  Shared by the forward and
+    lower triangle; ``True`` when not causal.  ``q0``/``k0`` are global
+    position offsets (ring attention rotates K/V blocks, so a block's
+    global span is offset + local index).  Shared by the forward and
     both backward kernels so a masking change cannot desynchronize them."""
-    return (qi + 1) * block_q > kj * block_k if causal else True
+    if not causal:
+        return True
+    return q0 + (qi + 1) * block_q > k0 + kj * block_k
 
 
-def _causal_mask(s, qi, kj, block_q: int, block_k: int):
-    """Mask scores above the diagonal to -inf within a (qi, kj) tile."""
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+def _causal_mask(s, qi, kj, block_q: int, block_k: int, q0, k0):
+    """Mask scores above the (global) diagonal to -inf within a tile."""
+    q_pos = q0 + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+    k_pos = k0 + kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     return jnp.where(q_pos >= k_pos, s, -jnp.inf)
 
@@ -69,7 +73,8 @@ def _unfuse(x, b: int, h: int):
     return x.reshape(b, h, s, d).swapaxes(1, 2)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
                   num_kb: int):
     """One (batch·head, q-block, k-block) grid step on the fused
@@ -81,6 +86,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     tile is resident at a time.
     """
     qi, kj = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
     @pl.when(kj == 0)
     def _init():
@@ -89,7 +95,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # Causal: q-blocks strictly above the diagonal contribute nothing.
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
     def _compute():
         # Matmuls run in the input dtype (bf16 hits the MXU at full rate)
         # with float32 accumulation; only the softmax math is f32.
@@ -98,7 +104,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0)
         m = m_scr[:]                                           # [bq, 1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
@@ -116,13 +122,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = (m_scr[:] + jnp.log(l)).T  # [1, bq]
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+def _offsets_arg(q_offset, k_offset):
+    """(1, 2) int32 SMEM operand carrying the global position offsets;
+    zeros in the plain (non-ring) path."""
+    return jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    ).reshape(1, 2)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                   q_offset=0, k_offset=0):
     """[B, S, H, D] in; internally runs on a fused [B·H, S, D] layout so
     every block's minor two dims are (seq_block, D) — the (8, 128)-tileable
     shape Mosaic requires (an [.., S, H, ..] block with a size-1 H slice is
-    not lowerable on real TPUs)."""
+    not lowerable on real TPUs).  ``q_offset``/``k_offset`` shift the causal
+    mask to global positions (ring attention)."""
     b, s, h, d = q.shape
-    num_kb = s // block_k
+    sk = k.shape[1]
+    num_kb = sk // block_k
     q3, k3, v3 = (_fuse(x) for x in (q, k, v))
     kernel = functools.partial(
         _flash_kernel, scale=d ** -0.5, causal=causal,
@@ -131,6 +152,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         kernel,
         grid=(b * h, s // block_q, num_kb),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
@@ -151,7 +173,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(_offsets_arg(q_offset, k_offset), q3, k3, v3)
     return _unfuse(out, b, h), lse.reshape(b, h, s)
 
 
@@ -166,7 +188,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, *,
+def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, q0, k0, *,
                scale, causal, block_q, block_k):
     """Shared per-(q-block, k-block) backward math: recompute P from the
     saved log-sum-exp, then ds = P ∘ (dO·Vᵀ − Δ).  Returns (p, ds) in
@@ -175,7 +197,7 @@ def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, *,
         q, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
-        s = _causal_mask(s, qi, kj, block_q, block_k)
+        s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0)
     p = jnp.exp(s - lse_col)                               # masked → 0
     dp = jax.lax.dot_general(
         do, vb, (((1,), (1,)), ((), ())),
@@ -184,22 +206,24 @@ def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, *,
     return p, ds
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, scale: float, causal: bool,
-                         block_q: int, block_k: int, num_kb: int):
+def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, dq_scr, *, scale: float,
+                         causal: bool, block_q: int, block_k: int,
+                         num_kb: int):
     """Grid (B·H, q-block, k-block); K innermost/sequential accumulates
     dQ = scale · Σ_k dS·K in a VMEM scratch."""
     qi, kj = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
     @pl.when(kj == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
     def _compute():
         q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         _, ds = _bwd_block(
-            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj,
+            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj, q0, k0,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), kb, (((1,), (0,)), ((), ())),
@@ -210,24 +234,25 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                          causal: bool, block_q: int, block_k: int,
-                          num_qb: int):
+def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale: float, causal: bool, block_q: int,
+                          block_k: int, num_qb: int):
     """Grid (B·H, k-block, q-block); Q innermost/sequential accumulates
     dK = scale · Σ_q dSᵀ·Q and dV = Σ_q Pᵀ·dO in VMEM scratches."""
     kj, qi = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal))
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
     def _compute():
         q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _bwd_block(
-            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj,
+            q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj, q0, k0,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -242,16 +267,23 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
-    q, k, v, out, lse = res
+def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
+                      block_k, interpret, q_offset=0, k_offset=0):
+    """(dQ, dK, dV) of one attention block given the FINAL softmax
+    statistics ``lse``/``delta`` (shapes [B, H, S]).
+
+    The flash backward identities hold per K/V block when P is computed
+    against the final log-sum-exp, which is what makes the ring backward a
+    sum of per-block kernel calls (`parallel/ring_attention.py`); the
+    plain backward below is the single-block case with zero offsets.
+    """
     b, s, h, d = q.shape
+    sk = k.shape[1]
     scale = d ** -0.5
-    num_qb, num_kb = s // block_q, s // block_k
-    q3, k3, v3, do3, o3 = (_fuse(x) for x in (q, k, v, dout, out))
+    num_qb, num_kb = s // block_q, sk // block_k
+    q3, k3, v3, do3 = (_fuse(x) for x in (q, k, v, dout))
     lse3 = lse.reshape(b * h, 1, s)
-    delta3 = jnp.sum(
-        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
-    ).reshape(b * h, 1, s)
+    delta3 = delta.reshape(b * h, 1, s)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g, 0, i))
@@ -263,30 +295,32 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
+    offs = _offsets_arg(q_offset, k_offset)
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kb=num_kb),
         grid=(b * h, num_qb, num_kb),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                  row_spec, row_spec],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=semantics,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)[0]
+    )(offs, q3, k3, v3, do3, lse3, delta3)[0]
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_qb=num_qb),
         grid=(b * h, num_kb, num_qb),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+        in_specs=[_smem_spec(), q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
                   row_spec_t, row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -294,9 +328,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
         ],
         compiler_params=semantics,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(offs, q3, k3, v3, do3, lse3, delta3)
 
     return _unfuse(dq, b, h), _unfuse(dk, b, h), _unfuse(dv, b, h)
+
+
+def flash_delta(out, dout):
+    """Δ = rowsum(dO ∘ O) per query position, as [B, H, S] float32."""
+    return jnp.sum(
+        out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    return flash_block_grads(
+        q, k, v, dout, lse, flash_delta(out, dout),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

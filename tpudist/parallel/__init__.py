@@ -42,6 +42,7 @@ from tpudist.parallel.ps_hybrid import (
 from tpudist.parallel.ring_attention import (
     make_sp_train_step,
     ring_attention_fn,
+    ring_flash_attention_fn,
     sp_forward,
     ulysses_attention_fn,
 )
@@ -66,6 +67,7 @@ __all__ = [
     "make_spmd_train_step",
     "make_tp_state",
     "ring_attention_fn",
+    "ring_flash_attention_fn",
     "sp_forward",
     "ulysses_attention_fn",
     "shard_batch",

@@ -6,7 +6,7 @@ workload whose communication pattern the reference's point-to-point RPC
 transport (`model_parallel_ResNet50.py:173-174`) would have needed at scale,
 re-expressed over ICI.
 
-Two interchangeable strategies, both plugging into
+Three interchangeable strategies, all plugging into
 :class:`tpudist.models.TransformerLM` via its ``attention_fn`` hook:
 
 * :func:`ring_attention_fn` — blockwise attention with **online softmax**
@@ -19,6 +19,12 @@ Two interchangeable strategies, both plugging into
   axis: [B, S/n, H, D] → [B, S, H/n, D] (full sequence, head subset), plain
   attention, swap back.  Cheaper collectives on small meshes; requires
   ``num_heads % axis_size == 0``.
+* :func:`ring_flash_attention_fn` — the ring recurrence with the per-block
+  compute done by the Pallas flash kernels (`tpudist.ops.flash_attention`)
+  instead of materialized [S/n, S/n] logits, plus a ring-level
+  ``custom_vjp`` whose backward rotates dK/dV accumulators with their
+  blocks.  The production long-context path: linear memory per device in
+  both directions.
 
 Both match :func:`tpudist.models.sdpa` bit-for-bit up to float tolerance —
 tested against it in ``tests/test_ring_attention.py``.
@@ -29,6 +35,7 @@ Use inside any ``shard_map`` whose in_specs shard the sequence dimension;
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -36,6 +43,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpudist.ops.flash_attention import (
+    _auto_block,
+    _flash_forward,
+    flash_block_grads,
+    flash_delta,
+)
 from tpudist.parallel.common import jit_sharded_step
 
 _NEG_BIG = -1e30  # finite stand-in for -inf: keeps the online-softmax
@@ -190,3 +203,125 @@ def sp_forward(
         P(data_axis, seq_axis),
         donate_first=False,
     )
+
+
+# --------------------------------------------------------------------------
+# Ring flash attention: the ring recurrence with Pallas kernels per block
+# --------------------------------------------------------------------------
+
+def _rowstat_to_bshd(c: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, S] row statistic → [B, S, H, 1] for broadcasting over D."""
+    return c.transpose(0, 2, 1)[..., None]
+
+
+def _ring_perm(n: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_flash_fwd_impl(q, k, v, causal, axis_name, block_q, block_k,
+                         interpret):
+    """Rotate K/V blocks around the ring; each step runs the Pallas flash
+    forward on the resident block with GLOBAL position offsets (the kernel
+    masks and skips dead tiles itself), then merges (out, lse) pairs with
+    the log-sum-exp identity.  Returns (out, final lse)."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), _NEG_BIG, jnp.float32)
+    perm = _ring_perm(n)
+
+    def body(carry, _):
+        kb, vb, src, o, lse = carry
+        ob, lse_b = _flash_forward(
+            q, kb, vb, causal, block_q, block_k, interpret,
+            q_offset=my * s_loc, k_offset=src * s_loc)
+        new_lse = jnp.logaddexp(lse, lse_b)
+        o = (o * _rowstat_to_bshd(jnp.exp(lse - new_lse))
+             + ob.astype(jnp.float32)
+             * _rowstat_to_bshd(jnp.exp(lse_b - new_lse)))
+        kb, vb = lax.ppermute((kb, vb), axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (kb, vb, src, o, new_lse), None
+
+    (_, _, _, o, lse), _ = lax.scan(body, (k, v, my, o0, lse0), None, length=n)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd_impl(
+        q, k, v, causal, axis_name, block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(
+        q, k, v, causal, axis_name, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res,
+                    dout):
+    """Backward ring: dK/dV accumulators travel WITH their K/V blocks (one
+    full loop lands them back on the owner), dQ accumulates locally.  Each
+    step is the Pallas flash backward on the resident block, valid per
+    block because P is recomputed against the FINAL lse."""
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_loc = q.shape[1]
+    delta = flash_delta(out, dout)
+    perm = _ring_perm(n)
+
+    def body(carry, _):
+        kb, vb, dk, dv, src, dq = carry
+        dq_b, dk_b, dv_b = flash_block_grads(
+            q, kb, vb, dout, lse, delta,
+            causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret, q_offset=my * s_loc, k_offset=src * s_loc)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk = dk + dk_b.astype(jnp.float32)
+        dv = dv + dv_b.astype(jnp.float32)
+        kb, vb, dk, dv = lax.ppermute((kb, vb, dk, dv), axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (kb, vb, dk, dv, src, dq), None
+
+    init = (k, v, jnp.zeros(k.shape, jnp.float32),
+            jnp.zeros(v.shape, jnp.float32), my,
+            jnp.zeros(q.shape, jnp.float32))
+    (_, _, dk, dv, _, dq), _ = lax.scan(body, init, None, length=n)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention_fn(
+    axis_name: str = "seq",
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> Callable:
+    """:func:`ring_attention_fn` with the per-block compute done by the
+    Pallas flash kernels instead of materialized [S/n, S/n] logits: VMEM
+    blocking within a device, ``ppermute`` ring across devices — the same
+    online-softmax recurrence at both levels.  Gradients run a second ring
+    (dK/dV ride the rotating blocks home); memory stays linear in S on
+    every device in both directions."""
+
+    def attend(q, k, v, *, causal: bool = True):
+        s_loc = q.shape[1]
+        bq = _auto_block(s_loc) if block_q is None else min(block_q, s_loc)
+        bk = _auto_block(s_loc) if block_k is None else min(block_k, s_loc)
+        if s_loc % bq or s_loc % bk:
+            raise ValueError(
+                f"block sizes ({bq}, {bk}) must divide the local "
+                f"sequence length {s_loc}")
+        itp = (
+            (jax.default_backend() == "cpu") if interpret is None
+            else interpret
+        )
+        return _ring_flash(q, k, v, causal, axis_name, bq, bk, itp)
+
+    return attend
