@@ -71,3 +71,40 @@ def test_elastic_checkpoint_resume_across_gang_restart(tmp_path):
     assert (tmp_path / "start_attempt1.txt").read_text() == "10"  # resumed
     final_steps, final_loss = (tmp_path / "final.txt").read_text().split()
     assert final_steps == "20" and float(final_loss) < 3.0
+
+
+def test_elastic_shrink_to_min_nprocs(tmp_path):
+    """horovodrun --min-np semantics: a world that only works at size <= 2
+    shrinks 3 -> 2 across one restart and then succeeds."""
+    rc = launch(
+        [sys.executable, WORKER], nprocs=3, max_restarts=2, min_nprocs=2,
+        env={"WORKER_OUT_DIR": str(tmp_path), "WORKER_FAIL_IF_WORLD_GT": "2"},
+        restart_cooldown=0.01,
+    )
+    assert rc == 0
+    # The psum total encodes the world size: 2*(2+1)/2 = 3 proves the final
+    # successful attempt ran at world 2 (earlier attempts' survivors may
+    # have left files from the bigger world behind).
+    for r in (0, 1):
+        assert (tmp_path / f"rank{r}.txt").read_text().strip() == "3.0"
+
+
+def test_elastic_discovery_sets_world_size(tmp_path):
+    """--host-discovery-script semantics: the discovery command's stdout
+    drives the restart world size directly (4 -> 2 in one hop, skipping 3,
+    which would still fail)."""
+    rc = launch(
+        [sys.executable, WORKER], nprocs=4, max_restarts=1, min_nprocs=2,
+        discover_cmd=f'"{sys.executable}" -c "print(2)"',
+        env={"WORKER_OUT_DIR": str(tmp_path), "WORKER_FAIL_IF_WORLD_GT": "2"},
+    )
+    assert rc == 0
+    # world jumped 4 -> 2 in ONE restart (max_restarts=1): only discovery
+    # could have picked 2 directly; psum total 3.0 proves world 2.
+    for r in (0, 1):
+        assert (tmp_path / f"rank{r}.txt").read_text().strip() == "3.0"
+
+
+def test_min_nprocs_above_nprocs_rejected():
+    with pytest.raises(ValueError, match="must not exceed"):
+        launch([sys.executable, WORKER], nprocs=2, min_nprocs=4)

@@ -48,6 +48,13 @@ def main() -> int:
         print("worker 0 injecting failure", flush=True)
         return 17
 
+    # Optional: a world that only works below a size threshold — exercises
+    # the launcher's elastic shrink (min_nprocs / discover_cmd).
+    limit = os.environ.get("WORKER_FAIL_IF_WORLD_GT")
+    if limit and nprocs > int(limit) and ctx.process_index == nprocs - 1:
+        print(f"worker {ctx.process_index}: world {nprocs} too big", flush=True)
+        return 13
+
     out_dir = os.environ.get("WORKER_OUT_DIR")
     if out_dir:
         with open(os.path.join(out_dir, f"rank{ctx.process_index}.txt"), "w") as fh:

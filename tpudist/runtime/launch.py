@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -45,6 +46,24 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _discover_world_size(discover_cmd: str, current: int, lo: int,
+                         hi: int) -> int:
+    """Run the discovery command; its stdout (an int) is the next world
+    size, clamped to [lo, hi].  Failures keep the current size (a broken
+    discovery script must not take the job down)."""
+    try:
+        out = subprocess.run(
+            discover_cmd, shell=True, capture_output=True, text=True,
+            timeout=30, check=True,
+        ).stdout.strip()
+        return min(hi, max(lo, int(out.splitlines()[-1])))
+    except Exception as e:  # noqa: BLE001 - discovery is advisory
+        stderr = getattr(e, "stderr", None)
+        log.warning("discovery command failed (%s)%s; keeping world=%d",
+                    e, f": {stderr.strip()}" if stderr else "", current)
+        return current
+
+
 def launch(
     cmd: list[str],
     nprocs: int,
@@ -53,9 +72,29 @@ def launch(
     platform: str = "cpu",
     devices_per_proc: int = 1,
     coord_server: bool = True,
+    min_nprocs: int | None = None,
+    restart_cooldown: tuple[float, float] | float | None = None,
+    discover_cmd: str | None = None,
 ) -> int:
     """Run ``cmd`` as an ``nprocs``-process gang; returns the gang's exit
-    code (0 only if every worker of some attempt exited 0)."""
+    code (0 only if every worker of some attempt exited 0).
+
+    Elastic restarts (the ``horovodrun --min-np/--host-discovery-script/
+    --blacklist-cooldown-range`` surface, `horovod_mnist_elastic.py:108`):
+
+    * ``min_nprocs`` — after a failed attempt the gang restarts one worker
+      SMALLER (a persistently failing member is dropped, Horovod's
+      blacklist effect), never below this floor; workers see the new size
+      in ``TPUDIST_NUM_PROCESSES`` and rescale via their reset callbacks.
+    * ``discover_cmd`` — shell command run before each restart whose stdout
+      (an integer) sets the next world size, clamped to
+      ``[min_nprocs or 1, nprocs]`` (≙ ``--host-discovery-script``).
+    * ``restart_cooldown`` — seconds (or a ``(lo, hi)`` range sampled
+      uniformly) to wait before each restart (≙ the blacklist cooldown).
+    """
+    if min_nprocs is not None and min_nprocs > nprocs:
+        raise ValueError(
+            f"min_nprocs ({min_nprocs}) must not exceed nprocs ({nprocs})")
     server = None
     base_env = dict(os.environ)
     # Workers must resolve the same tpudist the launcher runs from, however
@@ -75,15 +114,27 @@ def launch(
         except Exception as e:  # noqa: BLE001 - control plane is optional
             log.warning("coordination server unavailable (%s); continuing", e)
 
+    world = nprocs
+    floor = max(1, min_nprocs) if min_nprocs else None
     try:
         for attempt in range(max_restarts + 1):
+            if attempt > 0:
+                if restart_cooldown is not None:
+                    lo, hi = (restart_cooldown if isinstance(
+                        restart_cooldown, tuple) else (restart_cooldown,) * 2)
+                    time.sleep(random.uniform(lo, hi))
+                if discover_cmd is not None:
+                    world = _discover_world_size(
+                        discover_cmd, world, floor or 1, nprocs)
+                elif floor is not None:
+                    world = max(floor, world - 1)
             coordinator = f"127.0.0.1:{_free_port()}"
             procs: list[subprocess.Popen] = []
-            for rank in range(nprocs):
+            for rank in range(world):
                 wenv = dict(base_env)
                 wenv.update({
                     "TPUDIST_COORDINATOR": coordinator,
-                    "TPUDIST_NUM_PROCESSES": str(nprocs),
+                    "TPUDIST_NUM_PROCESSES": str(world),
                     "TPUDIST_PROCESS_ID": str(rank),
                     "TPUDIST_LOCAL_RANK": str(rank),
                     "TPUDIST_RESTART_ATTEMPT": str(attempt),
@@ -160,6 +211,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="JAX_PLATFORMS for workers ('' = inherit)")
     ap.add_argument("--devices-per-proc", type=int, default=1,
                     help="simulated CPU devices per worker")
+    ap.add_argument("--min-nprocs", type=int, default=None,
+                    help="shrink the gang toward this floor on repeated "
+                         "failure (horovodrun --min-np semantics)")
+    ap.add_argument("--restart-cooldown", default=None,
+                    help="seconds before each restart, or LO:HI range "
+                         "(horovodrun --blacklist-cooldown-range)")
+    ap.add_argument("--discover-cmd", default=None,
+                    help="shell command printing the next world size "
+                         "(horovodrun --host-discovery-script)")
     ap.add_argument("--no-coord", action="store_true",
                     help="skip the native coordination server")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -170,10 +230,24 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("missing worker command")
     if cmd[0].endswith(".py"):
         cmd = [sys.executable, *cmd]
+    cooldown = None
+    if args.restart_cooldown is not None:
+        try:
+            parts = [float(v) for v in str(args.restart_cooldown).split(":")]
+        except ValueError:
+            ap.error(f"--restart-cooldown must be SECONDS or LO:HI, got "
+                     f"{args.restart_cooldown!r}")
+        if any(p < 0 for p in parts):
+            ap.error("--restart-cooldown values must be non-negative")
+        cooldown = (parts[0], parts[-1]) if len(parts) > 1 else parts[0]
+    if args.min_nprocs is not None and args.min_nprocs > args.nprocs:
+        ap.error(f"--min-nprocs ({args.min_nprocs}) must not exceed "
+                 f"-n ({args.nprocs})")
     return launch(
         cmd, args.nprocs, max_restarts=args.max_restarts,
         platform=args.platform, devices_per_proc=args.devices_per_proc,
-        coord_server=not args.no_coord,
+        coord_server=not args.no_coord, min_nprocs=args.min_nprocs,
+        restart_cooldown=cooldown, discover_cmd=args.discover_cmd,
     )
 
 
