@@ -1,0 +1,105 @@
+"""OrbaxCheckpointer: sharding-aware durable commits with the same
+interface as the npz Checkpointer (multi-host story on one machine)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudist.elastic import HAVE_ORBAX, ElasticState, OrbaxCheckpointer
+from tpudist.runtime.mesh import make_mesh
+from tpudist.train.state import TrainState
+
+pytestmark = pytest.mark.skipif(not HAVE_ORBAX, reason="orbax unavailable")
+
+
+def _sharded_state(devices8):
+    mesh = make_mesh({"data": 8}, devices8)
+    params = {
+        "w": jax.device_put(
+            jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+            NamedSharding(mesh, P("data"))),
+        "b": jax.device_put(jnp.ones((4,)), NamedSharding(mesh, P())),
+    }
+    return TrainState.create(None, params, optax.sgd(0.1))
+
+
+def test_save_restore_roundtrip_sharded(tmp_path, devices8):
+    state = _sharded_state(devices8)
+    ckpt = OrbaxCheckpointer(tmp_path / "ckpt", keep=2)
+    ckpt.save(3, state, meta={"epoch": 1, "batch": 30})
+    ckpt.wait()
+
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+    got = OrbaxCheckpointer(tmp_path / "ckpt").restore_latest(template)
+    assert got is not None
+    step, tree, meta = got
+    assert step == 3
+    assert meta == {"epoch": 1, "batch": 30}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), tree.params, state.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), tree.opt_state, state.opt_state)
+    # restore honored the template shardings
+    assert tree.params["w"].sharding.spec == P("data")
+
+
+def test_retention_keeps_latest(tmp_path, devices8):
+    state = _sharded_state(devices8)
+    ckpt = OrbaxCheckpointer(tmp_path / "ckpt", keep=2)
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, state)
+    ckpt.wait()
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+    step, _, _ = ckpt.restore_latest(template)
+    assert step == 4
+    steps = sorted(int(p.name) for p in (tmp_path / "ckpt").iterdir()
+                   if p.name.isdigit())
+    assert steps == [3, 4]
+
+
+def test_elastic_state_commit_with_orbax(tmp_path, devices8):
+    """ElasticState durable commits work identically through orbax."""
+    state = _sharded_state(devices8)
+    ckpt = OrbaxCheckpointer(tmp_path / "ckpt", keep=3, async_save=True)
+    es = ElasticState(state, checkpointer=ckpt)
+    es.host.epoch, es.host.batch = 2, 60
+    es.commit()
+    ckpt.wait()
+
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+    restored = OrbaxCheckpointer(tmp_path / "ckpt").restore_latest(template)
+    assert restored is not None
+    _, tree, meta = restored
+    assert meta.get("epoch") == 2 and meta.get("batch") == 60
+    np.testing.assert_array_equal(
+        np.asarray(tree.params["w"]), np.asarray(state.params["w"]))
+
+
+def test_same_and_regressing_steps_never_dropped(tmp_path, devices8):
+    """Repeated or regressing step numbers (fresh ElasticState after a gang
+    restart) must still produce durable commits — orbax would silently skip
+    them; the wrapper maps collisions to monotonic physical steps while
+    reporting the caller's step back on restore."""
+    state = _sharded_state(devices8)
+    ckpt = OrbaxCheckpointer(tmp_path / "ckpt", keep=5)
+    ckpt.save(7, state, meta={"tag": "a"})
+    ckpt.save(7, state, meta={"tag": "b"})   # same step: elastic re-commit
+    ckpt.save(2, state, meta={"tag": "c"})   # regression: post-restart world
+    ckpt.wait()
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+    step, _, meta = OrbaxCheckpointer(tmp_path / "ckpt").restore_latest(template)
+    assert meta == {"tag": "c"}   # newest durable commit wins
+    assert step == 2              # caller-visible (logical) step

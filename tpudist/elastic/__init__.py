@@ -19,8 +19,20 @@ from tpudist.elastic.checkpoint import (
 from tpudist.elastic.state import ElasticState, HostDataState
 from tpudist.elastic.loop import WorldChanged, WorkerFailure, elastic_run
 
+
+def __getattr__(name):
+    # Lazy (PEP 562): orbax pulls in tensorstore etc. (~seconds of import),
+    # a cost every launcher-spawned worker on the npz path would pay.
+    if name in ("HAVE_ORBAX", "OrbaxCheckpointer"):
+        from tpudist.elastic import orbax_ckpt
+
+        return getattr(orbax_ckpt, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "Checkpointer",
+    "HAVE_ORBAX",
+    "OrbaxCheckpointer",
     "ElasticState",
     "HostDataState",
     "WorkerFailure",

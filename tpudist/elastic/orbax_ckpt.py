@@ -1,0 +1,105 @@
+"""Orbax-backed checkpointing: the multi-host durable-commit path.
+
+The npz :class:`tpudist.elastic.checkpoint.Checkpointer` gathers every leaf
+to one host — exactly what the reference's ``torch.save`` snapshot does
+(`mnist_ddp_elastic.py:95-104`) and fine on one machine, but wrong at pod
+scale where params are sharded across hosts.  :class:`OrbaxCheckpointer`
+exposes the SAME interface (``save(step, tree, meta)`` /
+``restore_latest(template)`` / ``wait()``) on top of
+``orbax.checkpoint.CheckpointManager``, which writes each host's shards in
+parallel (distributed, sharding-aware save/restore), checkpoints
+atomically, retains ``keep`` steps, and overlaps saves with training when
+``async_save=True`` — so elastic commits (`tpudist.elastic.state`) scale
+from one chip to a multi-host slice by swapping the checkpointer.
+
+Restore honors the template's shardings: pass a state whose leaves are
+jax.Arrays (or ShapeDtypeStructs with shardings) and each host reloads
+only its shards.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any
+
+from tpudist.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_LOGICAL_KEY = "__logical_step__"
+
+try:  # orbax is in the image; guard anyway so npz remains self-sufficient
+    import orbax.checkpoint as ocp
+
+    HAVE_ORBAX = True
+except Exception:  # pragma: no cover - exercised only without orbax
+    ocp = None
+    HAVE_ORBAX = False
+
+
+class OrbaxCheckpointer:
+    """Drop-in :class:`tpudist.elastic.checkpoint.Checkpointer` alternative
+    backed by ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str | os.PathLike, keep: int = 3,
+                 async_save: bool = False) -> None:
+        if not HAVE_ORBAX:  # pragma: no cover
+            raise ImportError(
+                "orbax-checkpoint is unavailable; use "
+                "tpudist.elastic.checkpoint.Checkpointer instead")
+        self.directory = Path(directory).absolute()
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep or None,
+                enable_async_checkpointing=async_save,
+                create=True,
+            ),
+        )
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def save(self, step: int, tree: Any, meta: dict | None = None) -> None:
+        # npz-Checkpointer semantics: every save lands, even when the step
+        # number repeats (elastic commits between optimizer steps) or
+        # regresses (a fresh ElasticState after a gang restart counts
+        # commits from 1).  Orbax would silently skip step <= latest and
+        # deleting-then-rewriting would break crash atomicity, so a
+        # colliding step is written as ``latest + 1`` with the caller's
+        # step preserved in the metadata — saves stay atomic (new
+        # directory + rename) and no durable commit is ever dropped.
+        latest = self._mngr.latest_step()
+        physical = step if latest is None or step > latest else latest + 1
+        saved = self._mngr.save(
+            physical,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(tree),
+                meta=ocp.args.JsonSave({**(meta or {}), _LOGICAL_KEY: step}),
+            ),
+        )
+        if not saved:  # pragma: no cover - monotonic steps always save
+            raise RuntimeError(
+                f"orbax skipped checkpoint save at step {physical}")
+
+    def restore_latest(self, template: Any) -> tuple[int, Any, dict] | None:
+        """Return ``(step, tree, meta)`` for the newest complete checkpoint
+        (sharded per the template's leaves), or None on a fresh start."""
+        self.wait()
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        meta = dict(restored["meta"] or {})
+        step = meta.pop(_LOGICAL_KEY, step)
+        return step, restored["state"], meta
+
+    def close(self) -> None:
+        self._mngr.close()
