@@ -157,3 +157,63 @@ def test_auto_block_defaults():
     got = flash_attention(q, k, v, causal=True)  # defaults, interpret on CPU
     want = sdpa(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("h,h_kv", [(4, 2), (4, 1), (6, 3)])
+def test_flash_gqa_matches_sdpa(causal, h, h_kv):
+    """Grouped-query attention: K/V carry fewer heads; the kernel resolves
+    the head group in its index maps (no expansion)."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 32, h, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 32, h_kv, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 32, h_kv, 8)), jnp.float32)
+    want = sdpa(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_gradients_match(causal):
+    """GQA backward: dK/dV must sum over each KV head's query group (the
+    expanded inner grid of the dkv kernel)."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(sdpa(q, k, v, causal=causal)))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=causal, block_q=8, block_k=16)))
+
+    ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_got in zip(ref_grads, got_grads):
+        assert g_ref.shape == g_got.shape
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_gqa_rejects_non_multiple_heads():
+    q, k, v = (jnp.zeros((1, 16, h, 8)) for h in (4, 3, 3))
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, v)
+
+
+def test_transformer_gqa_with_flash_matches_sdpa_model():
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (2, 32)), jnp.int32)
+    ref = TransformerLM(cfg)
+    params = ref.init(jax.random.key(0), tokens)["params"]
+    want = ref.apply({"params": params}, tokens)
+    flash_model = TransformerLM(
+        cfg, attention_fn=flash_attention_fn(block_q=8, block_k=8))
+    got = flash_model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)

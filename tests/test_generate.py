@@ -50,3 +50,32 @@ def test_generate_rejects_overlong_rollout():
     except ValueError as e:
         raised = "max_seq_len" in str(e)
     assert raised
+
+
+def test_generate_gqa_cache_is_grouped():
+    """GQA decode: the KV cache is allocated at num_kv_heads (the memory
+    win), and greedy decode matches the full-context forward argmax."""
+    import numpy as np
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.models.generate import greedy_generate
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=16)
+    model = TransformerLM(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (2, 4)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+
+    decode_model = TransformerLM(cfg, decode=True)
+    cache = decode_model.init(
+        jax.random.key(0), prompt[:, :1])["cache"]
+    k_shape = cache["block0"]["attn"]["cached_key"].shape
+    assert k_shape == (2, 16, 2, cfg.head_dim), k_shape  # Hkv=2, not 4
+
+    out = greedy_generate(cfg, params, prompt, 6)
+    assert out.shape == (2, 10)
+    # step-by-step decode must agree with the teacher-forced forward
+    logits = model.apply({"params": params}, out[:, :-1])
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(logits[:, -1], -1)), np.asarray(out[:, -1]))

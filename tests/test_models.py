@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpudist.models import MLP, ConvNet, EmbeddingBagClassifier, ResNet50, resnet50_stages
 
@@ -95,3 +96,38 @@ def test_transformer_remat_matches_plain():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-6), g1, g2)
+
+
+class TestLosses:
+    def test_cross_entropy_perfect_logits_all_ranks(self):
+        """Perfect one-hot logits → ~0 loss for [N,C] AND [B,S,V] shapes.
+        Regression: labels[:, None] on a [B, S] batch used to gather a
+        [B, S, S] mix of wrong targets (optimum ≈ uniform) silently."""
+        import numpy as np
+
+        from tpudist.ops.losses import cross_entropy, cross_entropy_per_token
+
+        rng = np.random.default_rng(0)
+        for shape in [(8,), (4, 6)]:
+            labels = jnp.asarray(rng.integers(0, 10, shape), jnp.int32)
+            logits = jax.nn.one_hot(labels, 10) * 30.0
+            loss = float(cross_entropy(logits, labels))
+            assert loss < 1e-4, (shape, loss)
+            per = cross_entropy_per_token(logits, labels)
+            assert per.shape == shape
+
+    def test_cross_entropy_uniform_is_log_c(self):
+        import numpy as np
+
+        from tpudist.ops.losses import cross_entropy
+
+        labels = jnp.asarray(np.zeros((2, 5), np.int32))
+        logits = jnp.zeros((2, 5, 16))
+        np.testing.assert_allclose(
+            float(cross_entropy(logits, labels)), np.log(16), rtol=1e-6)
+
+    def test_cross_entropy_shape_mismatch_raises(self):
+        from tpudist.ops.losses import cross_entropy_per_token
+
+        with pytest.raises(ValueError, match="trailing class axis"):
+            cross_entropy_per_token(jnp.zeros((2, 3, 16)), jnp.zeros((6,), jnp.int32))

@@ -102,3 +102,24 @@ def test_causal_masking_blocks_future():
                                np.asarray(logits2[:, :-1]), atol=1e-6)
     assert not np.allclose(np.asarray(logits[:, -1]),
                            np.asarray(logits2[:, -1]))
+
+
+def test_tp_rules_cover_gqa_projections(devices8):
+    """GQA param names (attn/q, attn/kv) must get the Megatron column
+    layout, not fall through to replicated."""
+    import numpy as np
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.parallel.tensor_parallel import (
+        spec_tree_from_rules, transformer_tp_rules,
+    )
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=16)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = TransformerLM(cfg).init(jax.random.key(0), tokens)["params"]
+    specs = spec_tree_from_rules(params, transformer_tp_rules())
+    attn = specs["block0"]["attn"]
+    assert attn["q"]["kernel"] == P(None, "model")
+    assert attn["kv"]["kernel"] == P(None, "model")
+    assert attn["proj"]["kernel"] == P("model", None)

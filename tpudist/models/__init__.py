@@ -16,6 +16,7 @@ from tpudist.models.resnet import ResNet50, resnet50_stages
 from tpudist.models.transformer import (
     TransformerConfig,
     TransformerLM,
+    repeat_kv,
     sdpa,
 )
 

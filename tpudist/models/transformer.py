@@ -52,6 +52,18 @@ def _masked_attend(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def repeat_kv(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray):
+    """Expand grouped K/V heads to match Q's head count (GQA → MHA view).
+
+    KV head ``j`` serves query heads ``[j·g, (j+1)·g)`` — the convention
+    the pallas kernels implement natively via index maps (no expansion)."""
+    group = q.shape[2] // k.shape[2]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    return k, v
+
+
 def sdpa(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -59,12 +71,14 @@ def sdpa(
     *,
     causal: bool = True,
 ) -> jnp.ndarray:
-    """Plain scaled-dot-product attention on [B, S, H, D] arrays.
+    """Plain scaled-dot-product attention on [B, S, H, D] arrays; K/V may
+    carry fewer (grouped) heads — GQA.
 
     The reference semantics all pluggable attention implementations (ring,
     pallas flash) must match.  Softmax statistics in float32 regardless of
     the compute dtype — bfloat16 logits lose too much for long sequences.
     """
+    k, v = repeat_kv(q, k, v)
     mask = None
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
@@ -81,11 +95,20 @@ class TransformerConfig:
     mlp_ratio: int = 4
     max_seq_len: int = 512
     compute_dtype: jnp.dtype = jnp.float32
+    # Grouped-query attention: K/V heads (None = num_heads, plain MHA).
+    # Shrinks the decode KV cache by num_heads/num_kv_heads.
+    num_kv_heads: int | None = None
 
     @property
     def head_dim(self) -> int:
         assert self.embed_dim % self.num_heads == 0
         return self.embed_dim // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.num_kv_heads or self.num_heads
+        assert self.num_heads % kv == 0, (self.num_heads, kv)
+        return kv
 
 
 class CausalSelfAttention(nn.Module):
@@ -97,10 +120,19 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
         cfg = self.cfg
         b, s, _ = x.shape
-        qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False,
-                       dtype=cfg.compute_dtype, name="qkv")(x)
-        qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.kv_heads == cfg.num_heads:
+            qkv = nn.Dense(3 * cfg.embed_dim, use_bias=False,
+                           dtype=cfg.compute_dtype, name="qkv")(x)
+            qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:  # GQA: separate projections, K/V at the grouped head count
+            q = nn.Dense(cfg.embed_dim, use_bias=False,
+                         dtype=cfg.compute_dtype, name="q")(x)
+            q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            kv = nn.Dense(2 * cfg.kv_heads * cfg.head_dim, use_bias=False,
+                          dtype=cfg.compute_dtype, name="kv")(x)
+            kv = kv.reshape(b, s, 2, cfg.kv_heads, cfg.head_dim)
+            k, v = kv[:, :, 0], kv[:, :, 1]
         if self.decode:
             out = self._cached_attend(q, k, v)
         else:
@@ -115,14 +147,15 @@ class CausalSelfAttention(nn.Module):
         buffers + ``dynamic_update_slice`` keep the whole autoregressive
         loop jittable as a ``lax.scan``."""
         cfg = self.cfg
-        b, s, h, d = q.shape
+        b, s, _, d = q.shape
+        h_kv = k.shape[2]  # the GQA cache-memory win: Hkv slots, not H
         assert s == 1, "cached decoding feeds one token at a time"
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (b, cfg.max_seq_len, h, d), cfg.compute_dtype)
+            (b, cfg.max_seq_len, h_kv, d), cfg.compute_dtype)
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (b, cfg.max_seq_len, h, d), cfg.compute_dtype)
+            (b, cfg.max_seq_len, h_kv, d), cfg.compute_dtype)
         idx_var = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
         idx = idx_var.value
@@ -134,6 +167,7 @@ class CausalSelfAttention(nn.Module):
         idx_var.value = idx + 1
 
         mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
+        k_all, v_all = repeat_kv(q, k_all, v_all)  # cache itself stays GQA
         return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
 
 

@@ -142,9 +142,16 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
     not lowerable on real TPUs).  ``q_offset``/``k_offset`` shift the causal
     mask to global positions (ring attention)."""
     b, s, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
     num_kb = sk // block_k
     q3, k3, v3 = (_fuse(x) for x in (q, k, v))
+
+    def kv_head(g):
+        # Grouped-query attention: query head h attends KV head h // group
+        # — resolved in the index map, so grouped K/V are never expanded.
+        return (g // h) * h_kv + (g % h) // group
+
     kernel = functools.partial(
         _flash_kernel, scale=d ** -0.5, causal=causal,
         block_q=block_q, block_k=block_k, num_kb=num_kb)
@@ -154,8 +161,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
         in_specs=[
             _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (kv_head(g), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (kv_head(g), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
@@ -237,13 +244,17 @@ def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                           scale: float, causal: bool, block_q: int,
-                          block_k: int, num_qb: int):
-    """Grid (B·H, k-block, q-block); Q innermost/sequential accumulates
-    dK = scale · Σ_q dSᵀ·Q and dV = Σ_q Pᵀ·dO in VMEM scratches."""
-    kj, qi = pl.program_id(1), pl.program_id(2)
+                          block_k: int, num_q_iters: int, group: int):
+    """Grid (B·Hkv, k-block, q-block × group-member); the innermost
+    sequential dimension walks every (q-block, query-head-of-the-group)
+    pair, accumulating dK = scale · Σ dSᵀ·Q and dV = Σ Pᵀ·dO in VMEM —
+    under GQA each KV head's grads sum over its whole query-head group
+    here, with no cross-program races and no K/V expansion."""
+    kj, t = pl.program_id(1), pl.program_id(2)
+    qi = t // group
     q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -261,7 +272,7 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(qi == num_qb - 1)
+    @pl.when(t == num_q_iters - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -278,20 +289,33 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
     plain backward below is the single-block case with zero offsets.
     """
     b, s, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
     scale = d ** -0.5
     num_qb, num_kb = s // block_q, sk // block_k
     q3, k3, v3, do3 = (_fuse(x) for x in (q, k, v, dout))
     lse3 = lse.reshape(b * h, 1, s)
     delta3 = delta.reshape(b * h, 1, s)
 
+    def kv_head(g):
+        return (g // h) * h_kv + (g % h) // group
+
+    def q_head(g, t):
+        # dK/dV grid runs per KV head; member t % group selects which of
+        # its query heads this inner step contracts.
+        return (g // h_kv) * h + (g % h_kv) * group + t % group
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g, 0, i))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0))
-    # dK/dV pass walks the transposed grid (k-block major, q-block minor).
-    q_spec_t = pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0))
-    row_spec_t = pl.BlockSpec((1, 1, block_q), lambda g, j, i: (g, 0, i))
-    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d),
+                           lambda g, i, j: (kv_head(g), j, 0))
+    # dK/dV pass walks the transposed grid: KV-head programs, k-block
+    # major, (q-block × group-member) minor.
+    q_spec_t = pl.BlockSpec((1, block_q, d),
+                            lambda g, j, t: (q_head(g, t), t // group, 0))
+    row_spec_t = pl.BlockSpec((1, 1, block_q),
+                              lambda g, j, t: (q_head(g, t), 0, t // group))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0))
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
@@ -313,14 +337,15 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_qb=num_qb),
-        grid=(b * h, num_kb, num_qb),
+            block_q=block_q, block_k=block_k,
+            num_q_iters=num_qb * group, group=group),
+        grid=(b * h_kv, num_kb, num_qb * group),
         in_specs=[_smem_spec(), q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
                   row_spec_t, row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -330,7 +355,7 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
         interpret=interpret,
     )(offs, q3, k3, v3, do3, lse3, delta3)
 
-    return _unfuse(dq, b, h), _unfuse(dk, b, h), _unfuse(dv, b, h)
+    return _unfuse(dq, b, h), _unfuse(dk, b, h_kv), _unfuse(dv, b, h_kv)
 
 
 def flash_delta(out, dout):
@@ -378,6 +403,10 @@ def flash_attention(
     differentiable via ``custom_vjp``.  Block sizes default to the largest
     power-of-two divisor of S up to 1024 (the measured sweet spot)."""
     s = q.shape[1]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"num_heads {q.shape[2]} must be a multiple of kv heads "
+            f"{k.shape[2]} (GQA)")
     block_q = _auto_block(s) if block_q is None else min(block_q, s)
     block_k = _auto_block(s) if block_k is None else min(block_k, s)
     if s % block_q or s % block_k:

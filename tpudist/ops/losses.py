@@ -29,13 +29,23 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 def cross_entropy_per_token(
     logits: jnp.ndarray, labels: jnp.ndarray
 ) -> jnp.ndarray:
-    """UNREDUCED cross-entropy, one value per row — the building block the
+    """UNREDUCED cross-entropy, one value per label — the building block the
     sharded strategies need so they can sum locally and normalise by the
-    *global* token count (see :func:`tpudist.parallel.make_sp_train_step`)."""
+    *global* token count (see :func:`tpudist.parallel.make_sp_train_step`).
+
+    Rank-general: ``logits [..., C]`` with ``labels [...]`` (any leading
+    shape — [N, C]/[N] rows or [B, S, V]/[B, S] sequences).  The gather
+    must expand labels on the LAST axis; ``labels[:, None]`` on a [B, S]
+    batch would broadcast into a [B, S, S] gather of wrong targets whose
+    optimum is near-uniform — a silent wrong-loss failure mode."""
+    if logits.shape[:-1] != labels.shape:
+        raise ValueError(
+            f"logits {logits.shape} must be labels shape {labels.shape} "
+            f"+ one trailing class axis")
     logp = log_softmax(logits.astype(jnp.float32))
     return -jnp.take_along_axis(
-        logp, labels[:, None].astype(jnp.int32), axis=-1
-    )[:, 0]
+        logp, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
 
 
 def nll_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

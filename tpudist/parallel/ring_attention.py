@@ -65,6 +65,10 @@ def ring_attention_fn(axis_name: str = "seq") -> Callable:
     """
 
     def attend(q, k, v, *, causal: bool = True):
+        from tpudist.models.transformer import repeat_kv
+
+        k, v = repeat_kv(q, k, v)  # GQA: naive path expands; ring flash
+                                   # keeps K/V grouped (use it instead)
         n = lax.axis_size(axis_name)
         my = lax.axis_index(axis_name)
         b, s_loc, h, d = q.shape
@@ -111,7 +115,11 @@ def ulysses_attention_fn(axis_name: str = "seq") -> Callable:
     a sharded head axis around an exact full-sequence attention."""
 
     def attend(q, k, v, *, causal: bool = True):
-        from tpudist.models.transformer import sdpa
+        from tpudist.models.transformer import repeat_kv, sdpa
+
+        # GQA: expand grouped K/V before the all-to-all (head counts must
+        # match the axis split; the ring variants keep K/V grouped instead)
+        k, v = repeat_kv(q, k, v)
 
         def gather_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
             return lax.all_to_all(
@@ -312,6 +320,10 @@ def ring_flash_attention_fn(
 
     def attend(q, k, v, *, causal: bool = True):
         s_loc = q.shape[1]
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"num_heads {q.shape[2]} must be a multiple of kv heads "
+                f"{k.shape[2]} (GQA)")
         bq = _auto_block(s_loc) if block_q is None else min(block_q, s_loc)
         bk = _auto_block(s_loc) if block_k is None else min(block_k, s_loc)
         if s_loc % bq or s_loc % bk:

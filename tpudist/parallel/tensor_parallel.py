@@ -66,6 +66,10 @@ def transformer_tp_rules(axis: str = "model") -> Rules:
     """Megatron-style layout for :class:`tpudist.models.TransformerLM`."""
     return [
         (r"attn/qkv/kernel", P(None, axis)),
+        # GQA projections (column-parallel like qkv; requires
+        # num_kv_heads % tp_size == 0 so each shard owns whole KV heads)
+        (r"attn/q/kernel", P(None, axis)),
+        (r"attn/kv/kernel", P(None, axis)),
         (r"attn/proj/kernel", P(axis, None)),
         (r"mlp/up/kernel", P(None, axis)),
         (r"mlp/down/kernel", P(axis, None)),
