@@ -3,8 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpudist.models import TransformerConfig, TransformerLM, greedy_generate
+from tpudist.models.generate import sample_generate, top_k_filter, top_p_filter
 
 
 def _model():
@@ -58,7 +60,12 @@ def test_generate_gqa_cache_is_grouped():
     import numpy as np
 
     from tpudist.models import TransformerConfig, TransformerLM
-    from tpudist.models.generate import greedy_generate
+    from tpudist.models.generate import (
+    greedy_generate,
+    sample_generate,
+    top_k_filter,
+    top_p_filter,
+)
 
     cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
                             num_kv_heads=2, embed_dim=32, max_seq_len=16)
@@ -79,3 +86,85 @@ def test_generate_gqa_cache_is_grouped():
     logits = model.apply({"params": params}, out[:, :-1])
     np.testing.assert_array_equal(
         np.asarray(jnp.argmax(logits[:, -1], -1)), np.asarray(out[:, -1]))
+
+
+class TestSampling:
+    def _setup(self):
+        cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                embed_dim=32, max_seq_len=16)
+        model = TransformerLM(cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 32, (2, 4)), jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+        return cfg, params, prompt
+
+    def test_temperature_zero_equals_greedy(self):
+        cfg, params, prompt = self._setup()
+        greedy = greedy_generate(cfg, params, prompt, 8)
+        sampled = sample_generate(cfg, params, prompt, 8,
+                                  jax.random.key(1), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_top_k_one_equals_greedy(self):
+        cfg, params, prompt = self._setup()
+        greedy = greedy_generate(cfg, params, prompt, 8)
+        sampled = sample_generate(cfg, params, prompt, 8,
+                                  jax.random.key(2), top_k=1)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_sampling_deterministic_per_key_and_in_vocab(self):
+        cfg, params, prompt = self._setup()
+        a = sample_generate(cfg, params, prompt, 8, jax.random.key(3),
+                            temperature=1.3, top_k=8, top_p=0.9)
+        b = sample_generate(cfg, params, prompt, 8, jax.random.key(3),
+                            temperature=1.3, top_k=8, top_p=0.9)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape == (2, 12)
+        assert (np.asarray(a) >= 0).all() and (np.asarray(a) < 32).all()
+        c = sample_generate(cfg, params, prompt, 8, jax.random.key(4),
+                            temperature=1.3)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_top_p_keeps_nucleus_only(self):
+        """With a sharply peaked distribution, tiny top_p must reduce to
+        greedy even at high temperature-free sampling."""
+        cfg, params, prompt = self._setup()
+        greedy = greedy_generate(cfg, params, prompt, 8)
+        sampled = sample_generate(cfg, params, prompt, 8,
+                                  jax.random.key(5), temperature=0.05,
+                                  top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_invalid_args_raise(self):
+        cfg, params, prompt = self._setup()
+        with pytest.raises(ValueError, match="top_k"):
+            sample_generate(cfg, params, prompt, 4, jax.random.key(0), top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            sample_generate(cfg, params, prompt, 4, jax.random.key(0), top_p=0.0)
+        with pytest.raises(ValueError, match="temperature"):
+            sample_generate(cfg, params, prompt, 4, jax.random.key(0),
+                            temperature=-1.0)
+
+
+class TestFilters:
+    def test_top_p_keeps_whole_nucleus(self):
+        """Regression: the cutoff must be the SMALLEST kept logit — a max
+        cutoff silently degenerates every top_p sample to greedy."""
+        logits = jnp.asarray([[2.0, 1.0, 0.9, -3.0]])
+        out = np.asarray(top_p_filter(logits, 0.9))
+        # nucleus: cum probs of sorted [2.0, 1.0, 0.9, -3.0] pass 0.9 at
+        # the third token -> exactly three tokens survive
+        assert np.isfinite(out[0, :3]).all(), out
+        assert np.isinf(out[0, 3]) and out[0, 3] < 0, out
+
+    def test_top_p_statistics_multiple_tokens_sampled(self):
+        logits = jnp.tile(jnp.asarray([[1.0, 0.99, -10.0, -10.0]]), (512, 1))
+        filtered = top_p_filter(logits, 0.9)
+        draws = np.asarray(jax.random.categorical(jax.random.key(0), filtered))
+        assert set(np.unique(draws)) == {0, 1}, np.unique(draws)
+
+    def test_top_k_filter_exact(self):
+        logits = jnp.asarray([[0.1, 3.0, 2.0, -1.0]])
+        out = np.asarray(top_k_filter(logits, 2))
+        assert np.isfinite(out[0, [1, 2]]).all()
+        assert np.isinf(out[0, [0, 3]]).all()

@@ -10,13 +10,65 @@ dynamic shapes.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+# (logits [B, V], key) -> next token [B] int32
+SelectFn = Callable[[jnp.ndarray, jax.Array], jnp.ndarray]
+
+
+def _rollout(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    select: SelectFn,
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Shared KV-cached decode loop; ``select`` picks the next token from
+    each step's last-position logits (argmax for greedy, a sampler
+    otherwise)."""
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    model = TransformerLM(cfg, decode=True)
+    # Cache shapes via eval_shape (no FLOPs, no throwaway params), zeros =
+    # a blank cache (cache_index 0, empty slots).
+    cache_struct = jax.eval_shape(
+        model.init, jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
+        positions=jnp.zeros((b, 1), jnp.int32))["cache"]
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_struct)
+    # Prompt padded to the full rollout so the scan reads it with a dynamic
+    # index; positions past the prompt take the previous step's selection.
+    prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+
+    def step(carry, inputs):
+        t, step_key = inputs
+        cache, prev = carry
+        tok = jnp.where(t < prompt_len, prompt_pad[:, t], prev)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((b, 1), t, jnp.int32),
+            mutable=["cache"],
+        )
+        nxt = select(logits[:, -1], step_key).astype(jnp.int32)
+        return (mutated["cache"], nxt), tok
+
+    keys = jax.random.split(key, total)
+    (_, _), toks = lax.scan(
+        step, (cache, jnp.zeros((b,), jnp.int32)),
+        (jnp.arange(total), keys))
+    return toks.T  # [total, B] -> [B, total]
 
 
 def greedy_generate(
@@ -39,36 +91,74 @@ def greedy_generate(
       continuation.  ``prompt_len + max_new_tokens`` must fit in
       ``cfg.max_seq_len``.
     """
-    b, prompt_len = prompt.shape
-    total = prompt_len + max_new_tokens
-    if total > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt_len + max_new_tokens = {total} exceeds "
-            f"max_seq_len {cfg.max_seq_len}")
-    model = TransformerLM(cfg, decode=True)
-    # Cache shapes via eval_shape (no FLOPs, no throwaway params), zeros =
-    # a blank cache (cache_index 0, empty slots).
-    cache_struct = jax.eval_shape(
-        model.init, jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
-        positions=jnp.zeros((b, 1), jnp.int32))["cache"]
-    cache = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_struct)
-    # Prompt padded to the full rollout so the scan reads it with a dynamic
-    # index; positions past the prompt take the previous step's argmax.
-    prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    return _rollout(
+        cfg, params, prompt, max_new_tokens,
+        lambda logits, _key: jnp.argmax(logits, axis=-1),
+        jax.random.key(0))
 
-    def step(carry, t):
-        cache, prev = carry
-        tok = jnp.where(t < prompt_len, prompt_pad[:, t], prev)
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            positions=jnp.full((b, 1), t, jnp.int32),
-            mutable=["cache"],
-        )
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return (mutated["cache"], nxt), tok
 
-    (_, _), toks = lax.scan(
-        step, (cache, jnp.zeros((b,), jnp.int32)), jnp.arange(total))
-    return toks.T  # [total, B] -> [B, total]
+def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask all but the k highest logits to -inf (last axis)."""
+    if k >= logits.shape[-1]:
+        return logits
+    kth = lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Mask to the nucleus: the smallest prefix of probability-sorted
+    tokens whose cumulative probability reaches ``p`` (the argmax is
+    always kept)."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep every token whose PREDECESSORS sum below p; the cutoff is the
+    # SMALLEST kept logit (min, not max — max would degenerate to greedy).
+    keep_sorted = jnp.concatenate(
+        [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1) < p
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample_generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    key: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sample ``max_new_tokens`` past ``prompt`` with the standard
+    controls, all static-shape (one compiled rollout, like greedy):
+
+    * ``temperature`` scales logits (0 → greedy argmax);
+    * ``top_k`` keeps only the k highest-probability tokens;
+    * ``top_p`` keeps the smallest nucleus whose cumulative probability
+      reaches p (applied after top_k when both are set).
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0 < top_p <= 1:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    def select(logits: jnp.ndarray, step_key: jax.Array) -> jnp.ndarray:
+        logits = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k is not None:
+            logits = top_k_filter(logits, top_k)
+        if top_p is not None:
+            logits = top_p_filter(logits, top_p)
+        return jax.random.categorical(step_key, logits, axis=-1)
+
+    return _rollout(cfg, params, prompt, max_new_tokens, select, key)
