@@ -217,3 +217,61 @@ def test_transformer_gqa_with_flash_matches_sdpa_model():
     got = flash_model.apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def _sdpa_windowed(q, k, v, window):
+    """Reference sliding-window attention via explicit band mask."""
+    from tpudist.models.transformer import _masked_attend, repeat_kv
+
+    k, v = repeat_kv(q, k, v)
+    s = q.shape[1]
+    pos = np.arange(s)
+    mask = (pos[:, None] >= pos[None, :]) & (
+        pos[:, None] - pos[None, :] < window)
+    return _masked_attend(q, k, v, jnp.asarray(mask))
+
+
+@pytest.mark.parametrize("window", [1, 8, 24, 64])
+def test_flash_sliding_window_matches_reference(window):
+    q, k, v = _qkv(s=64)
+    want = _sdpa_windowed(q, k, v, window)
+    got = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("window", [8, 24])
+def test_flash_sliding_window_gradients(window):
+    q, k, v = _qkv(b=1, s=32, h=2, d=8, seed=6)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_sdpa_windowed(q, k, v, window)))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(
+            q, k, v, causal=True, window=window, block_q=8, block_k=8)))
+
+    ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_got in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_window_gqa_composes():
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    want = _sdpa_windowed(q, k, v, 8)
+    got = flash_attention(q, k, v, causal=True, window=8,
+                          block_q=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_window_requires_causal():
+    q, k, v = _qkv(s=32)
+    with pytest.raises(ValueError, match="requires causal"):
+        flash_attention(q, k, v, causal=False, window=8)

@@ -251,3 +251,34 @@ def test_ring_flash_gqa(devices8):
         assert g_ref.shape == g_got.shape
         np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_ring_flash_sliding_window(devices8):
+    """Sliding-window attention across ring shards: the band can span
+    shard boundaries (window 12 over 8-position shards)."""
+    from tests.test_flash_attention import _sdpa_windowed
+
+    q, k, v = _qkv(b=1, s=32, h=2, d=8, seed=5)
+    want = _sdpa_windowed(q, k, v, 12)
+    mesh = make_mesh({"seq": 4}, devices8[:4])
+    attend = ring_flash_attention_fn("seq", block_q=8, block_k=8, window=12)
+    sharded = jax.jit(jax.shard_map(
+        attend, mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    got = sharded(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+    def sp_loss(q, k, v):
+        return jnp.sum(jnp.square(attend(q, k, v, causal=True)))
+
+    ref_grads = jax.grad(
+        lambda a, b, c: jnp.sum(jnp.square(_sdpa_windowed(a, b, c, 12))),
+        argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.jit(jax.shard_map(
+        jax.grad(sp_loss, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+        check_vma=False))(q, k, v)
+    for g_ref, g_got in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)

@@ -31,7 +31,9 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-# (q, k, v, causal) on [batch, seq, num_heads, head_dim] -> same-shape out.
+# (q, k, v, *, causal, window=None) on [batch, seq, heads, head_dim]
+# arrays -> out shaped like q.  ``window`` is the sliding-window width
+# (None = full causal attention); implementations may reject it.
 AttentionFn = Callable[..., jnp.ndarray]
 
 
@@ -70,9 +72,11 @@ def sdpa(
     v: jnp.ndarray,
     *,
     causal: bool = True,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Plain scaled-dot-product attention on [B, S, H, D] arrays; K/V may
-    carry fewer (grouped) heads — GQA.
+    carry fewer (grouped) heads (GQA), and ``window`` restricts each query
+    to the last ``window`` positions (sliding-window attention).
 
     The reference semantics all pluggable attention implementations (ring,
     pallas flash) must match.  Softmax statistics in float32 regardless of
@@ -83,6 +87,11 @@ def sdpa(
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        if window is not None:
+            pos_q = jnp.arange(s_q)[:, None] + (s_k - s_q)
+            mask = mask & (pos_q - jnp.arange(s_k)[None, :] < window)
+    elif window is not None:
+        raise ValueError("window requires causal=True")
     return _masked_attend(q, k, v, mask)
 
 
@@ -98,6 +107,10 @@ class TransformerConfig:
     # Grouped-query attention: K/V heads (None = num_heads, plain MHA).
     # Shrinks the decode KV cache by num_heads/num_kv_heads.
     num_kv_heads: int | None = None
+    # Sliding-window attention width (None = full causal attention).
+    # The single source of truth: the training path passes it to the
+    # attention_fn and the decode cache mask applies the same band.
+    attention_window: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -136,7 +149,14 @@ class CausalSelfAttention(nn.Module):
         if self.decode:
             out = self._cached_attend(q, k, v)
         else:
-            out = self.attention_fn(q, k, v, causal=causal)
+            # cfg is the single source of truth for the sliding window:
+            # passed unconditionally (None = full causal) so a factory-level
+            # window on the attention_fn can never silently diverge from
+            # the decode cache mask, and a fn that doesn't accept the
+            # kwarg fails loudly instead of training full-attention
+            # against a windowed decode cache.
+            out = self.attention_fn(q, k, v, causal=causal,
+                                    window=cfg.attention_window)
         out = out.reshape(b, s, cfg.embed_dim)
         return nn.Dense(cfg.embed_dim, use_bias=False,
                         dtype=cfg.compute_dtype, name="proj")(out)
@@ -167,6 +187,9 @@ class CausalSelfAttention(nn.Module):
         idx_var.value = idx + 1
 
         mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
+        if cfg.attention_window is not None:  # sliding window: last W only
+            mask = mask & (
+                idx - jnp.arange(cfg.max_seq_len) < cfg.attention_window)
         k_all, v_all = repeat_kv(q, k_all, v_all)  # cache itself stays GQA
         return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
 

@@ -38,26 +38,77 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
+# Sentinel distinguishing "caller didn't pass window" (factory default
+# applies) from an explicit window=None (full causal attention) — so a
+# model config's attention_window always overrides the factory's.
+_UNSET = object()
 
 
-def _block_live(qi, kj, block_q: int, block_k: int, causal: bool, q0, k0):
-    """Whether (q-block ``qi``, k-block ``kj``) intersects the causal
-    lower triangle; ``True`` when not causal.  ``q0``/``k0`` are global
-    position offsets (ring attention rotates K/V blocks, so a block's
-    global span is offset + local index).  Shared by the forward and
-    both backward kernels so a masking change cannot desynchronize them."""
+def _can_prune(window, causal, q_offset, k_offset) -> bool:
+    """Whether the sliding-window band grids may be pruned: static zero
+    offsets only (the ring path has traced offsets).  ONE definition so
+    forward and backward can never prune differently."""
+    return (window is not None and causal
+            and isinstance(q_offset, int) and q_offset == 0
+            and isinstance(k_offset, int) and k_offset == 0)
+
+
+def _block_live(qi, kj, block_q: int, block_k: int, causal: bool, q0, k0,
+                window: int | None = None):
+    """Whether (q-block ``qi``, k-block ``kj``) can contribute: intersects
+    the causal lower triangle AND (for sliding-window attention) the band
+    ``q_pos - k_pos < window``.  ``True`` when not causal.  ``q0``/``k0``
+    are global position offsets (ring attention rotates K/V blocks, so a
+    block's global span is offset + local index).  Shared by the forward
+    and both backward kernels so a masking change cannot desynchronize
+    them."""
     if not causal:
         return True
-    return q0 + (qi + 1) * block_q > k0 + kj * block_k
+    live = q0 + (qi + 1) * block_q > k0 + kj * block_k
+    if window is not None:
+        # k block's last position must reach past the window's left edge
+        # of the q block's first position
+        live = jnp.logical_and(
+            live,
+            k0 + (kj + 1) * block_k > q0 + qi * block_q - (window - 1))
+    return live
 
 
-def _causal_mask(s, qi, kj, block_q: int, block_k: int, q0, k0):
-    """Mask scores above the (global) diagonal to -inf within a tile."""
+def _causal_mask(s, qi, kj, block_q: int, block_k: int, q0, k0,
+                 window: int | None = None):
+    """Mask scores above the (global) diagonal — and, with ``window``,
+    older than the sliding window — to -inf within a tile."""
     q_pos = q0 + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = k0 + kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep = jnp.logical_and(keep, q_pos - k_pos < window)
+    return jnp.where(keep, s, -jnp.inf)
+
+
+def _band_k(window: int, block_q: int, block_k: int, num_kb: int):
+    """K-block span and per-q-block start for a pruned sliding-window
+    grid: q block ``qi`` only visits k blocks overlapping its band
+    ``[qi·bq − (window−1), (qi+1)·bq − 1]`` (width bq + window − 1)."""
+    span = min(num_kb, (block_q + window - 2) // block_k + 2)
+
+    def start(qi):
+        return jnp.clip(
+            (qi * block_q - (window - 1)) // block_k, 0, num_kb - span)
+
+    return span, start
+
+
+def _band_q(window: int, block_q: int, block_k: int, num_qb: int):
+    """Q-block span and per-k-block start for the pruned dK/dV grid."""
+    span = min(num_qb, (block_k + window - 2) // block_q + 2)
+
+    def start(kj):
+        return jnp.clip((kj * block_k) // block_q, 0, num_qb - span)
+
+    return span, start
 
 
 def _fuse(x):
@@ -76,7 +127,8 @@ def _unfuse(x, b: int, h: int):
 def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
-                  num_kb: int):
+                  num_kb: int, window: int | None = None,
+                  prune: bool = False, total_kb: int | None = None):
     """One (batch·head, q-block, k-block) grid step on the fused
     [B·H, S, D] layout.
 
@@ -85,17 +137,22 @@ def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     online-softmax state across K steps while only one [block_k, D] K/V
     tile is resident at a time.
     """
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    qi, j = pl.program_id(1), pl.program_id(2)
+    if prune:  # pruned windowed grid: j indexes the band, not all of K
+        kj = _band_k(window, block_q, block_k, total_kb)[1](qi) + j
+    else:
+        kj = j
     q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
-    @pl.when(kj == 0)
+    @pl.when(j == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: q-blocks strictly above the diagonal contribute nothing.
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
+    # Causal: q-blocks strictly above the diagonal contribute nothing;
+    # with a sliding window, blocks left of the band are dead too.
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0, window))
     def _compute():
         # Matmuls run in the input dtype (bf16 hits the MXU at full rate)
         # with float32 accumulation; only the softmax math is f32.
@@ -104,7 +161,7 @@ def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0, window)
         m = m_scr[:]                                           # [bq, 1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
@@ -115,7 +172,7 @@ def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = acc_scr[:] * corr + jnp.dot(
             p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
 
-    @pl.when(kj == num_kb - 1)
+    @pl.when(j == num_kb - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
@@ -135,7 +192,7 @@ def _smem_spec():
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                   q_offset=0, k_offset=0):
+                   q_offset=0, k_offset=0, window=None):
     """[B, S, H, D] in; internally runs on a fused [B·H, S, D] layout so
     every block's minor two dims are (seq_block, D) — the (8, 128)-tileable
     shape Mosaic requires (an [.., S, H, ..] block with a size-1 H slice is
@@ -152,17 +209,28 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
         # — resolved in the index map, so grouped K/V are never expanded.
         return (g // h) * h_kv + (g % h) // group
 
+    # Sliding window on the plain (non-ring) path: prune the K grid to the
+    # band so iterations AND K/V tile traffic scale with S·window, not S².
+    prune = _can_prune(window, causal, q_offset, k_offset)
+    if prune:
+        span_k, k_start = _band_k(window, block_q, block_k, num_kb)
+        kv_idx = lambda g, i, j: (kv_head(g), k_start(i) + j, 0)
+    else:
+        span_k = num_kb
+        kv_idx = lambda g, i, j: (kv_head(g), j, 0)
+
     kernel = functools.partial(
         _flash_kernel, scale=d ** -0.5, causal=causal,
-        block_q=block_q, block_k=block_k, num_kb=num_kb)
+        block_q=block_q, block_k=block_k, num_kb=span_k, window=window,
+        prune=prune, total_kb=num_kb)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, s // block_q, num_kb),
+        grid=(b * h, s // block_q, span_k),
         in_specs=[
             _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (kv_head(g), j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (kv_head(g), j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
@@ -184,19 +252,21 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
     return _unfuse(out, b, h), lse.reshape(b, h, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window):
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                            window=window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                              window=window)
     return out, (q, k, v, out, lse)
 
 
 def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, q0, k0, *,
-               scale, causal, block_q, block_k):
+               scale, causal, block_q, block_k, window=None):
     """Shared per-(q-block, k-block) backward math: recompute P from the
     saved log-sum-exp, then ds = P ∘ (dO·Vᵀ − Δ).  Returns (p, ds) in
     float32; callers contract them onto the MXU in the input dtype."""
@@ -204,7 +274,7 @@ def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, q0, k0, *,
         q, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
-        s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0)
+        s = _causal_mask(s, qi, kj, block_q, block_k, q0, k0, window)
     p = jnp.exp(s - lse_col)                               # masked → 0
     dp = jax.lax.dot_general(
         do, vb, (((1,), (1,)), ((), ())),
@@ -216,27 +286,33 @@ def _bwd_block(q, kb, vb, do, lse_col, delta_col, qi, kj, q0, k0, *,
 def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, dq_scr, *, scale: float,
                          causal: bool, block_q: int, block_k: int,
-                         num_kb: int):
+                         num_kb: int, window: int | None = None,
+                         prune: bool = False, total_kb: int | None = None):
     """Grid (B·H, q-block, k-block); K innermost/sequential accumulates
     dQ = scale · Σ_k dS·K in a VMEM scratch."""
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    qi, j = pl.program_id(1), pl.program_id(2)
+    if prune:
+        kj = _band_k(window, block_q, block_k, total_kb)[1](qi) + j
+    else:
+        kj = j
     q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
-    @pl.when(kj == 0)
+    @pl.when(j == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0, window))
     def _compute():
         q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         _, ds = _bwd_block(
             q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj, q0, k0,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            window=window)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(kj == num_kb - 1)
+    @pl.when(j == num_kb - 1)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -244,7 +320,9 @@ def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                           scale: float, causal: bool, block_q: int,
-                          block_k: int, num_q_iters: int, group: int):
+                          block_k: int, num_q_iters: int, group: int,
+                          window: int | None = None, prune: bool = False,
+                          total_qb: int | None = None):
     """Grid (B·Hkv, k-block, q-block × group-member); the innermost
     sequential dimension walks every (q-block, query-head-of-the-group)
     pair, accumulating dK = scale · Σ dSᵀ·Q and dV = Σ Pᵀ·dO in VMEM —
@@ -252,6 +330,8 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     here, with no cross-program races and no K/V expansion."""
     kj, t = pl.program_id(1), pl.program_id(2)
     qi = t // group
+    if prune:
+        qi = _band_q(window, block_q, block_k, total_qb)[1](kj) + qi
     q0, k0 = off_ref[0, 0], off_ref[0, 1]
 
     @pl.when(t == 0)
@@ -259,12 +339,13 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0))
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0, window))
     def _compute():
         q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _bwd_block(
             q, kb, vb, do, lse_ref[0].T, delta_ref[0].T, qi, kj, q0, k0,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            window=window)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -279,7 +360,8 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
-                      block_k, interpret, q_offset=0, k_offset=0):
+                      block_k, interpret, q_offset=0, k_offset=0,
+                      window=None):
     """(dQ, dK, dV) of one attention block given the FINAL softmax
     statistics ``lse``/``delta`` (shapes [B, H, S]).
 
@@ -305,16 +387,27 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
         # its query heads this inner step contracts.
         return (g // h_kv) * h + (g % h_kv) * group + t % group
 
+    prune = _can_prune(window, causal, q_offset, k_offset)
+    if prune:
+        span_k, k_start = _band_k(window, block_q, block_k, num_kb)
+        span_q, q_start = _band_q(window, block_q, block_k, num_qb)
+    else:
+        span_k, k_start = num_kb, (lambda i: 0)
+        span_q, q_start = num_qb, (lambda j: 0)
+
+    def qi_of(j, t):
+        return q_start(j) + t // group
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g, 0, i))
     kv_spec = pl.BlockSpec((1, block_k, d),
-                           lambda g, i, j: (kv_head(g), j, 0))
+                           lambda g, i, j: (kv_head(g), k_start(i) + j, 0))
     # dK/dV pass walks the transposed grid: KV-head programs, k-block
     # major, (q-block × group-member) minor.
     q_spec_t = pl.BlockSpec((1, block_q, d),
-                            lambda g, j, t: (q_head(g, t), t // group, 0))
+                            lambda g, j, t: (q_head(g, t), qi_of(j, t), 0))
     row_spec_t = pl.BlockSpec((1, 1, block_q),
-                              lambda g, j, t: (q_head(g, t), 0, t // group))
+                              lambda g, j, t: (q_head(g, t), 0, qi_of(j, t)))
     kv_spec_t = pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0))
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
@@ -323,8 +416,9 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_kb=num_kb),
-        grid=(b * h, num_qb, num_kb),
+            block_q=block_q, block_k=block_k, num_kb=span_k, window=window,
+            prune=prune, total_kb=num_kb),
+        grid=(b * h, num_qb, span_k),
         in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
                   row_spec, row_spec],
         out_specs=[q_spec],
@@ -338,8 +432,9 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
-            num_q_iters=num_qb * group, group=group),
-        grid=(b * h_kv, num_kb, num_qb * group),
+            num_q_iters=span_q * group, group=group, window=window,
+            prune=prune, total_qb=num_qb),
+        grid=(b * h_kv, num_kb, span_q * group),
         in_specs=[_smem_spec(), q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
                   row_spec_t, row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
@@ -365,11 +460,12 @@ def flash_delta(out, dout):
     ).transpose(0, 2, 1)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, dout):
     q, k, v, out, lse = res
     return flash_block_grads(
         q, k, v, dout, lse, flash_delta(out, dout),
-        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -397,11 +493,18 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Fused attention on [B, S, H, D] arrays; drop-in for
     :func:`tpudist.models.sdpa` (same ``AttentionFn`` contract),
     differentiable via ``custom_vjp``.  Block sizes default to the largest
-    power-of-two divisor of S up to 1024 (the measured sweet spot)."""
+    power-of-two divisor of S up to 1024 (the measured sweet spot).
+
+    ``window`` enables sliding-window attention (Mistral-style): each
+    query attends only the last ``window`` positions (itself included).
+    Requires ``causal=True``; blocks wholly left of the band are skipped,
+    so FLOPs scale with S·window instead of S².  K/V may carry fewer
+    (grouped) heads — GQA."""
     s = q.shape[1]
     if q.shape[2] % k.shape[2]:
         raise ValueError(
@@ -412,20 +515,26 @@ def flash_attention(
     if s % block_q or s % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"seq_len {s}")
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret, window)
 
 
 def flash_attention_fn(
     block_q: int | None = None, block_k: int | None = None,
-    interpret: bool | None = None
+    interpret: bool | None = None, window: int | None = None,
 ):
     """``AttentionFn`` factory for :class:`tpudist.models.TransformerLM`:
     ``TransformerLM(cfg, attention_fn=flash_attention_fn())``."""
+    factory_window = window
 
-    def attend(q, k, v, *, causal: bool = True):
+    def attend(q, k, v, *, causal: bool = True, window=_UNSET):
+        eff = factory_window if window is _UNSET else window
         return flash_attention(q, k, v, causal=causal, block_q=block_q,
-                               block_k=block_k, interpret=interpret)
+                               block_k=block_k, interpret=interpret,
+                               window=eff)
 
     return attend

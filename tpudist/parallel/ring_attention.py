@@ -44,6 +44,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpudist.ops.flash_attention import (
+    _UNSET,
     _auto_block,
     _flash_forward,
     flash_block_grads,
@@ -64,9 +65,12 @@ def ring_attention_fn(axis_name: str = "seq") -> Callable:
     positions [i·S/n, (i+1)·S/n)).
     """
 
-    def attend(q, k, v, *, causal: bool = True):
+    def attend(q, k, v, *, causal: bool = True,
+               window: int | None = None):
         from tpudist.models.transformer import repeat_kv
 
+        if window is not None and not causal:
+            raise ValueError("window requires causal=True")
         k, v = repeat_kv(q, k, v)  # GQA: naive path expands; ring flash
                                    # keeps K/V grouped (use it instead)
         n = lax.axis_size(axis_name)
@@ -90,6 +94,9 @@ def ring_attention_fn(axis_name: str = "seq") -> Callable:
                 "bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
+                if window is not None:
+                    mask = mask & (
+                        q_pos[:, None] - k_pos[None, :] < window)
                 logits = jnp.where(mask[None, None], logits, -jnp.inf)
             blk_max = jnp.max(logits, axis=-1)                 # [B,H,Sq]
             new_m = jnp.maximum(m, jnp.maximum(blk_max, _NEG_BIG))
@@ -114,7 +121,8 @@ def ulysses_attention_fn(axis_name: str = "seq") -> Callable:
     """All-to-all sequence parallelism: trade the sharded sequence axis for
     a sharded head axis around an exact full-sequence attention."""
 
-    def attend(q, k, v, *, causal: bool = True):
+    def attend(q, k, v, *, causal: bool = True,
+               window: int | None = None):
         from tpudist.models.transformer import repeat_kv, sdpa
 
         # GQA: expand grouped K/V before the all-to-all (head counts must
@@ -130,7 +138,7 @@ def ulysses_attention_fn(axis_name: str = "seq") -> Callable:
                 x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
         out = sdpa(gather_heads(q), gather_heads(k), gather_heads(v),
-                   causal=causal)
+                   causal=causal, window=window)
         return scatter_heads(out)
 
     return attend
@@ -227,7 +235,7 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
 
 
 def _ring_flash_fwd_impl(q, k, v, causal, axis_name, block_q, block_k,
-                         interpret):
+                         interpret, window=None):
     """Rotate K/V blocks around the ring; each step runs the Pallas flash
     forward on the resident block with GLOBAL position offsets (the kernel
     masks and skips dead tiles itself), then merges (out, lse) pairs with
@@ -243,7 +251,7 @@ def _ring_flash_fwd_impl(q, k, v, causal, axis_name, block_q, block_k,
         kb, vb, src, o, lse = carry
         ob, lse_b = _flash_forward(
             q, kb, vb, causal, block_q, block_k, interpret,
-            q_offset=my * s_loc, k_offset=src * s_loc)
+            q_offset=my * s_loc, k_offset=src * s_loc, window=window)
         new_lse = jnp.logaddexp(lse, lse_b)
         o = (o * _rowstat_to_bshd(jnp.exp(lse - new_lse))
              + ob.astype(jnp.float32)
@@ -256,21 +264,23 @@ def _ring_flash_fwd_impl(q, k, v, causal, axis_name, block_q, block_k,
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret,
+                window):
     out, _ = _ring_flash_fwd_impl(
-        q, k, v, causal, axis_name, block_q, block_k, interpret)
+        q, k, v, causal, axis_name, block_q, block_k, interpret, window)
     return out
 
 
-def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret):
+def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret,
+                    window):
     out, lse = _ring_flash_fwd_impl(
-        q, k, v, causal, axis_name, block_q, block_k, interpret)
+        q, k, v, causal, axis_name, block_q, block_k, interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res,
-                    dout):
+def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, window,
+                    res, dout):
     """Backward ring: dK/dV accumulators travel WITH their K/V blocks (one
     full loop lands them back on the owner), dQ accumulates locally.  Each
     step is the Pallas flash backward on the resident block, valid per
@@ -287,7 +297,8 @@ def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res,
         dq_b, dk_b, dv_b = flash_block_grads(
             q, kb, vb, dout, lse, delta,
             causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret, q_offset=my * s_loc, k_offset=src * s_loc)
+            interpret=interpret, q_offset=my * s_loc, k_offset=src * s_loc,
+            window=window)
         dq = dq + dq_b.astype(jnp.float32)
         dk = dk + dk_b.astype(jnp.float32)
         dv = dv + dv_b.astype(jnp.float32)
@@ -310,6 +321,7 @@ def ring_flash_attention_fn(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> Callable:
     """:func:`ring_attention_fn` with the per-block compute done by the
     Pallas flash kernels instead of materialized [S/n, S/n] logits: VMEM
@@ -317,8 +329,10 @@ def ring_flash_attention_fn(
     online-softmax recurrence at both levels.  Gradients run a second ring
     (dK/dV ride the rotating blocks home); memory stays linear in S on
     every device in both directions."""
+    factory_window = window
 
-    def attend(q, k, v, *, causal: bool = True):
+    def attend(q, k, v, *, causal: bool = True, window=_UNSET):
+        window = factory_window if window is _UNSET else window
         s_loc = q.shape[1]
         if q.shape[2] % k.shape[2]:
             raise ValueError(
@@ -330,10 +344,13 @@ def ring_flash_attention_fn(
             raise ValueError(
                 f"block sizes ({bq}, {bk}) must divide the local "
                 f"sequence length {s_loc}")
+        if window is not None and (not causal or window < 1):
+            raise ValueError(
+                f"window={window} requires causal=True and window >= 1")
         itp = (
             (jax.default_backend() == "cpu") if interpret is None
             else interpret
         )
-        return _ring_flash(q, k, v, causal, axis_name, bq, bk, itp)
+        return _ring_flash(q, k, v, causal, axis_name, bq, bk, itp, window)
 
     return attend
