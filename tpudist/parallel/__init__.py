@@ -32,6 +32,7 @@ from tpudist.parallel.pipeline import (
     make_pipeline_train_step,
     make_stacked_pipeline_train_step,
     stacked_state_specs,
+    state_specs_like,
 )
 from tpudist.parallel.ps_hybrid import (
     make_ps_hybrid_forward,
@@ -87,4 +88,5 @@ __all__ = [
     "ps_state_specs",
     "sharded_bag_lookup",
     "stacked_state_specs",
+    "state_specs_like",
 ]

@@ -13,9 +13,11 @@ some of those patterns, so it stays off for all strategies uniformly.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
+from jax import lax
 from jax.sharding import Mesh
 
 
@@ -32,3 +34,53 @@ def jit_sharded_step(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate_first else ())
+
+
+# --------------------------------------------------------------------------
+# AD-correct manual collectives (the Megatron "f"/"g" operators)
+# --------------------------------------------------------------------------
+#
+# Under ``shard_map`` with ``check_vma=False``, the transpose of ``psum``
+# is ``psum`` — so differentiating a row-parallel matmul's output psum
+# would scale cotangents by the axis size.  The classic fix is a pair of
+# custom-vjp operators:
+#
+#   ``psum_fwd_id_bwd``  — psum forward, identity backward ("g"): ends a
+#     row-parallel layer (partial sums join; the cotangent is already
+#     replicated, so backward passes it through).
+#   ``id_fwd_psum_bwd``  — identity forward, psum backward ("f"): starts a
+#     column-parallel layer from a replicated activation (forward is a
+#     no-op; the backward sums each shard's cotangent contribution).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_fwd_id_bwd(x, axis_name: str):
+    """``psum`` over ``axis_name`` whose VJP is the identity."""
+    return lax.psum(x, axis_name)
+
+
+def _psum_id_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _psum_id_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+psum_fwd_id_bwd.defvjp(_psum_id_fwd, _psum_id_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def id_fwd_psum_bwd(x, axis_name: str):
+    """Identity whose VJP is a ``psum`` over ``axis_name``."""
+    return x
+
+
+def _id_psum_fwd(x, axis_name):
+    return x, None
+
+
+def _id_psum_bwd(axis_name, _, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+id_fwd_psum_bwd.defvjp(_id_psum_fwd, _id_psum_bwd)

@@ -291,6 +291,27 @@ def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage"):
     return jax.tree.map(leaf_spec, state)
 
 
+def state_specs_like(state, param_specs):
+    """Full-TrainState spec tree from a params spec tree: every opt-state
+    subtree that structurally mirrors the params (Adam moments etc.)
+    gets ``param_specs``; everything else (counts, scalars) replicates.
+
+    Structure-based matching (the `ps_state_specs` precedent), so two
+    param leaves sharing a shape but needing different specs can never
+    cross-contaminate each other's optimizer moments."""
+    param_treedef = jax.tree.structure(state.params)
+
+    def mirrors_params(subtree) -> bool:
+        return jax.tree.structure(subtree) == param_treedef
+
+    opt_specs = jax.tree.map(
+        lambda sub: (param_specs if mirrors_params(sub)
+                     else jax.tree.map(lambda _: P(), sub)),
+        state.opt_state, is_leaf=mirrors_params)
+    return state.replace(
+        params=param_specs, opt_state=opt_specs, step=P(), rng=P())
+
+
 def make_stacked_pipeline_train_step(
     block_fn: StageFn,
     loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
@@ -301,6 +322,7 @@ def make_stacked_pipeline_train_step(
     stage_axis: str = "stage",
     remat: bool = False,
     donate: bool = True,
+    state_specs=None,
 ):
     """Pipeline of HOMOGENEOUS blocks with stage-sharded parameters.
 
@@ -313,6 +335,14 @@ def make_stacked_pipeline_train_step(
 
     ``state_example`` (a TrainState, concrete or abstract) is used only to
     derive the per-leaf sharding specs via :func:`stacked_state_specs`.
+
+    ``state_specs`` overrides the derived specs — the hook for 3-D
+    DP×PP×TP runs: shard param leaves over a ``model`` axis too and make
+    ``block_fn`` a tensor-parallel block built from the AD-correct
+    collectives in :mod:`tpudist.parallel.common`
+    (``id_fwd_psum_bwd`` / ``psum_fwd_id_bwd``); gradients for every
+    sharded leaf stay local to its shard, so the data-axis mean below
+    remains the only cross-shard gradient collective.
     """
     n_stages = mesh.shape[stage_axis]
     for path, leaf in jax.tree_util.tree_leaves_with_path(state_example.params):
@@ -322,7 +352,8 @@ def make_stacked_pipeline_train_step(
                 f"[{n_stages}, ...]; {jax.tree_util.keystr(path)} has shape "
                 f"{getattr(leaf, 'shape', None)}"
             )
-    state_specs = stacked_state_specs(state_example, n_stages, stage_axis)
+    if state_specs is None:
+        state_specs = stacked_state_specs(state_example, n_stages, stage_axis)
 
     def _step(state, batch):
         x, y = batch
