@@ -337,3 +337,36 @@ class TestThreeDParallel:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-5),
             new_state.params, ref_params)
+
+
+def test_state_specs_like_single_leaf_params():
+    """Bare-array params with Adam: the scalar count must replicate, not
+    inherit the rank-3 param spec (structure-only matching would)."""
+    from jax.sharding import PartitionSpec as PS
+
+    from tpudist.parallel.pipeline import state_specs_like
+
+    params = jnp.zeros((2, 4, 8))
+    state = TrainState.create(None, params, optax.adam(1e-3))
+    specs = state_specs_like(state, PS("stage", None, "model"))
+    count_spec = specs.opt_state[0].count
+    assert count_spec == PS(), count_spec
+    assert specs.opt_state[0].mu == PS("stage", None, "model")
+
+
+def test_stacked_specs_must_shard_stage_dim():
+    from jax.sharding import PartitionSpec as PS
+
+    from tpudist.parallel.pipeline import (
+        make_stacked_pipeline_train_step, state_specs_like,
+    )
+    from tpudist.ops.losses import mse_loss
+
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2})
+    params = {"w": jnp.zeros((2, 4, 4))}
+    state = TrainState.create(None, params, optax.sgd(0.1))
+    bad = state_specs_like(state, {"w": PS(None, None, "model")})
+    with pytest.raises(ValueError, match="leading .stage. dim"):
+        make_stacked_pipeline_train_step(
+            lambda p, x: x, mse_loss, mesh, 2, state_example=state,
+            state_specs=bad)

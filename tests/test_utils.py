@@ -1,0 +1,121 @@
+"""Utility-layer coverage: config CLI override, metrics, tree helpers."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.utils.config import cli_override, config_field
+from tpudist.utils.metrics import MetricLogger, ThroughputMeter, maybe_profile
+from tpudist.utils.trees import (
+    flatten_with_names,
+    host_to_leaf,
+    leaf_to_host,
+    tree_size_bytes,
+    tree_to_numpy,
+    unflatten_like,
+)
+
+
+@dataclasses.dataclass
+class _Cfg:
+    epochs: int = config_field(3, "total epochs")
+    lr: float = config_field(0.1, "learning rate")
+    name: str = config_field("run", "run name")
+    bf16: bool = config_field(False, "bfloat16 compute")
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = cli_override(_Cfg, [])
+        assert cfg == _Cfg()
+
+    def test_override_each_type(self):
+        cfg = cli_override(
+            _Cfg, ["--epochs", "7", "--lr", "3e-4", "--name", "x",
+                   "--bf16", "true"])
+        assert cfg.epochs == 7 and isinstance(cfg.epochs, int)
+        assert cfg.lr == pytest.approx(3e-4)
+        assert cfg.name == "x"
+        assert cfg.bf16 is True
+
+    def test_bool_false_spellings(self):
+        for spelling in ("0", "false", "no"):
+            assert cli_override(_Cfg, ["--bf16", spelling]).bf16 is False
+
+
+class TestMetrics:
+    def test_throughput_meter_excludes_warmup(self):
+        m = ThroughputMeter(warmup_steps=1)
+        m.start()
+        time.sleep(0.05)   # warmup step: excluded from the rate
+        m.step(1000)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.01:
+            pass
+        m.step(100)
+        rate = m.items_per_sec
+        assert 0 < rate < 100 / 0.0099
+        assert m.mean_step_time > 0
+
+    def test_metric_logger_means(self):
+        ml = MetricLogger()
+        ml.update(loss=2.0, acc=0.5)
+        ml.update(loss=4.0, acc=1.0)
+        means = ml.means()
+        assert means["loss"] == pytest.approx(3.0)
+        assert means["acc"] == pytest.approx(0.75)
+        ml.reset()
+        assert ml.means() == {}
+
+    def test_maybe_profile_noop_and_trace(self, tmp_path):
+        with maybe_profile(None):
+            pass  # no-op path
+        with maybe_profile(str(tmp_path / "trace")):
+            jnp.zeros((4,)).block_until_ready()
+        assert any((tmp_path / "trace").rglob("*")), "no trace written"
+
+
+class TestTrees:
+    def _tree(self):
+        return {
+            "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "nest": {"b": jnp.ones((4,), jnp.int32)},
+            "key": jax.random.key(0),
+            "scalar": jnp.float32(2.5),
+        }
+
+    def test_numpy_roundtrip_including_prng_keys(self):
+        tree = self._tree()
+        host = tree_to_numpy(tree)
+        back = jax.tree.map(host_to_leaf, tree, host)
+        assert jnp.issubdtype(back["key"].dtype, jax.dtypes.prng_key)
+        np.testing.assert_array_equal(
+            jax.random.key_data(back["key"]),
+            jax.random.key_data(tree["key"]))
+        np.testing.assert_array_equal(np.asarray(back["a"]),
+                                      np.asarray(tree["a"]))
+
+    def test_flatten_unflatten_roundtrip(self):
+        tree = self._tree()
+        named = flatten_with_names(tree_to_numpy(tree))
+        assert all(isinstance(k, str) for k in named)
+        back = unflatten_like(tree, named)
+        np.testing.assert_array_equal(
+            np.asarray(back["nest"]["b"]), np.asarray(tree["nest"]["b"]))
+
+    def test_unflatten_shape_mismatch_raises(self):
+        tree = self._tree()
+        named = flatten_with_names(tree_to_numpy(tree))
+        bad = dict(named)
+        first = next(k for k in bad if "a" in k)
+        bad[first] = np.zeros((9, 9), np.float32)
+        with pytest.raises((ValueError, AssertionError)):
+            unflatten_like(tree, bad)
+
+    def test_tree_size_bytes(self):
+        assert tree_size_bytes(
+            {"x": jnp.zeros((2, 3), jnp.float32)}) == 24

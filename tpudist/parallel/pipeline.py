@@ -293,16 +293,25 @@ def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage"):
 
 def state_specs_like(state, param_specs):
     """Full-TrainState spec tree from a params spec tree: every opt-state
-    subtree that structurally mirrors the params (Adam moments etc.)
-    gets ``param_specs``; everything else (counts, scalars) replicates.
+    subtree that mirrors the params — same pytree structure AND same
+    per-leaf shapes (Adam moments etc.) — gets ``param_specs``;
+    everything else (counts, scalars) replicates.
 
-    Structure-based matching (the `ps_state_specs` precedent), so two
-    param leaves sharing a shape but needing different specs can never
-    cross-contaminate each other's optimizer moments."""
+    Matching by structure + shape rather than shape alone means two param
+    leaves sharing a shape but needing different specs can never
+    cross-contaminate each other's optimizer moments (same motivation as
+    `ps_state_specs`' match-by-path, adapted to optax's mirrored trees);
+    the shape condition also keeps scalar state (e.g. Adam's count) from
+    matching when ``params`` is a single bare array."""
     param_treedef = jax.tree.structure(state.params)
+    param_shapes = [getattr(l, "shape", None)
+                    for l in jax.tree.leaves(state.params)]
 
     def mirrors_params(subtree) -> bool:
-        return jax.tree.structure(subtree) == param_treedef
+        if jax.tree.structure(subtree) != param_treedef:
+            return False
+        return [getattr(l, "shape", None)
+                for l in jax.tree.leaves(subtree)] == param_shapes
 
     opt_specs = jax.tree.map(
         lambda sub: (param_specs if mirrors_params(sub)
@@ -354,6 +363,19 @@ def make_stacked_pipeline_train_step(
             )
     if state_specs is None:
         state_specs = stacked_state_specs(state_example, n_stages, stage_axis)
+    else:
+        # The schedule indexes the LOCAL stage slice (`p[0]`); a param spec
+        # that doesn't shard dim 0 over the stage axis would silently run
+        # every stage on chunk 0's weights.
+        for path, spec in jax.tree_util.tree_leaves_with_path(
+                state_specs.params,
+                is_leaf=lambda x: isinstance(x, P)):
+            if not (isinstance(spec, P) and len(spec) >= 1
+                    and spec[0] == stage_axis):
+                raise ValueError(
+                    f"state_specs param leaf {jax.tree_util.keystr(path)} "
+                    f"must shard its leading (stage) dim over "
+                    f"{stage_axis!r}; got {spec}")
 
     def _step(state, batch):
         x, y = batch
