@@ -107,6 +107,30 @@ class TestShardedLoader:
         assert not np.array_equal(e0, e1)
         assert set(e0.ravel()) == set(e1.ravel())
 
+    def test_valid_mask_marks_wraparound_padding(self, devices8):
+        """drop_last=False pads shards by wrap-around; valid_mask must mark
+        exactly the n real samples True, aligned with batch assembly."""
+        mesh = data_mesh(8)
+        x = np.arange(10, dtype=np.float32)[:, None]
+        loader = ShardedLoader([x], global_batch=16, mesh=mesh,
+                               drop_last=False)
+        (xb,) = next(iter(loader))
+        mask = loader.valid_mask(0)
+        assert mask.shape == (16,)
+        assert int(mask.sum()) == 10
+        # every True entry is a distinct real sample; padding duplicates them
+        vals = np.asarray(xb).ravel()
+        assert set(vals[mask]) == set(range(10))
+        assert all(v in vals[mask] for v in vals[~mask])
+
+    def test_valid_mask_all_true_with_drop_last(self, devices8):
+        mesh = data_mesh(8)
+        x = np.arange(64, dtype=np.float32)[:, None]
+        loader = ShardedLoader([x], global_batch=16, mesh=mesh,
+                               drop_last=True)
+        for step in range(loader.steps_per_epoch):
+            assert loader.valid_mask(step).all()
+
 
 def test_epoch_stacked_matches_single_steps():
     """epoch_stacked groups == the same steps from epoch(), stacked."""

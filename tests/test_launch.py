@@ -108,3 +108,14 @@ def test_elastic_discovery_sets_world_size(tmp_path):
 def test_min_nprocs_above_nprocs_rejected():
     with pytest.raises(ValueError, match="must not exceed"):
         launch([sys.executable, WORKER], nprocs=2, min_nprocs=4)
+
+
+@pytest.mark.parametrize("value", ["1:2:3", "abc", "-1", "5:-2"])
+def test_malformed_restart_cooldown_rejected(value, capsys):
+    """CLI rejects cooldowns that are not SECONDS or LO:HI (ADVICE r1:
+    '1:2:3' was silently read as the range (1, 3))."""
+    from tpudist.runtime.launch import main
+
+    with pytest.raises(SystemExit):
+        main(["-n", "1", "--restart-cooldown", value, "--", WORKER])
+    assert "--restart-cooldown" in capsys.readouterr().err

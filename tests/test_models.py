@@ -98,6 +98,30 @@ def test_transformer_remat_matches_plain():
             np.asarray(a), np.asarray(b), atol=1e-6), g1, g2)
 
 
+def test_factory_window_mismatch_rejected():
+    """An attention_fn built with its own window must not be silently
+    overridden by cfg.attention_window (ADVICE r1): disagreement raises;
+    agreement trains fine."""
+    import pytest
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.ops.flash_attention import flash_attention_fn
+
+    toks = jnp.zeros((1, 16), jnp.int32)
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                            embed_dim=32, max_seq_len=16)
+    model = TransformerLM(cfg, attention_fn=flash_attention_fn(window=4))
+    with pytest.raises(ValueError, match="was built with window"):
+        model.init(jax.random.key(0), toks)
+
+    agreed_cfg = TransformerConfig(
+        vocab_size=32, num_layers=1, num_heads=2, embed_dim=32,
+        max_seq_len=16, attention_window=4)
+    ok = TransformerLM(agreed_cfg, attention_fn=flash_attention_fn(window=4))
+    params = ok.init(jax.random.key(0), toks)["params"]
+    assert ok.apply({"params": params}, toks).shape == (1, 16, 32)
+
+
 class TestLosses:
     def test_cross_entropy_perfect_logits_all_ranks(self):
         """Perfect one-hot logits → ~0 loss for [N,C] AND [B,S,V] shapes.

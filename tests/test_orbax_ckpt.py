@@ -103,3 +103,24 @@ def test_same_and_regressing_steps_never_dropped(tmp_path, devices8):
     step, _, meta = OrbaxCheckpointer(tmp_path / "ckpt").restore_latest(template)
     assert meta == {"tag": "c"}   # newest durable commit wins
     assert step == 2              # caller-visible (logical) step
+
+
+def test_async_quick_commits_never_dropped(tmp_path, devices8):
+    """With ``async_save=True``, ``latest_step()`` may not yet see an
+    in-flight save; two quick commits with non-increasing logical steps must
+    still both land (the collision remap tracks the last physical step
+    issued in-process, ADVICE r1)."""
+    state = _sharded_state(devices8)
+    ckpt = OrbaxCheckpointer(tmp_path / "ckpt", keep=8, async_save=True)
+    ckpt.save(3, state, meta={"tag": "a"})
+    ckpt.save(3, state, meta={"tag": "b"})  # before the first save finishes
+    ckpt.save(1, state, meta={"tag": "c"})
+    ckpt.wait()
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+    reader = OrbaxCheckpointer(tmp_path / "ckpt")
+    step, _, meta = reader.restore_latest(template)
+    assert meta == {"tag": "c"} and step == 1
+    # all three commits durable, none skipped
+    assert len(reader._mngr.all_steps()) == 3

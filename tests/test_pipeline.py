@@ -339,6 +339,133 @@ class TestThreeDParallel:
             new_state.params, ref_params)
 
 
+    def test_replicated_leaf_grads_synced_over_model_axis(self):
+        """A param leaf REPLICATED over the tensor axis (a scale applied
+        between the Megatron f/g collectives, where cotangents are per-shard
+        partials) must come out with the full gradient — the grad psum over
+        sync axes missing from its spec (ADVICE r1 medium)."""
+        from tpudist.parallel.common import id_fwd_psum_bwd, psum_fwd_id_bwd
+        from tpudist.parallel.pipeline import (
+            make_stacked_pipeline_train_step, state_specs_like,
+        )
+
+        P_, M, d, ff = 2, 4, 8, 16
+        mesh = make_mesh({"data": 2, "stage": P_, "model": 2})
+        rng = np.random.default_rng(1)
+        params = {
+            "scale": jnp.asarray(
+                1.0 + 0.1 * rng.standard_normal((P_, d)), jnp.float32),
+            "up": jnp.asarray(
+                rng.standard_normal((P_, d, ff)) * 0.3, jnp.float32),
+            "down": jnp.asarray(
+                rng.standard_normal((P_, ff, d)) * 0.3, jnp.float32),
+        }
+
+        def tp_block(p, x):
+            x = id_fwd_psum_bwd(x, "model")
+            x = x * p["scale"]  # replicated leaf inside the f..g region
+            h = jnp.tanh(x @ p["up"])
+            return psum_fwd_id_bwd(h @ p["down"], "model")
+
+        def full_block(p, x):
+            return jnp.tanh((x * p["scale"]) @ p["up"]) @ p["down"]
+
+        x = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+
+        def seq_loss(params, x, y):
+            h = x
+            for c in range(P_):
+                h = full_block(jax.tree.map(lambda p: p[c], params), h)
+            return mse_loss(h, y)
+
+        tx = optax.sgd(0.1)
+        _, ref_grads = jax.value_and_grad(seq_loss)(params, x, y)
+        ref_params = TrainState.create(None, params, tx).apply_gradients(
+            ref_grads).params
+
+        from jax.sharding import PartitionSpec as PS
+
+        state = TrainState.create(None, params, tx)
+        state_specs = state_specs_like(
+            state, {"scale": PS("stage"),  # replicated over 'model'
+                    "up": PS("stage", None, "model"),
+                    "down": PS("stage", "model", None)})
+        step = make_stacked_pipeline_train_step(
+            tp_block, mse_loss, mesh, num_microbatches=M,
+            state_example=state, state_specs=state_specs, donate=False)
+        new_state, _ = step(state, x, y)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            new_state.params, ref_params)
+
+
+    def test_per_leaf_grad_sync_for_mixed_blocks(self):
+        """A block mixing a partial-cotangent leaf (scale inside f..g) with
+        an already-complete one (bias added AFTER psum_fwd_id_bwd, the
+        row-parallel bias position) needs per-leaf sync axes: psum for the
+        scale, none for the bias."""
+        from tpudist.parallel.common import id_fwd_psum_bwd, psum_fwd_id_bwd
+        from tpudist.parallel.pipeline import (
+            make_stacked_pipeline_train_step, state_specs_like,
+        )
+
+        P_, M, d, ff = 2, 4, 8, 16
+        mesh = make_mesh({"data": 2, "stage": P_, "model": 2})
+        rng = np.random.default_rng(2)
+        params = {
+            "scale": jnp.asarray(
+                1.0 + 0.1 * rng.standard_normal((P_, d)), jnp.float32),
+            "bias": jnp.asarray(
+                0.1 * rng.standard_normal((P_, d)), jnp.float32),
+            "up": jnp.asarray(
+                rng.standard_normal((P_, d, ff)) * 0.3, jnp.float32),
+            "down": jnp.asarray(
+                rng.standard_normal((P_, ff, d)) * 0.3, jnp.float32),
+        }
+
+        def tp_block(p, x):
+            x = id_fwd_psum_bwd(x, "model")
+            h = jnp.tanh((x * p["scale"]) @ p["up"])
+            return psum_fwd_id_bwd(h @ p["down"], "model") + p["bias"]
+
+        def full_block(p, x):
+            return jnp.tanh((x * p["scale"]) @ p["up"]) @ p["down"] + p["bias"]
+
+        x = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+
+        def seq_loss(params, x, y):
+            h = x
+            for c in range(P_):
+                h = full_block(jax.tree.map(lambda p: p[c], params), h)
+            return mse_loss(h, y)
+
+        tx = optax.sgd(0.1)
+        _, ref_grads = jax.value_and_grad(seq_loss)(params, x, y)
+        ref_params = TrainState.create(None, params, tx).apply_gradients(
+            ref_grads).params
+
+        from jax.sharding import PartitionSpec as PS
+
+        state = TrainState.create(None, params, tx)
+        state_specs = state_specs_like(
+            state, {"scale": PS("stage"), "bias": PS("stage"),
+                    "up": PS("stage", None, "model"),
+                    "down": PS("stage", "model", None)})
+        step = make_stacked_pipeline_train_step(
+            tp_block, mse_loss, mesh, num_microbatches=M,
+            state_example=state, state_specs=state_specs, donate=False,
+            grad_sync_axes={"scale": ("model",), "bias": (),
+                            "up": ("model",), "down": ("model",)})
+        new_state, _ = step(state, x, y)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            new_state.params, ref_params)
+
+
 def test_state_specs_like_single_leaf_params():
     """Bare-array params with Adam: the scalar count must replicate, not
     inherit the rank-3 param spec (structure-only matching would)."""

@@ -100,6 +100,24 @@ class ShardedLoader:
             [p[lo : lo + self.local_batch] for p in per_shard]
         )
 
+    def valid_mask(self, step: int) -> np.ndarray:
+        """Boolean mask aligned with batch ``step``'s global assembly order:
+        True for real samples, False for the wrap-around padding a
+        ``drop_last=False`` sampler appends to equalize shards (the
+        DistributedSampler duplicates).  Exact-accuracy evaluation weights
+        by this mask so padded duplicates can't skew the numerator or the
+        denominator (`Trainer.test`).  Independent of the epoch: padding
+        occupies fixed stream positions regardless of the shuffle."""
+        lo = step * self.local_batch
+        parts = []
+        for s in self.samplers:
+            hi = min(lo + self.local_batch, s.shard_size)
+            j = np.arange(lo, max(hi, lo))
+            # element j of shard r sits at stream position r + j*num_shards;
+            # positions >= n are wrap-around padding (sampler.indices)
+            parts.append(s.shard + j * self.num_shards < s.n)
+        return np.concatenate(parts)
+
     def epoch(self, epoch: int, start_step: int = 0) -> Iterator[tuple]:
         """Yield one epoch of batches; ``epoch`` seeds the shuffle
         (the ``sampler.set_epoch`` contract, `mnist_ddp_elastic.py:84`).

@@ -49,6 +49,12 @@ class OrbaxCheckpointer:
                 "orbax-checkpoint is unavailable; use "
                 "tpudist.elastic.checkpoint.Checkpointer instead")
         self.directory = Path(directory).absolute()
+        # Highest physical step issued by THIS process.  With async_save,
+        # ``latest_step()`` may not yet include an in-flight save, so the
+        # collision remap in :meth:`save` must not rely on it alone: two
+        # quick commits with non-increasing logical steps could otherwise
+        # compute the same physical step and the second would be skipped.
+        self._last_physical: int | None = None
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -70,8 +76,11 @@ class OrbaxCheckpointer:
         # colliding step is written as ``latest + 1`` with the caller's
         # step preserved in the metadata — saves stay atomic (new
         # directory + rename) and no durable commit is ever dropped.
-        latest = self._mngr.latest_step()
+        issued = [s for s in (self._mngr.latest_step(), self._last_physical)
+                  if s is not None]
+        latest = max(issued) if issued else None
         physical = step if latest is None or step > latest else latest + 1
+        self._last_physical = physical
         saved = self._mngr.save(
             physical,
             args=ocp.args.Composite(

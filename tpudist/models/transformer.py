@@ -146,15 +146,25 @@ class CausalSelfAttention(nn.Module):
                           dtype=cfg.compute_dtype, name="kv")(x)
             kv = kv.reshape(b, s, 2, cfg.kv_heads, cfg.head_dim)
             k, v = kv[:, :, 0], kv[:, :, 1]
+        # cfg is the single source of truth for the sliding window: a
+        # factory built with its OWN window (flash_attention_fn(window=W))
+        # that disagrees is rejected — in BOTH branches, since the decode
+        # cache masks from cfg alone and would otherwise silently discard
+        # the factory's window.
+        fw = getattr(self.attention_fn, "factory_window", None)
+        if fw is not None and fw != cfg.attention_window:
+            raise ValueError(
+                f"attention_fn was built with window={fw} but "
+                f"cfg.attention_window={cfg.attention_window}; set the "
+                "window on TransformerConfig (the single source of "
+                "truth) or make the two agree")
         if self.decode:
             out = self._cached_attend(q, k, v)
         else:
-            # cfg is the single source of truth for the sliding window:
-            # passed unconditionally (None = full causal) so a factory-level
-            # window on the attention_fn can never silently diverge from
-            # the decode cache mask, and a fn that doesn't accept the
-            # kwarg fails loudly instead of training full-attention
-            # against a windowed decode cache.
+            # window passed unconditionally (None = full causal) so the
+            # training path can never diverge from the decode cache mask,
+            # and a fn that doesn't accept the kwarg fails loudly instead
+            # of training full-attention against a windowed decode cache.
             out = self.attention_fn(q, k, v, causal=causal,
                                     window=cfg.attention_window)
         out = out.reshape(b, s, cfg.embed_dim)

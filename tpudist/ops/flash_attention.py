@@ -537,4 +537,8 @@ def flash_attention_fn(
                                block_k=block_k, interpret=interpret,
                                window=eff)
 
+    # Discoverable by TransformerLM: a model whose cfg.attention_window
+    # disagrees with this must fail loudly instead of silently training
+    # full-attention against a windowed decode cache (or vice versa).
+    attend.factory_window = factory_window
     return attend

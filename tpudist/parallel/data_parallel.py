@@ -180,8 +180,9 @@ def make_dp_eval_step(
 
     Counts (not fractions) are psum'd; the caller divides by the number of
     samples it actually fed (with ``drop_last=False`` loaders that includes
-    wrap-around-padded duplicates — use ``drop_last=True`` eval loaders for
-    duplicate-free accuracy).  The reference evaluates the full
+    wrap-around-padded duplicates — use :func:`make_dp_masked_eval_step`
+    with ``ShardedLoader.valid_mask`` for duplicate-exact accuracy, as
+    ``Trainer.test`` does).  The reference evaluates the full
     (sampler-sharded) test set on every rank and prints per-rank accuracy
     (`mnist_ddp_elastic.py:117-130`); here every shard evaluates its slice
     once and the global count is exact.
@@ -195,6 +196,35 @@ def make_dp_eval_step(
 
     stepped = jit_sharded_step(
         _step, mesh, (P(), P(axis)), P(), donate_first=False
+    )
+
+    def eval_step(params, *batch):
+        return stepped(params, batch)
+
+    return eval_step
+
+
+def make_dp_masked_eval_step(
+    predict_fn: Callable[[Any, tuple], jnp.ndarray],
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Exact-count evaluation: ``eval_step(params, *inputs, labels, mask)
+    -> (correct, total)`` where ``mask`` (bool, batch-aligned) marks real
+    samples — wrap-around padding from ``drop_last=False`` sharding
+    (``ShardedLoader.valid_mask``) contributes to neither count, so
+    accuracy is exact over the true dataset regardless of padding."""
+
+    def _step(params, batch):
+        *inputs, labels, mask = batch
+        logits = predict_fn(params, tuple(inputs))
+        hit = (jnp.argmax(logits, -1) == labels) & mask
+        correct = jnp.sum(hit.astype(jnp.int32))
+        total = jnp.sum(mask.astype(jnp.int32))
+        return lax.psum(correct, axis), lax.psum(total, axis)
+
+    stepped = jit_sharded_step(
+        _step, mesh, (P(), P(axis)), (P(), P()), donate_first=False
     )
 
     def eval_step(params, *batch):

@@ -332,6 +332,7 @@ def make_stacked_pipeline_train_step(
     remat: bool = False,
     donate: bool = True,
     state_specs=None,
+    grad_sync_axes: Sequence[str] | Any | None = None,
 ):
     """Pipeline of HOMOGENEOUS blocks with stage-sharded parameters.
 
@@ -349,9 +350,26 @@ def make_stacked_pipeline_train_step(
     DP×PP×TP runs: shard param leaves over a ``model`` axis too and make
     ``block_fn`` a tensor-parallel block built from the AD-correct
     collectives in :mod:`tpudist.parallel.common`
-    (``id_fwd_psum_bwd`` / ``psum_fwd_id_bwd``); gradients for every
-    sharded leaf stay local to its shard, so the data-axis mean below
-    remains the only cross-shard gradient collective.
+    (``id_fwd_psum_bwd`` / ``psum_fwd_id_bwd``).  Gradients for every
+    leaf SHARDED over a tensor axis stay local to its shard; a leaf left
+    REPLICATED over a tensor axis (e.g. a layernorm scale inside a TP
+    block) receives per-shard PARTIAL gradients — Megatron cotangents
+    between the f/g collectives are partial sums — so its grads are
+    ``psum``'d over every ``grad_sync_axes`` axis missing from its spec.
+    ``grad_sync_axes`` defaults to all mesh axes except ``data_axis`` and
+    ``stage_axis`` when ``state_specs`` is given (the 3-D contract:
+    ``block_fn`` distributes compute over every extra mesh axis).
+
+    The psum is only correct for replicated leaves whose cotangents are
+    per-shard partials (used strictly between the f/g collectives); a
+    replicated leaf used OUTSIDE that region (e.g. a bias added after
+    ``psum_fwd_id_bwd``, the standard row-parallel bias position) already
+    has the COMPLETE gradient on every shard, and a psum would scale it by
+    the axis size.  For blocks mixing both kinds, pass ``grad_sync_axes``
+    as a PYTREE matching ``params``: each leaf a tuple of axis names to
+    sync for that leaf (``()`` for already-complete leaves).  A flat
+    sequence applies the same axes to every leaf; ``()`` disables the sync
+    entirely (every extra mesh axis unused inside ``block_fn``).
     """
     n_stages = mesh.shape[stage_axis]
     for path, leaf in jax.tree_util.tree_leaves_with_path(state_example.params):
@@ -363,6 +381,8 @@ def make_stacked_pipeline_train_step(
             )
     if state_specs is None:
         state_specs = stacked_state_specs(state_example, n_stages, stage_axis)
+        if grad_sync_axes is None:
+            grad_sync_axes = ()
     else:
         # The schedule indexes the LOCAL stage slice (`p[0]`); a param spec
         # that doesn't shard dim 0 over the stage axis would silently run
@@ -376,6 +396,28 @@ def make_stacked_pipeline_train_step(
                     f"state_specs param leaf {jax.tree_util.keystr(path)} "
                     f"must shard its leading (stage) dim over "
                     f"{stage_axis!r}; got {spec}")
+        if grad_sync_axes is None:
+            grad_sync_axes = tuple(a for a in mesh.axis_names
+                                   if a not in (data_axis, stage_axis))
+    # Per-leaf static plan: which sync axes each param leaf's spec leaves
+    # it replicated over (its grads there are per-shard partials that the
+    # data-axis mean alone would silently desync — see docstring).
+    spec_leaves = jax.tree.leaves(
+        state_specs.params, is_leaf=lambda x: isinstance(x, P))
+    if isinstance(grad_sync_axes, (tuple, list)):
+        sync_per_leaf = [tuple(grad_sync_axes)] * len(spec_leaves)
+    else:  # pytree matching params: per-leaf axis tuples
+        sync_per_leaf = [
+            tuple(s) for s in jax.tree.leaves(
+                grad_sync_axes,
+                is_leaf=lambda x: isinstance(x, (tuple, list)))]
+        if len(sync_per_leaf) != len(spec_leaves):
+            raise ValueError(
+                f"grad_sync_axes pytree has {len(sync_per_leaf)} leaves "
+                f"but params have {len(spec_leaves)}")
+    missing_per_leaf = [
+        tuple(a for a in sync if a not in _spec_axes(s))
+        for sync, s in zip(sync_per_leaf, spec_leaves)]
 
     def _step(state, batch):
         x, y = batch
@@ -406,8 +448,14 @@ def make_stacked_pipeline_train_step(
 
         loss, grads = jax.value_and_grad(local_loss)(state.params)
         # stage-sharded params: each device's grads are for its own slice
-        # already — only the data-axis average is needed.
+        # already — only the data-axis average is needed, plus (3-D) the
+        # tensor-axis psum for any leaf replicated over a sync axis.
         grads = lax.pmean(grads, data_axis)
+        if any(missing_per_leaf):
+            leaves, treedef = jax.tree.flatten(grads)
+            leaves = [lax.psum(g, m) if m else g
+                      for g, m in zip(leaves, missing_per_leaf)]
+            grads = jax.tree.unflatten(treedef, leaves)
         metrics = {"loss": lax.pmean(lax.psum(loss, stage_axis), data_axis)}
         return state.apply_gradients(grads), metrics
 
@@ -420,6 +468,16 @@ def make_stacked_pipeline_train_step(
         return stepped(state, (x, y))
 
     return train_step
+
+
+def _spec_axes(spec) -> set:
+    """Mesh axes a PartitionSpec shards over (flattening tuple entries)."""
+    axes: set = set()
+    for part in spec:
+        if part is None:
+            continue
+        axes.update(part if isinstance(part, tuple) else (part,))
+    return axes
 
 
 # --------------------------------------------------------------------------

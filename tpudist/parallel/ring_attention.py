@@ -353,4 +353,7 @@ def ring_flash_attention_fn(
         )
         return _ring_flash(q, k, v, causal, axis_name, bq, bk, itp, window)
 
+    # See flash_attention_fn: lets TransformerLM reject a factory window
+    # that disagrees with cfg.attention_window instead of discarding it.
+    attend.factory_window = factory_window
     return attend

@@ -237,9 +237,12 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError:
             ap.error(f"--restart-cooldown must be SECONDS or LO:HI, got "
                      f"{args.restart_cooldown!r}")
+        if len(parts) > 2:
+            ap.error(f"--restart-cooldown must be SECONDS or LO:HI, got "
+                     f"{args.restart_cooldown!r}")
         if any(p < 0 for p in parts):
             ap.error("--restart-cooldown values must be non-negative")
-        cooldown = (parts[0], parts[-1]) if len(parts) > 1 else parts[0]
+        cooldown = (parts[0], parts[1]) if len(parts) == 2 else parts[0]
     if args.min_nprocs is not None and args.min_nprocs > args.nprocs:
         ap.error(f"--min-nprocs ({args.min_nprocs}) must not exceed "
                  f"-n ({args.nprocs})")

@@ -28,7 +28,7 @@ from tpudist.elastic.checkpoint import restore_pytree, save_pytree
 from tpudist.ops.losses import cross_entropy
 from tpudist.parallel.data_parallel import (
     broadcast_params,
-    make_dp_eval_step,
+    make_dp_masked_eval_step,
     make_dp_train_loop,
     make_dp_train_step,
 )
@@ -111,7 +111,7 @@ class Trainer:
             make_dp_train_loop(dp_loss, mesh)
             if config.steps_per_dispatch > 1 else None
         )
-        self.eval_step = make_dp_eval_step(dp_predict, mesh)
+        self.eval_step = make_dp_masked_eval_step(dp_predict, mesh)
         self.metrics = MetricLogger()
         self.throughput = ThroughputMeter(warmup_steps=2)
 
@@ -209,10 +209,17 @@ class Trainer:
         return summary
 
     def test(self) -> float:
+        """Exact test accuracy: each real sample counted once — the
+        validity mask zeroes wrap-around padding from ``drop_last=False``
+        sharding, and the denominator is the true number of evaluated
+        samples, not batches × batch-size (the reference divides by the
+        padded sampler length, `mnist_ddp_elastic.py:117-130`)."""
         assert self.test_loader is not None
         correct = 0
         seen = 0
-        for batch in self.test_loader.epoch(0):
-            correct += int(jax.device_get(self.eval_step(self.state.params, *batch)))
-            seen += self.test_loader.global_batch
+        for step, batch in enumerate(self.test_loader.epoch(0)):
+            mask = self.test_loader.valid_mask(step)
+            c, t = self.eval_step(self.state.params, *batch, mask)
+            correct += int(jax.device_get(c))
+            seen += int(jax.device_get(t))
         return correct / max(seen, 1)
