@@ -39,6 +39,16 @@ def setup_platform(argv: Sequence[str] | None = None) -> list[str]:
             from tpudist.runtime.simulate import force_cpu_devices
 
             force_cpu_devices(n)
+    elif (os.environ.get("JAX_PLATFORMS") == "cpu"
+          and "TPUDIST_NUM_PROCESSES" in os.environ):
+        # Spawned by tpudist.runtime.launch with the CPU platform: honor it
+        # even where site config force-pins a real backend via jax.config
+        # (which overrides the env var alone) — N launcher workers must
+        # never pile onto one real-TPU tunnel.
+        sim = True
+        from tpudist.runtime.simulate import force_cpu_devices
+
+        force_cpu_devices(1, check=False)
     if not sim:
         # Real backends pay multi-minute first compiles; cache persistently.
         from tpudist.runtime.cache import enable_compilation_cache

@@ -43,7 +43,18 @@ def main(argv=None) -> float:
     parser.add_argument("--limit", default=0, type=int)
     parser.add_argument("--resize-at", default="",
                         help="epoch:batch:new_world — inject one elastic resize")
+    parser.add_argument(
+        "--elastic", choices=["sim", "ttl"], default="sim",
+        help="sim: single process, --resize-at injects a synthetic resize; "
+             "ttl: REAL membership-driven elastic over the coordination "
+             "service — launch as `python -m tpudist.runtime.launch -n 3 "
+             "--min-nprocs 2 --elastic-inprocess -- "
+             "examples/horovod_mnist_elastic_tpu.py --elastic ttl` and "
+             "kill -9 a worker to watch survivors re-rendezvous")
     args = parser.parse_args(argv)
+
+    if args.elastic == "ttl":
+        return _ttl_main(args)
 
     import jax
     import numpy as np
@@ -144,6 +155,97 @@ def main(argv=None) -> float:
         correct += int(jax.device_get(eval_step(state.state.params, *batch)))
         seen += global_batch
     accuracy = correct / max(seen, 1)
+    print(f"accuracy: {100 * accuracy:.2f}%")  # `horovod_mnist_elastic.py:102`
+    return accuracy
+
+
+def _ttl_main(args) -> float:
+    """Membership-driven elastic (`horovod_mnist_elastic.py:108` semantics):
+    each process trains its rank's shard, syncs gradients through the
+    coordination-store collectives, and the TTL rendezvous — not a
+    simulated flag — decides when the world resizes."""
+    import math
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tpudist.data.mnist import load_mnist
+    from tpudist.data.sampler import ShardedSampler
+    from tpudist.elastic.state import ElasticState
+    from tpudist.elastic.worker import run_elastic_worker
+    from tpudist.models import ConvNet
+    from tpudist.ops.losses import nll_loss
+    from tpudist.train.state import TrainState
+
+    train_ds = load_mnist("train", n=args.limit or None)
+    test_ds = load_mnist("test", n=args.limit or None)
+    model = ConvNet()
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 28, 28, 1), np.float32))["params"]
+
+    def make_tx(world: int) -> optax.GradientTransformation:
+        return optax.adamw(args.base_lr / math.sqrt(world))
+
+    state = ElasticState(TrainState.create(model.apply, params, make_tx(1)))
+
+    def on_state_reset(es: ElasticState, old: int, new: int) -> None:
+        es.state = es.state.replace(tx=make_tx(new))
+        print(f"reset: world {old} -> {new}, "
+              f"lr -> {args.base_lr / math.sqrt(new):.5f}", flush=True)
+
+    state.register_reset_callbacks([on_state_reset])
+
+    @jax.jit
+    def grads_fn(params, x, y, rng):
+        def loss(p):
+            logits = model.apply(
+                {"params": p}, x, train=True, rngs={"dropout": rng})
+            return nll_loss(logits, y)
+
+        return jax.value_and_grad(loss)(params)
+
+    def train(es: ElasticState, ctx) -> None:
+        # dataset sharding re-derived per (re)start at the current world —
+        # the reference rebuilds its dataset per restart too
+        # (`horovod_mnist_elastic.py:57-58`)
+        sampler = ShardedSampler(
+            len(train_ds), ctx.world_size, ctx.rank, shuffle=True)
+        steps = sampler.shard_size // args.batch_size
+        gloss = float("nan")
+        for epoch in range(es.host.epoch, args.epochs):
+            idx = sampler.indices(epoch)
+            start = es.host.batch if epoch == es.host.epoch else 0
+            for b in range(start, steps):
+                sel = idx[b * args.batch_size:(b + 1) * args.batch_size]
+                rng = jax.random.fold_in(es.state.rng, ctx.rank)
+                loss, grads = grads_fn(
+                    es.state.params, train_ds.images[sel],
+                    train_ds.labels[sel], rng)
+                grads, gloss = ctx.collectives.allreduce_mean(
+                    (grads, np.asarray(float(loss))))
+                es.state = es.state.apply_gradients(grads)
+                es.host.epoch, es.host.batch = epoch, b + 1
+                if (b + 1) % args.commit_every == 0:
+                    es.commit()
+                    ctx.check()
+            es.host.epoch, es.host.batch = epoch + 1, 0
+            es.commit()
+            ctx.check()
+            print(f"[rank {ctx.rank}/{ctx.world_size}] epoch {epoch} "
+                  f"loss {float(gloss):.4f}", flush=True)
+
+    run_elastic_worker(train, state)
+
+    import jax.numpy as jnp
+
+    correct = 0
+    for lo in range(0, len(test_ds), 512):
+        logits = model.apply(
+            {"params": state.state.params}, test_ds.images[lo:lo + 512])
+        correct += int(jnp.sum(
+            jnp.argmax(logits, -1) == test_ds.labels[lo:lo + 512]))
+    accuracy = correct / len(test_ds)
     print(f"accuracy: {100 * accuracy:.2f}%")  # `horovod_mnist_elastic.py:102`
     return accuracy
 

@@ -1,0 +1,168 @@
+"""Host collectives over the native store + dynamic (live-set) rendezvous.
+
+These are the control-plane primitives that let elastic worlds resize
+in-process (SURVEY.md §2.2: gloo / Horovod-controller capabilities).  Each
+"worker" here is a thread with its own store connection — the same wire
+protocol the multi-process test (`tests/test_elastic_ttl.py`) exercises
+across real process boundaries.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpudist.runtime.collectives import HostCollectives, PeerLost
+from tpudist.runtime.coord import CoordClient, CoordServer, Rendezvous
+
+
+@pytest.fixture(scope="module")
+def server():
+    try:
+        srv = CoordServer(0)
+    except Exception:
+        pytest.skip("native coordination library unavailable")
+    yield srv
+    srv.stop()
+
+
+def _run_world(server, world, fn):
+    """Run fn(rank, client) in `world` threads; re-raise any failure."""
+    errors = []
+    results = [None] * world
+
+    def work(rank):
+        try:
+            with CoordClient(port=server.port) as client:
+                results[rank] = fn(rank, client)
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    return results
+
+
+def test_allreduce_sum_and_mean(server):
+    world = 3
+
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, world, round_id=10)
+        tree = {"a": np.full((4,), float(rank + 1)),
+                "b": np.arange(6, dtype=np.int64).reshape(2, 3) * (rank + 1)}
+        s = coll.allreduce_sum(tree)
+        m = coll.allreduce_mean({"x": np.asarray([float(rank)])})
+        return s, m
+
+    for s, m in _run_world(server, world, fn):
+        np.testing.assert_array_equal(s["a"], np.full((4,), 6.0))
+        np.testing.assert_array_equal(
+            s["b"], np.arange(6).reshape(2, 3) * 6)
+        np.testing.assert_allclose(m["x"], [1.0])
+
+
+def test_broadcast_from_root(server):
+    world = 3
+
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, world, round_id=11)
+        tree = {"w": np.full((3,), float(rank) + 7.0)}
+        return coll.broadcast(tree, root=0)
+
+    for out in _run_world(server, world, fn):
+        np.testing.assert_array_equal(out["w"], np.full((3,), 7.0))
+
+
+def test_key_cleanup_stays_bounded(server):
+    """Posting op N deletes op N-2: after K allreduces at most 2 keys per
+    rank remain, and close_round removes the rest."""
+    world = 2
+
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, world, round_id=12)
+        for _ in range(5):
+            coll.allreduce_sum({"x": np.ones(2)})
+        return coll
+
+    colls = _run_world(server, world, fn)
+    with CoordClient(port=server.port) as probe:
+        leftover = probe.keys("coll/12/")
+        assert len(leftover) <= 2 * world, leftover
+        colls[0].client = probe  # reuse a live connection for cleanup
+        colls[0].close_round()
+        assert probe.keys("coll/12/") == []
+
+
+def test_missing_peer_raises_peer_lost(server):
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, 2, round_id=13, timeout_s=1.0)
+        if rank == 1:
+            return None  # never posts
+        with pytest.raises(PeerLost):
+            coll.allreduce_sum({"x": np.ones(1)})
+        return True
+
+    assert _run_world(server, 2, fn)[0] is True
+
+
+def test_on_wait_hook_can_abort(server):
+    """The elastic hook: a wait callback raising (as ElasticMonitor.check
+    does on membership change) aborts the collective immediately."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def raiser():
+        raise Boom()
+
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, 2, round_id=14, timeout_s=30.0,
+                               on_wait=raiser)
+        with pytest.raises(Boom):
+            coll.allreduce_sum({"x": np.ones(1)})
+        return True
+
+    assert _run_world(server, 1, fn)[0] is True
+
+
+class TestJoinLive:
+    def test_assigns_dense_sorted_ranks(self, server):
+        world = 4
+
+        def fn(rank, client):
+            wid = f"alpha{rank}"
+            client.heartbeat(wid, 5.0)  # liveness is membership
+            rdzv = Rendezvous(client, namespace="jl1")
+            got = rdzv.join_live(0, wid, timeout_s=20, min_world=world)
+            client.heartbeat(wid, 0)  # leave
+            return wid, got
+
+        results = _run_world(server, world, fn)
+        worlds = {got[1] for _, got in results}
+        assert worlds == {world}
+        ranks = sorted((got[0], wid) for wid, got in results)
+        assert [r for r, _ in ranks] == list(range(world))
+        # rank order == sorted worker-id order, identical member lists
+        members = {tuple(got[2]) for _, got in results}
+        assert len(members) == 1
+        assert [wid for _, wid in ranks] == sorted(w for w, _ in results)
+
+    def test_forms_smaller_world_after_grace(self, server):
+        """A registered-but-dead peer must not hang the round: after the
+        min_world grace the live members form the round without it."""
+
+        def fn(rank, client):
+            wid = f"beta{rank}"
+            client.heartbeat(wid, 5.0)
+            rdzv = Rendezvous(client, namespace="jl2")
+            got = rdzv.join_live(0, wid, timeout_s=30, min_world=3,
+                                 min_world_grace_s=1.5)
+            client.heartbeat(wid, 0)
+            return got
+
+        results = _run_world(server, 2, fn)
+        assert all(world == 2 for _, world, _ in results)

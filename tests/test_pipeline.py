@@ -93,6 +93,113 @@ class TestHeterogeneousPipeline:
             make_pipeline_train_step(list(fns), mse_loss, mesh, 2)
 
 
+class TestPackedPipeline:
+    """Stage-sharded heterogeneous pipeline: same trajectory as sequential,
+    per-device param bytes ≈ widest stage (not the sum) — VERDICT r1 #4."""
+
+    def test_matches_sequential_training(self):
+        from tpudist.parallel.pipeline import (
+            make_packed_pipeline_train_step,
+            pack_stage_params,
+            unpack_stage_params,
+        )
+
+        n_stages = 2
+        dims = [12, 24, 8]
+        fns, params = zip(*[
+            _dense_stage(dims[i], dims[i + 1], i) for i in range(n_stages)])
+        mesh = make_mesh({"data": 4, "stage": n_stages})
+        flat, meta = pack_stage_params(params)
+        assert flat.shape == (n_stages, 12 * 24 + 24)  # widest stage
+
+        x = np.random.default_rng(7).standard_normal(
+            (16, dims[0]), dtype=np.float32)
+        y = np.random.default_rng(8).standard_normal(
+            (16, dims[-1]), dtype=np.float32)
+
+        tx = optax.adam(0.05)
+        state = TrainState.create(lambda *a: None, flat, tx, rng=0)
+        step = make_packed_pipeline_train_step(
+            list(fns), mse_loss, mesh, 4, meta, state, donate=False)
+
+        def seq_loss(flat_params, x, y):
+            from tpudist.parallel.pipeline import unpack_stage
+
+            h = x
+            for s, fn in enumerate(fns):
+                h = fn(unpack_stage(flat_params[s], meta, s), h)
+            return mse_loss(h, y)
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(
+            flat, jnp.asarray(x), jnp.asarray(y))
+        ref_state = state.apply_gradients(ref_grads)
+
+        new_state, metrics = step(state, jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_state.params), np.asarray(ref_state.params),
+            rtol=1e-4, atol=1e-5)
+        # round-trip: packed buffer unpacks back to per-stage trees
+        trees = unpack_stage_params(new_state.params, meta)
+        assert trees[0]["w"].shape == (12, 24)
+        assert trees[1]["b"].shape == (8,)
+
+    def test_per_device_param_memory_is_stage_local(self):
+        """Each device's addressable shard of the packed params holds ONE
+        stage's slice: bytes == width (the widest stage), not the sum."""
+        from tpudist.parallel.pipeline import pack_stage_params
+
+        fns, params = zip(*[_dense_stage(64, 64, 0), _dense_stage(64, 8, 1)])
+        mesh = make_mesh({"data": 4, "stage": 2})
+        flat, meta = pack_stage_params(params)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as PS
+
+        sharded = jax.device_put(flat, NamedSharding(mesh, PS("stage")))
+        total = flat.size * flat.dtype.itemsize
+        for shard in sharded.addressable_shards:
+            assert shard.data.size * flat.dtype.itemsize == total // 2
+
+    def test_resnet50_two_stage_packed_trains(self):
+        """The reference workload under the memory-scaled pipeline
+        (`model_parallel_ResNet50.py:191-225`): loss decreases, grads flow
+        through both packed stages."""
+        from tpudist.parallel.pipeline import (
+            make_packed_pipeline_train_step,
+            pack_stage_params,
+        )
+
+        stages = resnet50_stages(2, num_classes=10, compute_dtype=jnp.float32)
+        mesh = make_mesh({"data": 4, "stage": 2})
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 32, 32, 3), dtype=np.float32)
+        one_hot = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+
+        key = jax.random.key(0)
+        params = tuple(
+            seg.init(jax.random.fold_in(key, i),
+                     jnp.zeros(s, jnp.float32))["params"]
+            for i, (seg, s) in enumerate(
+                zip(stages, [(2, 32, 32, 3), (2, 8, 8, 512)]))
+        )
+        fns = [
+            (lambda seg: lambda p, x: seg.apply({"params": p}, x))(seg)
+            for seg in stages
+        ]
+        flat, meta = pack_stage_params(params)
+        state = TrainState.create(lambda *a: None, flat, optax.adam(1e-3),
+                                  rng=0)
+        step = make_packed_pipeline_train_step(
+            fns, mse_loss, mesh, 2, meta, state)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, jnp.asarray(x), jnp.asarray(one_hot))
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
 class TestStackedPipeline:
     def test_matches_sequential_training(self):
         n_stages, d = 4, 16
@@ -271,6 +378,87 @@ class TestInterleavedPipeline:
                     assert (m, c - 1) in done_tick, (t, p, v, m)
                     assert done_tick[(m, c - 1)] < t, (t, p, v, m)
                 done_tick[(m, c)] = t
+
+
+class TestOneFOneB:
+    """1F1B: scheduled forward/backward interleaving with O(P) activation
+    memory (VERDICT r1 #6)."""
+
+    @pytest.mark.parametrize("P_,M", [(2, 8), (4, 4), (2, 2)])
+    def test_matches_sequential_training(self, P_, M):
+        from tpudist.parallel.pipeline import make_1f1b_pipeline_train_step
+
+        d = 16
+        rng = np.random.default_rng(0)
+        stacked = {
+            "w": jnp.asarray(
+                rng.standard_normal((P_, d, d), dtype=np.float32) * 0.2),
+            "b": jnp.zeros((P_, d), jnp.float32),
+        }
+
+        def block(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        mesh = make_mesh({"data": 8 // P_, "stage": P_})
+        batch = M * (8 // P_)  # local batch must divide into M micro-batches
+        x = rng.standard_normal((batch, d), dtype=np.float32)
+        y = rng.standard_normal((batch, d), dtype=np.float32)
+
+        state = TrainState.create(lambda *a: None, stacked, optax.sgd(0.3),
+                                  rng=0)
+        step = make_1f1b_pipeline_train_step(
+            block, mse_loss, mesh, num_microbatches=M, state_example=state,
+            donate=False)
+
+        def seq_loss(params, x, y):
+            h = x
+            for s in range(P_):
+                h = block(jax.tree.map(lambda p: p[s], params), h)
+            return mse_loss(h, y)
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(
+            stacked, jnp.asarray(x), jnp.asarray(y))
+        ref_state = state.apply_gradients(ref_grads)
+
+        new_state, metrics = step(state, jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_loss), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(new_state.params),
+                        jax.tree.leaves(ref_state.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+    def test_activation_memory_beats_gpipe(self):
+        """The point of 1F1B: at M=8, P=2 the act buffer holds at most P
+        in-flight micro-batches — GPipe's reverse-scan saves all M."""
+        from tpudist.parallel.pipeline import _one_f_one_b_schedule
+
+        P_, M = 2, 8
+        sched = _one_f_one_b_schedule(P_, M)
+        assert sched.Qa <= P_ < M, (sched.Qa, P_, M)
+        # canonical span: 2M + 2(P-1) unit ticks
+        assert sched.T == 2 * M + 2 * (P_ - 1), sched.T
+
+    @pytest.mark.parametrize("P_,M", [(1, 4), (2, 8), (4, 4), (3, 7)])
+    def test_schedule_exactly_one_fwd_and_bwd_per_microbatch(self, P_, M):
+        from tpudist.parallel.pipeline import _one_f_one_b_schedule
+
+        sched = _one_f_one_b_schedule(P_, M)
+        assert sched.Qa <= P_ + 1
+        for p in range(P_):
+            fwd = [int(sched.m[t, p]) for t in range(sched.T)
+                   if sched.kind[t, p] == 0]
+            bwd = [int(sched.m[t, p]) for t in range(sched.T)
+                   if sched.kind[t, p] == 1]
+            assert sorted(fwd) == list(range(M))
+            assert sorted(bwd) == list(range(M))
+            # backward of m never precedes its forward
+            seen_f = set()
+            for t in range(sched.T):
+                if sched.kind[t, p] == 0:
+                    seen_f.add(int(sched.m[t, p]))
+                elif sched.kind[t, p] == 1:
+                    assert int(sched.m[t, p]) in seen_f
 
 
 class TestThreeDParallel:

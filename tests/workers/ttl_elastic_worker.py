@@ -1,0 +1,122 @@
+"""Worker for the TTL-heartbeat elastic test: a 3-process DP job where one
+worker SIGKILLs itself mid-training; the survivors must detect the loss via
+the coordination service's TTL leases (NOT launcher exit-code polling),
+roll back to the last commit, re-rendezvous at world 2, fire the lr-rescale
+reset callback, and finish — the capability torchrun's c10d rendezvous
+(`mnist_ddp_elastic.py:5-6`) and Horovod's elastic driver
+(`horovod_mnist_elastic.py:55,108`) deliver, re-built on
+``tpudist.runtime.coord`` + ``tpudist.elastic.worker``.
+
+Gradient sync rides ``HostCollectives`` (dynamic membership) rather than a
+fixed compiled mesh, which is exactly what lets the world shrink without a
+process restart.  Every worker appends JSON events to
+``$WORKER_OUT_DIR/events_<spawn_id>.jsonl`` for the test to assert on.
+"""
+
+import json
+import os
+import signal
+import sys
+
+from tpudist.runtime.simulate import force_cpu_devices
+
+force_cpu_devices(1, check=False)
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from tpudist.elastic.state import ElasticState, HostDataState  # noqa: E402
+from tpudist.elastic.worker import run_elastic_worker  # noqa: E402
+from tpudist.models import MLP  # noqa: E402
+from tpudist.ops.losses import cross_entropy  # noqa: E402
+from tpudist.train.state import TrainState  # noqa: E402
+
+TOTAL_STEPS = 30
+COMMIT_EVERY = 5
+GLOBAL_BATCH = 12  # divisible by both world sizes (3 and 2)
+BASE_LR = 0.1
+
+SPAWN_ID = os.environ.get("TPUDIST_PROCESS_ID", "x")
+KILL_SPAWN_ID = os.environ.get("WORKER_KILL_SPAWN_ID")
+KILL_AT_STEP = int(os.environ.get("WORKER_KILL_AT_STEP", "13"))
+OUT = os.environ["WORKER_OUT_DIR"]
+
+
+def emit(event: str, **fields) -> None:
+    with open(os.path.join(OUT, f"events_{SPAWN_ID}.jsonl"), "a") as fh:
+        fh.write(json.dumps({"event": event, **fields}) + "\n")
+
+
+def global_batch(step: int):
+    rng = np.random.default_rng(5000 + step)
+    x = rng.standard_normal((GLOBAL_BATCH, 28 * 28)).astype(np.float32)
+    y = rng.integers(0, 10, GLOBAL_BATCH)
+    return x, y
+
+
+def main() -> int:
+    model = MLP(hidden_layers=1, features=32)
+    params = model.init(jax.random.key(0),
+                        np.zeros((1, 28 * 28), np.float32))["params"]
+    # inject_hyperparams makes the lr part of opt_state, so the reset
+    # callback can rescale it in place (the `on_state_reset` contract,
+    # `horovod_mnist_elastic.py:80-82`)
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=BASE_LR)
+    train_state = TrainState.create(model.apply, params, tx, rng=0)
+    state = ElasticState(train_state, host=HostDataState())
+
+    def on_reset(s: ElasticState, old: int, new: int) -> None:
+        lr = float(s.state.opt_state.hyperparams["learning_rate"]) * new / old
+        s.state.opt_state.hyperparams["learning_rate"] = jnp.asarray(
+            lr, jnp.float32)
+        emit("reset", old_world=old, new_world=new, lr=lr)
+
+    state.register_reset_callbacks([on_reset])
+
+    @jax.jit
+    def local_grads(params, x, y):
+        def loss_fn(p):
+            return cross_entropy(model.apply({"params": p}, x), y)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    def train_fn(state: ElasticState, ctx) -> None:
+        emit("round", round=ctx.round, rank=ctx.rank, world=ctx.world_size,
+             resume_batch=state.host.batch)
+        shard = GLOBAL_BATCH // ctx.world_size
+        last_loss = float("nan")
+        for step in range(state.host.batch, TOTAL_STEPS):
+            gx, gy = global_batch(step)
+            lo = ctx.rank * shard
+            loss, grads = local_grads(
+                state.state.params, gx[lo:lo + shard], gy[lo:lo + shard])
+            # one fused allreduce syncs grads AND the scalar loss (the
+            # XLA-fusion analog on the control plane: one payload)
+            grads, gloss = ctx.collectives.allreduce_mean(
+                (grads, np.asarray(float(loss))))
+            state.state = state.state.apply_gradients(grads)
+            state.host.batch = step + 1
+            last_loss = float(gloss)
+            if KILL_SPAWN_ID == SPAWN_ID and step + 1 == KILL_AT_STEP:
+                emit("suicide", step=step + 1)
+                os.kill(os.getpid(), signal.SIGKILL)  # kill -9, no cleanup
+            if (step + 1) % COMMIT_EVERY == 0:
+                state.commit()
+                emit("commit", step=step + 1)
+                ctx.check()  # the per-commit membership poll
+        state.commit()
+        checksum = float(
+            sum(jnp.sum(jnp.abs(l)) for l in jax.tree.leaves(
+                state.state.params)))
+        emit("done", steps=TOTAL_STEPS, loss=last_loss, checksum=checksum,
+             lr=float(state.state.opt_state.hyperparams["learning_rate"]),
+             world=ctx.world_size)
+
+    run_elastic_worker(train_fn, state, worker_id=f"w{SPAWN_ID}",
+                       ttl_s=1.5, heartbeat_interval_s=0.3)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ from tpudist.elastic.checkpoint import (
 )
 from tpudist.elastic.state import ElasticState, HostDataState
 from tpudist.elastic.loop import WorldChanged, WorkerFailure, elastic_run
+from tpudist.elastic.worker import ElasticContext, run_elastic_worker
 
 
 def __getattr__(name):
@@ -33,6 +34,7 @@ __all__ = [
     "Checkpointer",
     "HAVE_ORBAX",
     "OrbaxCheckpointer",
+    "ElasticContext",
     "ElasticState",
     "HostDataState",
     "WorkerFailure",
@@ -40,5 +42,6 @@ __all__ = [
     "elastic_run",
     "latest_step",
     "restore_pytree",
+    "run_elastic_worker",
     "save_pytree",
 ]

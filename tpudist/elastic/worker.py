@@ -1,0 +1,167 @@
+"""The TTL-driven elastic worker loop — rendezvous-driven recovery.
+
+This is the piece that makes the coordination service (``native/coord.cpp``
++ :mod:`tpudist.runtime.coord`) *drive* elastic training the way the
+reference's control planes do: torchrun's c10d rendezvous re-forms the world
+on membership change (`pytorch_elastic/mnist_ddp_elastic.py:5-6`) and
+Horovod's elastic driver rolls back and re-assembles on host add/drop
+(`horovod/horovod_mnist_elastic.py:55,108`).  Here, in one loop per worker:
+
+1. heartbeat (TTL lease) starts before anything else — liveness IS
+   membership;
+2. :meth:`~tpudist.runtime.coord.Rendezvous.join_live` forms the round from
+   whatever workers are alive (world size *discovered*, not prescribed);
+3. committed state is broadcast from the round's rank 0 so every
+   participant resumes bitwise identically;
+4. training runs with :class:`~tpudist.runtime.collectives.HostCollectives`
+   whose waits poll :meth:`ElasticMonitor.check` — a ``kill -9``'d peer
+   surfaces as :class:`WorldChanged` at the next commit point OR mid-
+   allreduce, whichever comes first (TTL detection, no exit-code polling);
+5. on :class:`WorldChanged`: rollback to the last commit, fire reset
+   callbacks (lr/√N rescale, `horovod_mnist_elastic.py:80-82`), bump the
+   round, re-rendezvous at the new size, resume — within one commit
+   interval of the pre-failure state.
+
+Round agreement: the store key ``{ns}/round`` publishes the active round.
+A fresh worker joining mid-run reads it and registers for ``round + 1``;
+its heartbeat makes the incumbents' next ``check()`` raise
+:class:`WorldChanged`, and everyone converges on ``round + 1`` — the grow
+path and the shrink path are the same code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+import jax
+
+from tpudist.elastic.loop import WorldChanged
+from tpudist.elastic.state import ElasticState
+from tpudist.runtime.collectives import HostCollectives, PeerLost
+from tpudist.runtime.coord import CoordClient, ElasticMonitor, Rendezvous
+from tpudist.utils.logging import get_logger
+from tpudist.utils.trees import host_to_leaf, tree_to_numpy
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ElasticContext:
+    """Per-round handles passed to the train function."""
+
+    rank: int
+    world_size: int
+    round: int
+    collectives: HostCollectives
+    monitor: ElasticMonitor
+
+    def check(self) -> None:
+        """Membership probe — call at commit points (the Horovod per-commit
+        poll); raises :class:`WorldChanged` on TTL-detected add/drop."""
+        self.monitor.check()
+
+
+# train_fn(state, ctx) trains from state.host's position, calling
+# state.commit() + ctx.check() at its commit points and
+# ctx.collectives.allreduce_mean(...) for gradient sync.
+TrainFn = Callable[[ElasticState, ElasticContext], None]
+
+
+def _coord_client(coord_addr: str | None) -> CoordClient:
+    addr = coord_addr or os.environ.get("TPUDIST_COORD_ADDR")
+    if not addr:
+        raise ValueError(
+            "no coordination service address: pass coord_addr or launch "
+            "under tpudist.runtime.launch (which exports TPUDIST_COORD_ADDR)")
+    host, port = addr.rsplit(":", 1)
+    return CoordClient(host, int(port))
+
+
+def run_elastic_worker(
+    train_fn: TrainFn,
+    state: ElasticState,
+    coord_addr: str | None = None,
+    worker_id: str | None = None,
+    ttl_s: float = 2.0,
+    heartbeat_interval_s: float = 0.5,
+    max_rounds: int = 10,
+    rendezvous_timeout_s: float = 60.0,
+) -> ElasticState:
+    """Run ``train_fn`` under TTL-heartbeat elastic supervision.
+
+    Returns the final state after ``train_fn`` completes at some world
+    size.  Raises after ``max_rounds`` re-rendezvous attempts (torchrun's
+    ``--max-restarts``)."""
+    client = _coord_client(coord_addr)
+    wid = worker_id or f"w{os.getpid()}"
+    monitor = ElasticMonitor(client, wid, ttl_s=ttl_s,
+                             interval_s=heartbeat_interval_s)
+    monitor.start(None)  # beat first: liveness is membership
+    rdzv = Rendezvous(client)
+    raw = client.get("elastic/round")
+    round_id = 0 if raw is None else int(raw) + 1
+    # soft assembly target for round 0: the launcher-declared gang size
+    min_world = int(os.environ.get("TPUDIST_NUM_PROCESSES", "1"))
+    rounds = 0
+    try:
+        while True:
+            try:
+                rank, world, members = rdzv.join_live(
+                    round_id, wid, timeout_s=rendezvous_timeout_s,
+                    min_world=min_world)
+            except TimeoutError:
+                rounds += 1
+                if rounds > max_rounds:
+                    raise
+                raw = client.get("elastic/round")
+                published = -1 if raw is None else int(raw)
+                round_id = max(round_id + 1, published + 1)
+                continue
+            monitor.resize(world)
+            if rank == 0:
+                client.set("elastic/round", str(round_id))
+            coll = HostCollectives(client, rank, world, round_id,
+                                   on_wait=monitor.check)
+            # bitwise state agreement across the new world (the
+            # hvd.broadcast_parameters / TorchState re-broadcast role)
+            synced = coll.broadcast(tree_to_numpy(state.state), root=0)
+            state.state = jax.tree.map(host_to_leaf, state.state, synced)
+            state.world_size = world
+            state.commit()  # the agreed state is the rollback point
+            log.info("round %d: rank %d of %d (%s)", round_id, rank, world,
+                     ",".join(members))
+            try:
+                train_fn(state, ElasticContext(rank, world, round_id, coll,
+                                               monitor))
+                coll.barrier()  # all ranks finish before anyone leaves
+                return state
+            except WorldChanged as e:
+                rounds += 1
+                if rounds > max_rounds:
+                    raise
+                log.warning(
+                    "round %d: world %d -> %d; rolling back to commit #%d "
+                    "(epoch %d, batch %d)", round_id, world,
+                    e.new_world_size, state.commits,
+                    state._committed_host.epoch, state._committed_host.batch)
+                state.on_world_change(e.new_world_size)
+                coll.close_round()
+                round_id += 1
+                min_world = e.new_world_size
+            except PeerLost as e:
+                # a wait deadline fired before the TTL did — treat as a
+                # membership change at the currently-live size
+                rounds += 1
+                if rounds > max_rounds:
+                    raise
+                live = len(client.live())
+                log.warning("round %d: %s; re-rendezvous at %d", round_id,
+                            e, live)
+                state.on_world_change(live)
+                coll.close_round()
+                round_id += 1
+                min_world = live
+    finally:
+        monitor.stop(graceful=True)

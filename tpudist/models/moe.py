@@ -81,11 +81,23 @@ class MoEMLP(nn.Module):
     Input/output ``[tokens, d_model]``; expert weights are single stacked
     arrays ``[E, d, f]`` / ``[E, f, d]`` so the expert dim is shardable.
     Returns ``(out, aux_loss)``.
+
+    ``ep_axis`` selects the EXPLICIT expert-parallel path: the module must
+    then run inside a ``shard_map`` over that mesh axis with tokens sharded
+    across it and the expert stacks sharded on their leading dim — each
+    device routes its local tokens to ALL experts, a ``lax.all_to_all``
+    delivers every expert's batch to the device that owns it, the local
+    expert MLPs run, and a second all-to-all returns the outputs — the
+    canonical EP dispatch, *guaranteed* in the lowering rather than left to
+    GSPMD (which prefers replicate-tokens + all-reduce for the dense
+    formulation; see ``tests/test_moe.py``).  Initialize the global model
+    with ``ep_axis=None``, then shard.
     """
 
     d_model: int
     d_ff: int
     moe: MoEConfig
+    ep_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -100,19 +112,38 @@ class MoEMLP(nn.Module):
 
         # Params in float32, compute in the input dtype (the same f32-params/
         # bf16-compute contract nn.Dense(dtype=...) gives the dense layers).
+        # Under ep_axis the declared (local) expert count is E / axis size —
+        # matching the shard this device holds of the stacked weights.
+        n_shards = 1 if self.ep_axis is None else jax.lax.axis_size(
+            self.ep_axis)
+        if e % n_shards:
+            raise ValueError(
+                f"num_experts {e} not divisible by {self.ep_axis!r} axis "
+                f"size {n_shards}")
         w_up = self.param(
             "w_up", nn.initializers.lecun_normal(),
-            (e, self.d_model, self.d_ff)).astype(x.dtype)
+            (e // n_shards, self.d_model, self.d_ff)).astype(x.dtype)
         w_down = self.param(
             "w_down", nn.initializers.lecun_normal(),
-            (e, self.d_ff, self.d_model)).astype(x.dtype)
+            (e // n_shards, self.d_ff, self.d_model)).astype(x.dtype)
 
-        # dispatch: [T,E,C] × [T,d] -> per-expert batches [E,C,d] (the EP
-        # all-to-all when T is data-sharded and E expert-sharded) ...
+        # dispatch: [T,E,C] × [T,d] -> per-expert batches [E,C,d] ...
         expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        if self.ep_axis is not None:
+            # THE all-to-all of expert parallelism: expert-major blocks
+            # scatter to their owners, every shard's token batches gather
+            # along capacity -> [E/n, n·C, d]
+            expert_in = jax.lax.all_to_all(
+                expert_in, self.ep_axis, split_axis=0, concat_axis=1,
+                tiled=True)
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_up))
         expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
-        # ... and the return all-to-all, weighted by the combine gates.
+        if self.ep_axis is not None:
+            # return trip: [E/n, n·C, d] -> [E, C, d] back at the sources
+            expert_out = jax.lax.all_to_all(
+                expert_out, self.ep_axis, split_axis=1, concat_axis=0,
+                tiled=True)
+        # ... and the combine, weighted by the (renormalised) gates.
         out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
         return out, aux.astype(jnp.float32)
 
@@ -121,6 +152,7 @@ class MoEDecoderBlock(nn.Module):
     cfg: TransformerConfig
     moe: MoEConfig
     attention_fn: AttentionFn = sdpa
+    ep_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, *, causal: bool = True):
@@ -131,7 +163,8 @@ class MoEDecoderBlock(nn.Module):
         b, s, d = h.shape
         out, aux = MoEMLP(d_model=self.cfg.embed_dim,
                           d_ff=self.cfg.mlp_ratio * self.cfg.embed_dim,
-                          moe=self.moe, name="moe")(h.reshape(b * s, d))
+                          moe=self.moe, ep_axis=self.ep_axis,
+                          name="moe")(h.reshape(b * s, d))
         return x + out.reshape(b, s, d), aux
 
 
@@ -145,6 +178,7 @@ class MoETransformerLM(nn.Module):
     cfg: TransformerConfig
     moe: MoEConfig
     attention_fn: AttentionFn = sdpa
+    ep_axis: str | None = None
 
     @nn.compact
     def __call__(self, tokens, *, causal: bool = True, positions=None):
@@ -158,6 +192,7 @@ class MoETransformerLM(nn.Module):
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
             x, aux = MoEDecoderBlock(cfg, self.moe, self.attention_fn,
+                                     ep_axis=self.ep_axis,
                                      name=f"block{i}")(x, causal=causal)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)

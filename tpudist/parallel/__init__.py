@@ -16,6 +16,7 @@ from tpudist.parallel.data_parallel import (
     make_dp_train_step,
 )
 from tpudist.parallel.expert_parallel import (
+    make_ep_shard_train_step,
     make_ep_state,
     make_ep_train_step,
     moe_ep_rules,
@@ -24,15 +25,21 @@ from tpudist.parallel.fsdp import (
     fsdp_specs,
     make_fsdp_state,
     make_fsdp_train_step,
+    make_zero3_train_step,
 )
 from tpudist.parallel.pipeline import (
+    StagePacking,
     interleave_params,
+    make_1f1b_pipeline_train_step,
     make_interleaved_pipeline_train_step,
+    make_packed_pipeline_train_step,
     make_pipeline_forward,
     make_pipeline_train_step,
     make_stacked_pipeline_train_step,
+    pack_stage_params,
     stacked_state_specs,
     state_specs_like,
+    unpack_stage_params,
 )
 from tpudist.parallel.ps_hybrid import (
     make_ps_hybrid_forward,
@@ -59,10 +66,12 @@ from tpudist.parallel.tensor_parallel import (
 __all__ = [
     "broadcast_params",
     "fsdp_specs",
+    "make_ep_shard_train_step",
     "make_ep_state",
     "make_ep_train_step",
     "make_fsdp_state",
     "make_fsdp_train_step",
+    "make_zero3_train_step",
     "moe_ep_rules",
     "make_sp_train_step",
     "make_spmd_train_step",
@@ -78,10 +87,15 @@ __all__ = [
     "make_dp_eval_step",
     "make_dp_train_loop",
     "make_dp_train_step",
+    "StagePacking",
     "interleave_params",
+    "make_1f1b_pipeline_train_step",
     "make_interleaved_pipeline_train_step",
+    "make_packed_pipeline_train_step",
     "make_pipeline_forward",
     "make_pipeline_train_step",
+    "pack_stage_params",
+    "unpack_stage_params",
     "make_ps_hybrid_forward",
     "make_ps_hybrid_train_step",
     "make_stacked_pipeline_train_step",

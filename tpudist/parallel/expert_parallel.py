@@ -18,12 +18,16 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpudist.parallel.common import jit_sharded_step
 from tpudist.parallel.tensor_parallel import (
     Rules,
     make_spmd_train_step,
     make_tp_state,
+    spec_tree_from_rules,
 )
 from tpudist.train.state import TrainState
 
@@ -63,6 +67,75 @@ def make_ep_train_step(
     param_specs: Any,
     donate: bool = True,
 ):
-    """DP×EP train step — one GSPMD program; the expert-dim shardings in
-    ``param_specs`` make the dispatch/return einsums all-to-alls."""
+    """DP×EP train step — one GSPMD program.  GSPMD keeps expert weights
+    device-local but (measured, ``tests/test_moe.py``) lowers the dense
+    dispatch as replicate-tokens + all-reduce rather than all-to-all; for
+    the guaranteed all-to-all token dispatch use
+    :func:`make_ep_shard_train_step`."""
     return make_spmd_train_step(loss_fn, mesh, param_specs, donate)
+
+
+def make_ep_shard_train_step(
+    loss_fn: Callable[[Any, tuple], jnp.ndarray],
+    mesh: Mesh,
+    state_example,
+    data_axis: str = "data",
+    expert_axis: str = "expert",
+    donate: bool = True,
+):
+    """Explicit-collective DP×EP step under ``shard_map`` — the canonical
+    all-to-all dispatch, asserted rather than hoped for.
+
+    Contract:
+
+    * the model is built with ``ep_axis=expert_axis``
+      (:class:`tpudist.models.moe.MoETransformerLM`), so each MoE layer
+      routes local tokens to all experts and ships the batches through
+      ``lax.all_to_all`` to the expert owners (and back);
+    * the BATCH dimension of every batch array is sharded over BOTH axes
+      (``P((data_axis, expert_axis))``) — each device holds
+      ``B / (nd·ne)`` whole sequences;
+    * ``loss_fn(params, batch) -> scalar`` returns this shard's loss
+      CONTRIBUTION such that the global loss is the ``psum`` over all
+      shards — i.e. per-token sums divided by the GLOBAL token count, plus
+      any aux terms divided by the shard count.
+
+    Gradients: expert-sharded leaves receive complete expert-axis
+    gradients through the transposed all-to-alls (cotangents route back to
+    the expert owners), so they only psum over ``data_axis``; replicated
+    leaves (attention, router, embeddings) see local-token partials and
+    psum over both axes.
+    """
+    param_specs = spec_tree_from_rules(
+        state_example.params, moe_ep_rules(expert_axis))
+    from tpudist.parallel.pipeline import _spec_axes, state_specs_like
+
+    state_specs = state_specs_like(state_example, param_specs)
+    spec_leaves = jax.tree.leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    sync_per_leaf = [
+        (data_axis,) if expert_axis in _spec_axes(s)
+        else (data_axis, expert_axis)
+        for s in spec_leaves]
+
+    def _step(state, batch):
+        def local_loss(params):
+            return loss_fn(params, batch)
+
+        loss, grads = jax.value_and_grad(local_loss)(state.params)
+        leaves, treedef = jax.tree.flatten(grads)
+        leaves = [lax.psum(g, axes) for g, axes in zip(leaves, sync_per_leaf)]
+        grads = jax.tree.unflatten(treedef, leaves)
+        metrics = {"loss": lax.psum(loss, (data_axis, expert_axis))}
+        return state.apply_gradients(grads), metrics
+
+    stepped = jit_sharded_step(
+        _step, mesh, (state_specs, P((data_axis, expert_axis))),
+        (state_specs, P()), donate,
+    )
+
+    def train_step(state, *batch):
+        return stepped(state, batch)
+
+    train_step.jitted = stepped  # for HLO schedule assertions
+    return train_step

@@ -19,8 +19,10 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import jax
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpudist.parallel.common import jit_sharded_step
 from tpudist.parallel.tensor_parallel import (
     Rules,
     make_spmd_train_step,
@@ -96,9 +98,96 @@ def make_fsdp_train_step(
     param_specs: Any,
     donate: bool = True,
 ):
-    """ZeRO-3 train step: identical GSPMD program to
+    """ZeRO-3 train step, GSPMD-scheduled: identical program to
     :func:`make_spmd_train_step`; with ``param_specs`` from
-    :func:`fsdp_specs` the compiler's partitioning IS the FSDP schedule
-    (all-gather params per use, reduce-scatter grads, local optimizer
-    update on each shard)."""
+    :func:`fsdp_specs` the compiler partitions it with params/moments
+    stored sharded and all-gathers per use.  Measured caveat
+    (``tests/test_fsdp.py``): GSPMD reduces gradients with a full
+    ALL-REDUCE rather than a reduce-scatter — transiently materializing
+    unsharded gradients (ZeRO-2-style grad memory).  For the guaranteed
+    reduce-scatter schedule use :func:`make_zero3_train_step`."""
     return make_spmd_train_step(loss_fn, mesh, param_specs, donate)
+
+
+def _fsdp_sharded_dim(spec: P, axis: str) -> int | None:
+    for i, part in enumerate(spec):
+        if part == axis or (isinstance(part, tuple) and axis in part):
+            return i
+    return None
+
+
+def make_zero3_train_step(
+    loss_fn,
+    mesh: Mesh,
+    param_specs: Any,
+    state_example,
+    axis: str = "data",
+    donate: bool = True,
+):
+    """ZeRO-3 with the schedule written out, not inferred: per step each
+    device ``all_gather``s the full parameters from the shards, computes
+    local gradients on its batch shard, ``psum_scatter``s them straight
+    back to shard owners (THE reduce-scatter — full gradients never
+    persist), and runs the optimizer on its 1/N shard only.  The HLO
+    provably contains all-gather + reduce-scatter on every backend
+    (asserted in ``tests/test_fsdp.py``), unlike the GSPMD variant.
+
+    ``param_specs`` must shard each leaf over ``axis`` on at most one
+    dimension (what :func:`fsdp_specs` produces without ``tp_rules``);
+    replicated leaves fall back to a grad ``pmean``.  ``loss_fn`` has the
+    :data:`~tpudist.parallel.tensor_parallel.LossFn` contract (mean loss
+    over the LOCAL batch shard, aux dict).
+    """
+    from tpudist.parallel.pipeline import state_specs_like
+
+    state_specs = state_specs_like(state_example, param_specs)
+    n = mesh.shape[axis]
+    spec_leaves = jax.tree.leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    dims = [_fsdp_sharded_dim(s, axis) for s in spec_leaves]
+
+    def _gathered(params):
+        leaves, treedef = jax.tree.flatten(params)
+        full = [
+            leaf if d is None else lax.all_gather(
+                leaf, axis, axis=d, tiled=True)
+            for leaf, d in zip(leaves, dims)]
+        return jax.tree.unflatten(treedef, full)
+
+    def _step(state, batch):
+        shard_rng = jax.random.fold_in(state.rng, lax.axis_index(axis))
+
+        def shard_loss(local_params):
+            # gather INSIDE the differentiated function: the transpose of
+            # all_gather is reduce-scatter, so backward lands shard-local
+            # gradient slices directly — full grads never materialize
+            # outside the transient transpose
+            full_params = _gathered(local_params)
+            loss, aux = loss_fn(full_params, batch, shard_rng)
+            return loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(shard_loss, has_aux=True)(
+            state.params)
+        # all_gather's transpose is psum_scatter: `grads` is already the
+        # local shard of the cross-device gradient SUM; divide for the mean
+        # (replicated leaves transposed through identity carry only the
+        # local contribution and need the explicit mean)
+        leaves, treedef = jax.tree.flatten(grads)
+        leaves = [
+            lax.pmean(g, axis) if d is None else g / n
+            for g, d in zip(leaves, dims)]
+        grads = jax.tree.unflatten(treedef, leaves)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": lax.pmean(loss, axis),
+                   **{k: lax.pmean(v, axis) for k, v in aux.items()}}
+        return new_state, metrics
+
+    stepped = jit_sharded_step(
+        _step, mesh, (state_specs, P(axis)), (state_specs, P()), donate,
+    )
+
+    def train_step(state, *batch):
+        return stepped(state, batch)
+
+    train_step.jitted = stepped  # for HLO schedule assertions
+    return train_step

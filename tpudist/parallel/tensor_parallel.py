@@ -134,6 +134,11 @@ def make_spmd_train_step(
         with mesh:
             return stepped(state, batch)
 
+    # The underlying jit, exposed so callers/tests can inspect the compiled
+    # schedule (.lower(...).compile().as_text()) — the FSDP/EP contracts
+    # (reduce-scatter / all-gather / all-to-all) are ASSERTED against this
+    # HLO rather than trusted to GSPMD (tests/test_fsdp.py, test_moe.py).
+    train_step.jitted = stepped
     return train_step
 
 

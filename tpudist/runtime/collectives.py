@@ -1,0 +1,172 @@
+"""Host-side collectives over the native coordination store.
+
+The reference's elastic paths lean on CPU collective backends — Gloo for DDP
+(`mnist_ddp_elastic.py:26`) and Horovod's controller for the elastic driver
+(`horovod_mnist_elastic.py:35,55`) — whose defining property is that the
+*membership* of a collective is renegotiable at run time.  XLA's ICI
+collectives (the tpudist data plane) are compiled for a fixed mesh; this
+module provides the complementary control-plane collectives with DYNAMIC
+membership, built on the C++ TCP store (``native/coord.cpp``): allreduce /
+broadcast / barrier whose participant set is whatever the current rendezvous
+round agreed on.
+
+That property is what makes in-process elastic resize possible
+(:mod:`tpudist.elastic.worker`): when a worker dies mid-allreduce, the
+surviving participants' waits time out against the TTL-expired live set and
+surface :class:`~tpudist.elastic.loop.WorldChanged` — they re-rendezvous
+smaller and the next round's collectives simply have fewer participants.
+No compiled program needs to change, because these collectives live outside
+XLA.
+
+Wire format: each participant posts its payload under
+``{ns}/{round}/{op}/{rank}`` and reads every peer's key.  Values are npz
+bytes (dtype/shape-preserving) of the flattened pytree leaves.  A
+participant deletes its own ``op - 2`` key when posting ``op`` — by then
+every peer has consumed it (they must have completed ``op - 1`` to be
+posting/reading ``op``), so the store stays O(2 · world) keys per round.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from tpudist.runtime.coord import CoordClient
+
+
+class PeerLost(RuntimeError):
+    """A collective wait exceeded its deadline; membership likely changed."""
+
+
+def _dumps(leaves: list[np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, *leaves)
+    return buf.getvalue()
+
+
+def _loads(raw: bytes) -> list[np.ndarray]:
+    with np.load(io.BytesIO(raw)) as z:
+        # index by position, not z.files order (lexicographic would put
+        # arr_10 before arr_2)
+        return [z[f"arr_{i}"] for i in range(len(z.files))]
+
+
+class HostCollectives:
+    """Fixed-membership collectives for one rendezvous round.
+
+    Args:
+      client: store connection (one in-flight request per connection; do
+        not share with a concurrently-beating monitor — it clones its own).
+      rank / world: this participant's dense rank and the round's size
+        (from :meth:`tpudist.runtime.coord.Rendezvous.join_live`).
+      round_id: rendezvous round; namespaces all keys so a new round never
+        sees a dead round's leftovers.
+      on_wait: optional callback invoked between wait polls — the elastic
+        hook: pass ``ElasticMonitor.check`` so a TTL-expired peer turns a
+        hung allreduce into ``WorldChanged`` instead of a timeout.
+      timeout_s: per-collective deadline before :class:`PeerLost`.
+    """
+
+    def __init__(
+        self,
+        client: CoordClient,
+        rank: int,
+        world: int,
+        round_id: int = 0,
+        namespace: str = "coll",
+        on_wait: Callable[[], None] | None = None,
+        timeout_s: float = 60.0,
+    ) -> None:
+        self.client = client
+        self.rank = rank
+        self.world = world
+        self.round_id = round_id
+        self.ns = namespace
+        self.on_wait = on_wait
+        self.timeout_s = timeout_s
+        self._op = 0
+
+    def _key(self, op: int, rank: int) -> str:
+        return f"{self.ns}/{self.round_id}/{op}/{rank}"
+
+    def _post(self, payload: bytes) -> int:
+        op = self._op
+        self._op += 1
+        self.client.set(self._key(op, self.rank), payload)
+        if op >= 2:  # every peer consumed op-2 before posting op-1
+            self.client.delete(self._key(op - 2, self.rank))
+        return op
+
+    def _fetch(self, op: int, rank: int) -> bytes:
+        deadline = time.monotonic() + self.timeout_s
+        key = self._key(op, rank)
+        while True:
+            raw = self.client.get(key)
+            if raw is not None:
+                return raw
+            if self.on_wait is not None:
+                self.on_wait()
+            if time.monotonic() > deadline:
+                raise PeerLost(
+                    f"rank {rank} never posted {key} within "
+                    f"{self.timeout_s}s")
+            self.client.wait(key, timeout_s=0.2)
+
+    def allreduce_sum(self, tree: Any) -> Any:
+        """Sum a pytree of arrays across all ranks (all-gather + local
+        reduce; payloads ride the store, O(world) per rank)."""
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        np_leaves = [np.asarray(x) for x in leaves]
+        op = self._post(_dumps(np_leaves))
+        acc = [l.copy() for l in np_leaves]
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            for a, b in zip(acc, _loads(self._fetch(op, r))):
+                a += b
+        return jax.tree.unflatten(treedef, acc)
+
+    def allreduce_mean(self, tree: Any) -> Any:
+        import jax
+
+        summed = self.allreduce_sum(tree)
+        return jax.tree.map(lambda x: x / self.world, summed)
+
+    def broadcast(self, tree: Any, root: int = 0) -> Any:
+        """Every rank returns root's pytree (``hvd.broadcast_parameters``
+        role, `mnist_horovod.py:56` — state agreement after a resize)."""
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        if self.rank == root:
+            op = self._post(_dumps([np.asarray(x) for x in leaves]))
+            return tree
+        op = self._op
+        self._op += 1
+        out = _loads(self._fetch(op, root))
+        return jax.tree.unflatten(treedef, out)
+
+    def barrier(self, timeout_s: float | None = None) -> None:
+        """All-ranks barrier for this round (native store barrier)."""
+        op = self._op
+        self._op += 1
+        ok = self.client.barrier(
+            f"{self.ns}/{self.round_id}/bar/{op}", self.world,
+            timeout_s or self.timeout_s)
+        if not ok:
+            raise PeerLost(f"barrier {op} timed out at world {self.world}")
+
+    def close_round(self) -> None:
+        """Delete every key this round left in the store (called before
+        re-rendezvous so dead rounds cannot accumulate; idempotent —
+        every survivor may call it)."""
+        for key in self.client.keys(f"{self.ns}/{self.round_id}/"):
+            try:
+                self.client.delete(key)
+            except ConnectionError:
+                return

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
+import zlib
 
 from tpudist import _native
 
@@ -202,6 +204,83 @@ class Rendezvous:
                 f"rendezvous round {round}: {world_size} workers did not arrive"
             )
         return rank
+
+    def join_live(
+        self,
+        round: int,
+        worker_id: str,
+        timeout_s: float = 60.0,
+        settle_s: float = 0.3,
+        min_world: int = 1,
+        min_world_grace_s: float = 10.0,
+    ) -> tuple[int, int, list[str]]:
+        """Dynamic-membership rendezvous: the round's world is whatever set
+        of LIVE workers registers before membership stabilizes — the c10d
+        contract torchrun relies on to re-form a world after failures
+        (`mnist_ddp_elastic.py:5-6`), with the world size *discovered*, not
+        prescribed.
+
+        Protocol: register under ``{ns}/{round}/member/{id}``; poll until
+        the registered-and-live set has been stable for ``settle_s``; then
+        confirm agreement with a barrier keyed by the membership fingerprint
+        — every participant must have computed the SAME set, else the
+        barrier times out and the poll resumes (a straggler registered
+        during someone's settle window).  Requires the caller's heartbeat
+        (``ElasticMonitor.start``) to already be running so it appears in
+        ``live()``.
+
+        ``min_world`` is a SOFT assembly target: settling is deferred until
+        that many members registered or ``min_world_grace_s`` elapsed —
+        without it, the first arrival of a gang whose peers are still
+        importing would form a world of one and force an immediate resize
+        cascade.  After the grace the round forms with whoever is there
+        (liveness over the target: a pre-registration death must not hang
+        the gang).
+
+        Returns ``(rank, world_size, members)``; ranks are the sorted
+        member order — dense, deterministic, identical everywhere.
+        """
+        self.client.set(f"{self.ns}/{round}/member/{worker_id}", b"1")
+        start = time.monotonic()
+        deadline = start + timeout_s
+        grace_end = start + min(min_world_grace_s, timeout_s / 2)
+        prefix = f"{self.ns}/{round}/member/"
+        stable_since: float | None = None
+        prev: frozenset[str] = frozenset()
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rendezvous round {round}: membership never stabilized "
+                    f"(last saw {sorted(prev)})")
+            live = self.client.live()
+            members = frozenset(
+                k[len(prefix):] for k in self.client.keys(prefix)
+            ) & live
+            now = time.monotonic()
+            if len(members) < min_world and now < grace_end:
+                prev, stable_since = members, now
+                time.sleep(0.05)
+                continue
+            if worker_id not in members or members != prev:
+                prev, stable_since = members, now
+                time.sleep(0.05)
+                continue
+            if now - (stable_since or now) < settle_s:
+                time.sleep(0.05)
+                continue
+            ordered = sorted(members)
+            fingerprint = ",".join(ordered)
+            # agreement barrier: releases only if every member computed
+            # this exact set; a mismatch (someone saw a different set)
+            # times out server-side and withdraws the arrival
+            # crc32, not hash(): str hashing is salted per-process and the
+            # key must be identical on every participant
+            if self.client.barrier(
+                    f"{self.ns}/{round}/agree/"
+                    f"{zlib.crc32(fingerprint.encode())}/{len(ordered)}",
+                    len(ordered), timeout_s=max(2 * settle_s, 1.0)):
+                return ordered.index(worker_id), len(ordered), ordered
+            stable_since = None  # disagreement: re-poll
 
 
 class ElasticMonitor:

@@ -75,6 +75,7 @@ def launch(
     min_nprocs: int | None = None,
     restart_cooldown: tuple[float, float] | float | None = None,
     discover_cmd: str | None = None,
+    elastic_inprocess: bool = False,
 ) -> int:
     """Run ``cmd`` as an ``nprocs``-process gang; returns the gang's exit
     code (0 only if every worker of some attempt exited 0).
@@ -91,6 +92,12 @@ def launch(
       ``[min_nprocs or 1, nprocs]`` (≙ ``--host-discovery-script``).
     * ``restart_cooldown`` — seconds (or a ``(lo, hi)`` range sampled
       uniformly) to wait before each restart (≙ the blacklist cooldown).
+    * ``elastic_inprocess`` — a dying worker does NOT tear the gang down:
+      survivors are expected to detect the loss themselves via coordination-
+      service TTL heartbeats and re-rendezvous smaller in-process
+      (:func:`tpudist.elastic.worker.run_elastic_worker` — the Horovod
+      elastic-driver model, vs. the default torchrun gang-restart model).
+      The attempt succeeds when at least ``min_nprocs or 1`` workers exit 0.
     """
     if min_nprocs is not None and min_nprocs > nprocs:
         raise ValueError(
@@ -151,8 +158,11 @@ def launch(
                                      f"={devices_per_proc}")
                         wenv["XLA_FLAGS"] = " ".join(flags)
                 procs.append(subprocess.Popen(cmd, env=wenv))
-            codes = _supervise(procs)
-            if all(c == 0 for c in codes):
+            codes = _supervise(procs, tear_down=not elastic_inprocess)
+            if elastic_inprocess:
+                if sum(c == 0 for c in codes) >= (floor or 1):
+                    return 0
+            elif all(c == 0 for c in codes):
                 return 0
             log.warning(
                 "gang attempt %d failed (exit codes %s)%s", attempt, codes,
@@ -168,15 +178,18 @@ def launch(
             server.stop()
 
 
-def _supervise(procs: list[subprocess.Popen]) -> list[int]:
+def _supervise(procs: list[subprocess.Popen],
+               tear_down: bool = True) -> list[int]:
     """Wait for the gang; on first failure, terminate the survivors (the
-    torchrun gang-failure contract)."""
+    torchrun gang-failure contract).  With ``tear_down=False`` a failure
+    leaves the survivors running (in-process elastic: they shrink the world
+    themselves via TTL rendezvous)."""
     try:
         while True:
             codes = [p.poll() for p in procs]
             if all(c is not None for c in codes):
                 return codes  # type: ignore[return-value]
-            if any(c not in (None, 0) for c in codes):
+            if tear_down and any(c not in (None, 0) for c in codes):
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
@@ -222,6 +235,10 @@ def main(argv: list[str] | None = None) -> int:
                          "(horovodrun --host-discovery-script)")
     ap.add_argument("--no-coord", action="store_true",
                     help="skip the native coordination server")
+    ap.add_argument("--elastic-inprocess", action="store_true",
+                    help="don't tear the gang down on a worker death; "
+                         "survivors shrink via TTL rendezvous "
+                         "(tpudist.elastic.worker)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command, e.g. script.py arg1 arg2")
     args = ap.parse_args(argv)
@@ -251,6 +268,7 @@ def main(argv: list[str] | None = None) -> int:
         platform=args.platform, devices_per_proc=args.devices_per_proc,
         coord_server=not args.no_coord, min_nprocs=args.min_nprocs,
         restart_cooldown=cooldown, discover_cmd=args.discover_cmd,
+        elastic_inprocess=args.elastic_inprocess,
     )
 
 
