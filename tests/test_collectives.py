@@ -77,6 +77,28 @@ def test_broadcast_from_root(server):
         np.testing.assert_array_equal(out["w"], np.full((3,), 7.0))
 
 
+def test_back_to_back_broadcasts_with_slow_consumer(server):
+    """Broadcast is synchronizing: three broadcasts in a row must all land
+    even when a peer is slow to start fetching — without the trailing
+    barrier, the root's op-2 key GC would delete payload 0 before the slow
+    peer reads it (review finding r2)."""
+    import time as _time
+
+    def fn(rank, client):
+        coll = HostCollectives(client, rank, 2, round_id=15, timeout_s=20.0)
+        outs = []
+        for i in range(3):
+            if rank == 1 and i == 0:
+                _time.sleep(0.5)  # slow joiner
+            outs.append(coll.broadcast(
+                {"x": np.full((2,), float(i + 10 * rank))}, root=0))
+        return outs
+
+    for outs in _run_world(server, 2, fn):
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o["x"], np.full((2,), float(i)))
+
+
 def test_key_cleanup_stays_bounded(server):
     """Posting op N deletes op N-2: after K allreduces at most 2 keys per
     rank remain, and close_round removes the rest."""

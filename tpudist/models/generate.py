@@ -29,17 +29,20 @@ def _rollout(
     max_new_tokens: int,
     select: SelectFn,
     key: jax.Array,
+    decode_attention: str = "dense",
+    cache_constraint=None,
 ) -> jnp.ndarray:
     """Shared KV-cached decode loop; ``select`` picks the next token from
     each step's last-position logits (argmax for greedy, a sampler
-    otherwise)."""
+    otherwise).  ``cache_constraint`` (leaf -> sharding or None) pins the
+    cache layout for sharded decoding (:func:`tp_generate`)."""
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(
             f"prompt_len + max_new_tokens = {total} exceeds "
             f"max_seq_len {cfg.max_seq_len}")
-    model = TransformerLM(cfg, decode=True)
+    model = TransformerLM(cfg, decode=True, decode_attention=decode_attention)
     # Cache shapes via eval_shape (no FLOPs, no throwaway params), zeros =
     # a blank cache (cache_index 0, empty slots).
     cache_struct = jax.eval_shape(
@@ -47,6 +50,12 @@ def _rollout(
         positions=jnp.zeros((b, 1), jnp.int32))["cache"]
     cache = jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_struct)
+    if cache_constraint is not None:
+        cache = jax.tree.map(
+            lambda x: (x if cache_constraint(x) is None
+                       else lax.with_sharding_constraint(
+                           x, cache_constraint(x))),
+            cache)
     # Prompt padded to the full rollout so the scan reads it with a dynamic
     # index; positions past the prompt take the previous step's selection.
     prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
@@ -76,6 +85,7 @@ def greedy_generate(
     params: Any,
     prompt: jnp.ndarray,
     max_new_tokens: int,
+    decode_attention: str = "dense",
 ) -> jnp.ndarray:
     """Greedy-decode ``max_new_tokens`` past ``prompt``.
 
@@ -94,7 +104,60 @@ def greedy_generate(
     return _rollout(
         cfg, params, prompt, max_new_tokens,
         lambda logits, _key: jnp.argmax(logits, axis=-1),
-        jax.random.key(0))
+        jax.random.key(0), decode_attention=decode_attention)
+
+
+def tp_generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "model",
+    rules=None,
+    decode_attention: str = "dense",
+) -> jnp.ndarray:
+    """Tensor-parallel greedy decode: Megatron-layout params sharded over
+    ``axis`` and the KV cache sharded over its HEADS dimension, so both
+    weight and cache memory scale 1/tp per chip.  The whole rollout is one
+    GSPMD program: qkv/up matmuls run column-sharded, the cache update and
+    per-head attention stay head-local, and proj/down insert the pair
+    all-reduces — no code change to the model, the shardings ARE the
+    parallelism (same principle as
+    :func:`tpudist.parallel.tensor_parallel.make_spmd_train_step`).
+
+    Requires ``cfg.kv_heads % tp == 0`` (each shard owns whole KV heads).
+    Returns the same tokens as :func:`greedy_generate`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudist.parallel.tensor_parallel import (
+        shard_tree,
+        spec_tree_from_rules,
+        transformer_tp_rules,
+    )
+
+    tp = mesh.shape[axis]
+    if cfg.kv_heads % tp:
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} not divisible by {axis!r} size {tp}")
+    specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
+    sharded = shard_tree(params, mesh, specs)
+
+    def cache_constraint(leaf):
+        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers: shard the heads
+            return NamedSharding(mesh, P(None, None, axis, None))
+        return NamedSharding(mesh, P())  # cache_index scalars
+
+    def run(params, prompt):
+        return _rollout(
+            cfg, params, prompt, max_new_tokens,
+            lambda logits, _key: jnp.argmax(logits, axis=-1),
+            jax.random.key(0), decode_attention=decode_attention,
+            cache_constraint=cache_constraint)
+
+    with mesh:
+        return jax.jit(run, static_argnums=())(sharded, prompt)
 
 
 def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:

@@ -128,6 +128,10 @@ class CausalSelfAttention(nn.Module):
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
     decode: bool = False
+    # "dense": masked softmax over the whole cache buffer; "flash": the
+    # Pallas flash-decode kernel (tpudist.ops.flash_decode) — same numerics,
+    # one cache read per KV head, the long-context serving path.
+    decode_attention: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
@@ -196,6 +200,11 @@ class CausalSelfAttention(nn.Module):
         cached_k.value, cached_v.value = k_all, v_all
         idx_var.value = idx + 1
 
+        if self.decode_attention == "flash":
+            from tpudist.ops.flash_decode import flash_decode
+
+            return flash_decode(q, k_all, v_all, idx + 1,
+                                window=cfg.attention_window)
         mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
         if cfg.attention_window is not None:  # sliding window: last W only
             mask = mask & (
@@ -221,6 +230,7 @@ class DecoderBlock(nn.Module):
     cfg: TransformerConfig
     attention_fn: AttentionFn = sdpa
     decode: bool = False
+    decode_attention: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, causal: bool = True) -> jnp.ndarray:
@@ -229,6 +239,7 @@ class DecoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln1")(x)
         x = x + CausalSelfAttention(self.cfg, self.attention_fn,
                                     decode=self.decode,
+                                    decode_attention=self.decode_attention,
                                     name="attn")(h, causal=causal)
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
         return x + MLPBlock(self.cfg, name="mlp")(h)
@@ -246,6 +257,7 @@ class TransformerLM(nn.Module):
     attention_fn: AttentionFn = sdpa
     decode: bool = False
     remat: bool = False
+    decode_attention: str = "dense"
 
     @nn.compact
     def __call__(
@@ -271,6 +283,7 @@ class TransformerLM(nn.Module):
                      if self.remat else DecoderBlock)
         for i in range(cfg.num_layers):
             x = block_cls(cfg, self.attention_fn, decode=self.decode,
+                          decode_attention=self.decode_attention,
                           name=f"block{i}")(x, causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,

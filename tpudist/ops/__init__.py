@@ -2,6 +2,7 @@
 (:mod:`tpudist.ops.flash_attention`)."""
 
 from tpudist.ops.flash_attention import flash_attention, flash_attention_fn
+from tpudist.ops.flash_decode import flash_decode
 from tpudist.ops.losses import (
     accuracy,
     cross_entropy,
@@ -16,6 +17,7 @@ __all__ = [
     "cross_entropy_per_token",
     "flash_attention",
     "flash_attention_fn",
+    "flash_decode",
     "mse_loss",
     "nll_loss",
 ]

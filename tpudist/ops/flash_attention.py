@@ -317,6 +317,50 @@ def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
+def _flash_bwd_dq_fused_kernel(off_ref, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, o_ref, dq_ref, delta_out_ref,
+                               dq_scr, delta_scr, *, scale: float,
+                               causal: bool, block_q: int, block_k: int,
+                               num_kb: int, window: int | None = None,
+                               prune: bool = False,
+                               total_kb: int | None = None):
+    """The dQ pass with the Δ = rowsum(dO ∘ O) prepass FUSED in: at the
+    first K step of each q-block, Δ is computed in VMEM from the already-
+    resident dO and O tiles (one extra [bq, D] read, amortized over the
+    whole K loop) and emitted as a side output for the dK/dV pass — the
+    separate XLA elementwise pass over O/dO and its HBM round-trip
+    disappear (round-1 verdict #3)."""
+    qi, j = pl.program_id(1), pl.program_id(2)
+    if prune:
+        kj = _band_k(window, block_q, block_k, total_kb)[1](qi) + j
+    else:
+        kj = j
+    q0, k0 = off_ref[0, 0], off_ref[0, 1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        delta_scr[:] = jnp.sum(
+            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True)
+
+    @pl.when(_block_live(qi, kj, block_q, block_k, causal, q0, k0, window))
+    def _compute():
+        q, kb, vb, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        _, ds = _bwd_block(
+            q, kb, vb, do, lse_ref[0].T, delta_scr[:], qi, kj, q0, k0,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            window=window)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        delta_out_ref[0] = delta_scr[:].T  # [1, bq] row layout, like lse
+
+
 def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                           scale: float, causal: bool, block_q: int,
@@ -361,14 +405,21 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
                       block_k, interpret, q_offset=0, k_offset=0,
-                      window=None):
+                      window=None, out=None):
     """(dQ, dK, dV) of one attention block given the FINAL softmax
     statistics ``lse``/``delta`` (shapes [B, H, S]).
 
+    With ``delta=None`` (the plain, non-ring path) Δ is not precomputed:
+    the dQ kernel derives it from ``out``/``dout`` tiles in VMEM and emits
+    it for the dK/dV pass — no separate elementwise pass, no Δ HBM write
+    from XLA.  The ring backward passes an explicit Δ because its identity
+    must come from the FINAL output across all blocks
+    (`parallel/ring_attention.py`).
+
     The flash backward identities hold per K/V block when P is computed
     against the final log-sum-exp, which is what makes the ring backward a
-    sum of per-block kernel calls (`parallel/ring_attention.py`); the
-    plain backward below is the single-block case with zero offsets.
+    sum of per-block kernel calls; the plain backward below is the
+    single-block case with zero offsets.
     """
     b, s, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
@@ -377,7 +428,13 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
     num_qb, num_kb = s // block_q, sk // block_k
     q3, k3, v3, do3 = (_fuse(x) for x in (q, k, v, dout))
     lse3 = lse.reshape(b * h, 1, s)
-    delta3 = delta.reshape(b * h, 1, s)
+    fuse_delta = delta is None
+    if fuse_delta:
+        if out is None:
+            raise ValueError("flash_block_grads needs `out` when delta=None")
+        o3 = _fuse(out)
+    else:
+        delta3 = delta.reshape(b * h, 1, s)
 
     def kv_head(g):
         return (g // h) * h_kv + (g % h) // group
@@ -413,20 +470,42 @@ def flash_block_grads(q, k, v, dout, lse, delta, *, causal, block_q,
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     offs = _offsets_arg(q_offset, k_offset)
-    dq = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_kb=span_k, window=window,
-            prune=prune, total_kb=num_kb),
-        grid=(b * h, num_qb, span_k),
-        in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
-                  row_spec, row_spec],
-        out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=semantics,
-        interpret=interpret,
-    )(offs, q3, k3, v3, do3, lse3, delta3)[0]
+    if fuse_delta:
+        dq, delta3 = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dq_fused_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, num_kb=span_k,
+                window=window, prune=prune, total_kb=num_kb),
+            grid=(b * h, num_qb, span_k),
+            in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                      row_spec, q_spec],
+            out_specs=[q_spec, row_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            compiler_params=semantics,
+            interpret=interpret,
+        )(offs, q3, k3, v3, do3, lse3, o3)
+    else:
+        dq = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dq_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, num_kb=span_k,
+                window=window, prune=prune, total_kb=num_kb),
+            grid=(b * h, num_qb, span_k),
+            in_specs=[_smem_spec(), q_spec, kv_spec, kv_spec, q_spec,
+                      row_spec, row_spec],
+            out_specs=[q_spec],
+            out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype)],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=semantics,
+            interpret=interpret,
+        )(offs, q3, k3, v3, do3, lse3, delta3)[0]
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -463,7 +542,7 @@ def flash_delta(out, dout):
 def _flash_bwd(causal, block_q, block_k, interpret, window, res, dout):
     q, k, v, out, lse = res
     return flash_block_grads(
-        q, k, v, dout, lse, flash_delta(out, dout),
+        q, k, v, dout, lse, None, out=out,
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
         window=window)
 

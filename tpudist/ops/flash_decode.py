@@ -1,0 +1,144 @@
+"""Pallas flash-decode kernel: single-token attention against a KV cache.
+
+The decode hot op.  Training flash attention (`tpudist/ops/flash_attention.py`)
+tiles queries in ``block_q`` rows; at decode time there is exactly ONE query
+per head, so that layout wastes the (8, 128) tile on padding.  The decode
+trick is to put the GQA *query-head group* on the sublane axis instead: with
+``g = H / H_kv`` query heads per KV head, the per-(batch, kv-head) work is a
+``[g, D] × [S, D]ᵀ`` matmul — queries of the same group share the K/V
+stream, so the cache is read ONCE per kv head (the memory-bound quantity at
+long context) while the MXU sees a real tile.
+
+Grid: ``(B·H_kv, S/block_k)``, K sequential innermost with the online-
+softmax recurrence in VMEM scratch — the same structure as the training
+kernel's K loop.  Blocks past ``cache_len`` skip their FLOPs under
+``pl.when`` (the fetch still streams, bounded by the allocated cache);
+positions beyond the cache index — and, with ``window``, older than the
+sliding window — mask to -inf.
+
+Reference scope note: the reference suite is training-only (SURVEY.md §2 —
+no inference path anywhere); this kernel + the TP rollout in
+:mod:`tpudist.models.generate` are the framework's serving story.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, scale: float, block_k: int, num_kb: int,
+                   window: int | None):
+    kj = pl.program_id(1)
+    cache_len = len_ref[0, 0]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kj * block_k < cache_len)
+    def _compute():
+        q, kb, vb = q_ref[0], k_ref[0], v_ref[0]     # [gp, D], [bk, D]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [gp, bk]
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        keep = k_pos < cache_len
+        if window is not None:
+            keep = jnp.logical_and(keep, k_pos >= cache_len - window)
+        s = jnp.where(keep, s, -jnp.inf)
+        m = m_scr[:]
+        new_m = jnp.maximum(m, jnp.maximum(
+            jnp.max(s, axis=-1, keepdims=True), _NEG_BIG))
+        p = jnp.exp(s - new_m)
+        corr = jnp.exp(m - new_m)
+        m_scr[:] = new_m
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_len: jnp.ndarray | int,
+    *,
+    window: int | None = None,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One decode step of attention.
+
+    Args:
+      q: ``[B, 1, H, D]`` — the current token's queries.
+      k_cache / v_cache: ``[B, S, H_kv, D]`` fixed-size cache buffers
+        (GQA: ``H_kv`` may divide ``H``); slots ``>= cache_len`` are
+        ignored.
+      cache_len: number of valid cache positions INCLUDING the current
+        token (the flax ``cache_index + 1``); may be traced.
+      window: sliding-window width (attend to the last ``window``
+        positions only), matching :func:`tpudist.models.sdpa` semantics.
+
+    Returns ``[B, 1, H, D]``.
+    """
+    b, s_q, h, d = q.shape
+    assert s_q == 1, "flash_decode consumes one query token"
+    s, h_kv = k_cache.shape[1], k_cache.shape[2]
+    if h % h_kv:
+        raise ValueError(f"num_heads {h} not a multiple of kv heads {h_kv}")
+    g = h // h_kv
+    gp = -(-g // 8) * 8  # pad the group to the 8-row sublane tile
+    if s % block_k:
+        block_k = s  # degenerate small caches: one block
+    num_kb = s // block_k
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    # [B, 1, H, D] -> [B·Hkv, gp, D]
+    q3 = q.reshape(b, h_kv, g, d)
+    q3 = jnp.pad(q3, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    q3 = q3.reshape(b * h_kv, gp, d)
+    k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
+    v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
+    len_arg = jnp.asarray(cache_len, jnp.int32).reshape(1, 1)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=d ** -0.5, block_k=block_k,
+            num_kb=num_kb, window=window),
+        grid=(b * h_kv, num_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(len_arg, q3, k3, v3)
+    return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)

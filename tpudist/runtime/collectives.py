@@ -139,17 +139,26 @@ class HostCollectives:
 
     def broadcast(self, tree: Any, root: int = 0) -> Any:
         """Every rank returns root's pytree (``hvd.broadcast_parameters``
-        role, `mnist_horovod.py:56` — state agreement after a resize)."""
+        role, `mnist_horovod.py:56` — state agreement after a resize).
+
+        Synchronizing: a trailing barrier guarantees every peer consumed
+        the payload before anyone proceeds — without it, the root's op-2
+        key GC could delete a broadcast a slow peer hasn't read yet
+        (allreduce doesn't need this: posting op N implies having read
+        every peer's op N-1)."""
         import jax
 
         leaves, treedef = jax.tree.flatten(tree)
         if self.rank == root:
-            op = self._post(_dumps([np.asarray(x) for x in leaves]))
-            return tree
-        op = self._op
-        self._op += 1
-        out = _loads(self._fetch(op, root))
-        return jax.tree.unflatten(treedef, out)
+            self._post(_dumps([np.asarray(x) for x in leaves]))
+            out_tree = tree
+        else:
+            op = self._op
+            self._op += 1
+            out_tree = jax.tree.unflatten(
+                treedef, _loads(self._fetch(op, root)))
+        self.barrier()
+        return out_tree
 
     def barrier(self, timeout_s: float | None = None) -> None:
         """All-ranks barrier for this round (native store barrier)."""

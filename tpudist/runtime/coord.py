@@ -280,7 +280,11 @@ class Rendezvous:
                     f"{zlib.crc32(fingerprint.encode())}/{len(ordered)}",
                     len(ordered), timeout_s=max(2 * settle_s, 1.0)):
                 return ordered.index(worker_id), len(ordered), ordered
-            stable_since = None  # disagreement: re-poll
+            # Disagreement: reset `prev` so the next poll re-arms the
+            # settle clock and the barrier is retried — clearing only
+            # stable_since would livelock when membership stays unchanged
+            # (prev == members would skip every re-arm branch forever).
+            prev = frozenset()
 
 
 class ElasticMonitor:
