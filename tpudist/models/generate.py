@@ -56,28 +56,42 @@ def _rollout(
                        else lax.with_sharding_constraint(
                            x, cache_constraint(x))),
             cache)
-    # Prompt padded to the full rollout so the scan reads it with a dynamic
-    # index; positions past the prompt take the previous step's selection.
-    prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    keys = jax.random.split(key, max_new_tokens)
 
+    # PREFILL: the whole prompt through one batched forward (the serving
+    # split — at long context this is the difference between streaming the
+    # cache once per prompt TOKEN and once per prompt) ...
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt,
+        positions=jnp.arange(prompt_len)[None, :],
+        mutable=["cache"],
+    )
+    cache = mutated["cache"]
+    first = select(logits[:, -1], keys[0]).astype(jnp.int32)
+
+    # ... then DECODE one token a step.
     def step(carry, inputs):
         t, step_key = inputs
         cache, prev = carry
-        tok = jnp.where(t < prompt_len, prompt_pad[:, t], prev)
         logits, mutated = model.apply(
             {"params": params, "cache": cache},
-            tok[:, None],
-            positions=jnp.full((b, 1), t, jnp.int32),
+            prev[:, None],
+            positions=jnp.full((b, 1), prompt_len + t - 1, jnp.int32),
             mutable=["cache"],
         )
         nxt = select(logits[:, -1], step_key).astype(jnp.int32)
-        return (mutated["cache"], nxt), tok
+        return (mutated["cache"], nxt), prev
 
-    keys = jax.random.split(key, total)
-    (_, _), toks = lax.scan(
-        step, (cache, jnp.zeros((b,), jnp.int32)),
-        (jnp.arange(total), keys))
-    return toks.T  # [total, B] -> [B, total]
+    if max_new_tokens > 1:
+        # emits the token it consumes, so `toks` is [g0 .. g_{n-2}] and the
+        # final carry holds g_{n-1}
+        (_, last), toks = lax.scan(
+            step, (cache, first),
+            (jnp.arange(1, max_new_tokens), keys[1:]))
+        generated = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    else:
+        generated = first[:, None]
+    return jnp.concatenate([prompt, generated], axis=1)
 
 
 def greedy_generate(

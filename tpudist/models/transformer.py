@@ -176,14 +176,19 @@ class CausalSelfAttention(nn.Module):
                         dtype=cfg.compute_dtype, name="proj")(out)
 
     def _cached_attend(self, q, k, v):
-        """One-token decoding against a KV cache of ``max_seq_len`` slots
-        (the standard flax ``cache`` collection pattern): fixed-shape
-        buffers + ``dynamic_update_slice`` keep the whole autoregressive
-        loop jittable as a ``lax.scan``."""
+        """Decoding against a KV cache of ``max_seq_len`` slots (the
+        standard flax ``cache`` collection pattern): fixed-shape buffers +
+        ``dynamic_update_slice`` keep the whole autoregressive loop
+        jittable as a ``lax.scan``.
+
+        ``s == 1`` is the per-token decode step; ``s > 1`` is PREFILL —
+        the whole prompt chunk lands in the cache in one call and attends
+        causally within itself + everything cached before it (the serving
+        split: one batched forward for the prompt, then one-token steps).
+        """
         cfg = self.cfg
         b, s, _, d = q.shape
         h_kv = k.shape[2]  # the GQA cache-memory win: Hkv slots, not H
-        assert s == 1, "cached decoding feeds one token at a time"
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
             (b, cfg.max_seq_len, h_kv, d), cfg.compute_dtype)
@@ -198,8 +203,10 @@ class CausalSelfAttention(nn.Module):
         v_all = jax.lax.dynamic_update_slice(
             cached_v.value, v.astype(cached_v.value.dtype), (0, idx, 0, 0))
         cached_k.value, cached_v.value = k_all, v_all
-        idx_var.value = idx + 1
+        idx_var.value = idx + s
 
+        if s > 1:
+            return self._prefill_attend(q, k_all, v_all, idx)
         if self.decode_attention == "flash":
             from tpudist.ops.flash_decode import flash_decode
 
@@ -211,6 +218,33 @@ class CausalSelfAttention(nn.Module):
                 idx - jnp.arange(cfg.max_seq_len) < cfg.attention_window)
         k_all, v_all = repeat_kv(q, k_all, v_all)  # cache itself stays GQA
         return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
+
+    def _prefill_attend(self, q, k_all, v_all, idx):
+        """Chunk prefill: queries at global positions [idx, idx+s) attend
+        over the cache's first idx+s slots, causally.  The flash path
+        reuses the forward kernel at ``q_offset=idx`` (its causal mask
+        also silences the garbage in not-yet-written slots; dead tiles are
+        pruned); the dense path builds the banded mask explicitly."""
+        cfg = self.cfg
+        s = q.shape[1]
+        if self.decode_attention == "flash":
+            from tpudist.ops.flash_attention import (
+                _auto_block, _flash_forward,
+            )
+
+            out, _ = _flash_forward(
+                q, k_all, v_all, True,
+                _auto_block(s), _auto_block(cfg.max_seq_len),
+                jax.default_backend() == "cpu",
+                q_offset=idx, window=cfg.attention_window)
+            return out
+        q_pos = idx + jnp.arange(s)[:, None]                  # [s, 1]
+        k_pos = jnp.arange(cfg.max_seq_len)[None, :]          # [1, S]
+        mask = k_pos <= q_pos
+        if cfg.attention_window is not None:
+            mask = mask & (q_pos - k_pos < cfg.attention_window)
+        k_all, v_all = repeat_kv(q, k_all, v_all)
+        return _masked_attend(q, k_all, v_all, mask[None, None])
 
 
 class MLPBlock(nn.Module):

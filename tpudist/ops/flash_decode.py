@@ -81,7 +81,7 @@ def flash_decode(
     cache_len: jnp.ndarray | int,
     *,
     window: int | None = None,
-    block_k: int = 128,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """One decode step of attention.
@@ -105,6 +105,7 @@ def flash_decode(
         raise ValueError(f"num_heads {h} not a multiple of kv heads {h_kv}")
     g = h // h_kv
     gp = -(-g // 8) * 8  # pad the group to the 8-row sublane tile
+    block_k = min(block_k, s)
     if s % block_k:
         block_k = s  # degenerate small caches: one block
     num_kb = s // block_k
