@@ -43,6 +43,10 @@ def main(argv=None) -> dict:
     parser.add_argument("--lr", default=0.05, type=float)
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize stage activations (jax.checkpoint)")
+    parser.add_argument("--packed", action="store_true",
+                        help="stage-shard the parameters (packed buffer: "
+                             "per-device memory = the widest stage, the "
+                             "reference's two-shard placement property)")
     args = parser.parse_args(argv)
 
     import jax
@@ -53,7 +57,11 @@ def main(argv=None) -> dict:
     from tpudist.models import resnet50_stages
     from tpudist.ops.losses import mse_loss
     from tpudist.parallel.data_parallel import broadcast_params
-    from tpudist.parallel.pipeline import make_pipeline_train_step
+    from tpudist.parallel.pipeline import (
+        make_packed_pipeline_train_step,
+        make_pipeline_train_step,
+        pack_stage_params,
+    )
     from tpudist.runtime.mesh import pipeline_mesh
     from tpudist.train.state import TrainState
 
@@ -80,15 +88,23 @@ def main(argv=None) -> dict:
 
     results: dict[int, float] = {}
     for num_split in (int(v) for v in str(args.num_splits).split(",")):
-        state = TrainState.create(
-            apply_fn=None,
-            params=broadcast_params(tuple(params), mesh),
-            tx=optax.sgd(args.lr),
-        )
-        step = make_pipeline_train_step(
-            stage_fns, mse_loss, mesh, num_microbatches=num_split,
-            remat=args.remat,
-        )
+        if args.packed:
+            flat, meta = pack_stage_params(tuple(params))
+            state = TrainState.create(None, flat, optax.sgd(args.lr))
+            step = make_packed_pipeline_train_step(
+                stage_fns, mse_loss, mesh, num_split, meta, state,
+                remat=args.remat,
+            )
+        else:
+            state = TrainState.create(
+                apply_fn=None,
+                params=broadcast_params(tuple(params), mesh),
+                tx=optax.sgd(args.lr),
+            )
+            step = make_pipeline_train_step(
+                stage_fns, mse_loss, mesh, num_microbatches=num_split,
+                remat=args.remat,
+            )
         x = jnp.asarray(x_np)
         y = jnp.asarray(one_hot_np)
         # compile outside the timed region; the reference times eager RPC
