@@ -71,6 +71,75 @@ def test_kill9_ttl_detection_rerendezvous_and_resume(tmp_path):
     assert d0["loss"] == d1["loss"]
 
 
+def test_late_joiner_grows_world(tmp_path):
+    """The GROW path (Horovod host-discovery add): a 2-worker gang is
+    training when a third worker appears.  Its heartbeat makes the
+    incumbents' next commit poll raise WorldChanged(3); everyone
+    re-rendezvouses at world 3, the joiner adopts rank 0's committed
+    state AND position (broadcast includes the host counters), and all
+    three finish identically."""
+    import os
+    import subprocess
+    import time
+
+    from tpudist.runtime.coord import CoordServer
+
+    server = CoordServer(0)
+    repo = str(Path(__file__).parent.parent)
+    base = dict(
+        os.environ,
+        WORKER_OUT_DIR=str(tmp_path),
+        WORKER_STEP_DELAY="0.4",
+        TPUDIST_COORD_ADDR=f"127.0.0.1:{server.port}",
+        PYTHONPATH=os.pathsep.join(
+            [repo] + ([os.environ["PYTHONPATH"]]
+                      if os.environ.get("PYTHONPATH") else [])),
+    )
+    procs = []
+    try:
+        for i in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER],
+                env={**base, "TPUDIST_PROCESS_ID": str(i),
+                     "TPUDIST_NUM_PROCESSES": "2"}))
+        # wait for round 0 to form before the third worker appears
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if any(e["event"] == "round" for e in _events(tmp_path, 0)):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("round 0 never formed")
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER],
+            env={**base, "TPUDIST_PROCESS_ID": "2",
+                 "TPUDIST_NUM_PROCESSES": "1"}))
+        for p in procs:
+            assert p.wait(timeout=300) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    checksums = set()
+    for sid in (0, 1, 2):
+        ev = _events(tmp_path, sid)
+        done = [e for e in ev if e["event"] == "done"]
+        assert done and done[-1]["steps"] == 30 and done[-1]["world"] == 3
+        checksums.add(done[-1]["checksum"])
+        rounds = [e for e in ev if e["event"] == "round"]
+        assert rounds[-1]["world"] == 3
+        # resumed from a commit boundary (the broadcast position)
+        assert rounds[-1]["resume_batch"] % 5 == 0
+    assert len(checksums) == 1
+    # incumbents saw the grow as a reset 2 -> 3
+    for sid in (0, 1):
+        resets = [e for e in _events(tmp_path, sid) if e["event"] == "reset"]
+        assert resets and resets[-1]["old_world"] == 2
+        assert resets[-1]["new_world"] == 3
+
+
 def test_steady_gang_completes_without_resize(tmp_path):
     """No failures: one round at world 2, no resets, identical results."""
     rc = launch(

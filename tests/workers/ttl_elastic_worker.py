@@ -17,6 +17,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 from tpudist.runtime.simulate import force_cpu_devices
 
@@ -40,6 +41,7 @@ BASE_LR = 0.1
 SPAWN_ID = os.environ.get("TPUDIST_PROCESS_ID", "x")
 KILL_SPAWN_ID = os.environ.get("WORKER_KILL_SPAWN_ID")
 KILL_AT_STEP = int(os.environ.get("WORKER_KILL_AT_STEP", "13"))
+STEP_DELAY = float(os.environ.get("WORKER_STEP_DELAY", "0"))
 OUT = os.environ["WORKER_OUT_DIR"]
 
 
@@ -87,6 +89,8 @@ def main() -> int:
         shard = GLOBAL_BATCH // ctx.world_size
         last_loss = float("nan")
         for step in range(state.host.batch, TOTAL_STEPS):
+            if STEP_DELAY:
+                time.sleep(STEP_DELAY)  # stretch the run for join tests
             gx, gy = global_batch(step)
             lo = ctx.rank * shard
             loss, grads = local_grads(

@@ -36,6 +36,7 @@ import os
 from typing import Callable
 
 import jax
+import numpy as np
 
 from tpudist.elastic.loop import WorldChanged
 from tpudist.elastic.state import ElasticState
@@ -125,9 +126,18 @@ def run_elastic_worker(
             coll = HostCollectives(client, rank, world, round_id,
                                    on_wait=monitor.check)
             # bitwise state agreement across the new world (the
-            # hvd.broadcast_parameters / TorchState re-broadcast role)
-            synced = coll.broadcast(tree_to_numpy(state.state), root=0)
-            state.state = jax.tree.map(host_to_leaf, state.state, synced)
+            # hvd.broadcast_parameters / TorchState re-broadcast role) —
+            # INCLUDING the host position: a freshly-joined worker starts
+            # from scratch and must adopt rank 0's (epoch, batch), or its
+            # step stream would misalign with the incumbents'
+            synced = coll.broadcast(
+                {"state": tree_to_numpy(state.state),
+                 "host": np.asarray([state.host.epoch, state.host.batch])},
+                root=0)
+            state.state = jax.tree.map(
+                host_to_leaf, state.state, synced["state"])
+            state.host.epoch = int(synced["host"][0])
+            state.host.batch = int(synced["host"][1])
             state.world_size = world
             state.commit()  # the agreed state is the rollback point
             log.info("round %d: rank %d of %d (%s)", round_id, rank, world,
