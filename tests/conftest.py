@@ -3,8 +3,9 @@
 The TPU-native analog of the reference's ``mp.spawn``-on-localhost pattern
 (`model_parallel_ResNet50.py:260` — SURVEY.md §4): a multi-device topology
 exercisable on one host, so mesh/sharding/checkpoint/elastic code runs in CI
-without a TPU.  Real-hardware smoke tests live in ``tests/tpu/`` and are
-skipped unless a TPU backend is present.
+without a TPU.  Real-hardware coverage lives in ``bench.py`` (run
+separately; it owns the chip for the duration) — unit tests must never
+touch real hardware.
 
 Platform forcing is belt-and-braces: the ambient environment may register a
 real TPU backend at interpreter startup AND force ``jax_platforms`` via
