@@ -196,6 +196,17 @@ class TestFlashDecode:
                     np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
                     err_msg=f"h={h} hkv={h_kv} w={window} len={cache_len}")
 
+    def test_chunked_prefill_matches_one_shot(self):
+        """prefill_chunk (the bounded-memory prefill for long context /
+        GSPMD paths) must not change the tokens — uneven chunks included."""
+        cfg, model, params, prompt = _model()
+        want = greedy_generate(cfg, params, prompt, 10)
+        for chunk in (1, 2, 3):
+            got = greedy_generate(cfg, params, prompt, 10,
+                                  prefill_chunk=chunk)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=f"chunk={chunk}")
+
     def test_flash_decode_generation_matches_dense(self):
         cfg, model, params, prompt = _model()
         want = greedy_generate(cfg, params, prompt, 10)

@@ -31,11 +31,19 @@ def _rollout(
     key: jax.Array,
     decode_attention: str = "dense",
     cache_constraint=None,
+    prefill_chunk: int | None = None,
 ) -> jnp.ndarray:
     """Shared KV-cached decode loop; ``select`` picks the next token from
     each step's last-position logits (argmax for greedy, a sampler
     otherwise).  ``cache_constraint`` (leaf -> sharding or None) pins the
-    cache layout for sharded decoding (:func:`tp_generate`)."""
+    cache layout for sharded decoding (:func:`tp_generate`).
+
+    ``prefill_chunk`` bounds prefill memory: the prompt is ingested in
+    chunks of that many tokens (each attending causally over everything
+    cached so far) — with the dense cache attention the peak logits
+    buffer is [B, H, chunk, S] instead of [B, H, prompt, S], which is what
+    keeps long-context prefill feasible off the flash path (e.g. under
+    GSPMD sharding, where the Pallas kernel cannot partition)."""
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -58,15 +66,19 @@ def _rollout(
             cache)
     keys = jax.random.split(key, max_new_tokens)
 
-    # PREFILL: the whole prompt through one batched forward (the serving
-    # split — at long context this is the difference between streaming the
-    # cache once per prompt TOKEN and once per prompt) ...
-    logits, mutated = model.apply(
-        {"params": params, "cache": cache}, prompt,
-        positions=jnp.arange(prompt_len)[None, :],
-        mutable=["cache"],
-    )
-    cache = mutated["cache"]
+    # PREFILL: the prompt through batched forwards (the serving split — at
+    # long context this is the difference between streaming the cache once
+    # per prompt TOKEN and once per prompt) ...
+    chunk = prompt_len if prefill_chunk is None else min(
+        prefill_chunk, prompt_len)
+    for lo in range(0, prompt_len, chunk):
+        piece = prompt[:, lo:lo + chunk]
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, piece,
+            positions=jnp.arange(lo, lo + piece.shape[1])[None, :],
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
     first = select(logits[:, -1], keys[0]).astype(jnp.int32)
 
     # ... then DECODE one token a step.
@@ -100,6 +112,7 @@ def greedy_generate(
     prompt: jnp.ndarray,
     max_new_tokens: int,
     decode_attention: str = "dense",
+    prefill_chunk: int | None = None,
 ) -> jnp.ndarray:
     """Greedy-decode ``max_new_tokens`` past ``prompt``.
 
@@ -118,7 +131,8 @@ def greedy_generate(
     return _rollout(
         cfg, params, prompt, max_new_tokens,
         lambda logits, _key: jnp.argmax(logits, axis=-1),
-        jax.random.key(0), decode_attention=decode_attention)
+        jax.random.key(0), decode_attention=decode_attention,
+        prefill_chunk=prefill_chunk)
 
 
 def tp_generate(
@@ -130,6 +144,7 @@ def tp_generate(
     axis: str = "model",
     rules=None,
     decode_attention: str = "dense",
+    prefill_chunk: int | None = 512,
 ) -> jnp.ndarray:
     """Tensor-parallel greedy decode: Megatron-layout params sharded over
     ``axis`` and the KV cache sharded over its HEADS dimension, so both
@@ -168,7 +183,8 @@ def tp_generate(
             cfg, params, prompt, max_new_tokens,
             lambda logits, _key: jnp.argmax(logits, axis=-1),
             jax.random.key(0), decode_attention=decode_attention,
-            cache_constraint=cache_constraint)
+            cache_constraint=cache_constraint,
+            prefill_chunk=prefill_chunk)
 
     with mesh:
         return jax.jit(run, static_argnums=())(sharded, prompt)
