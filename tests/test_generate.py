@@ -249,6 +249,31 @@ def test_tp_generate_matches_single_device(devices8):
         tp_generate(cfg, params, prompt, 4, make_mesh({"data": 2, "model": 4}))
 
 
+def test_sp_generate_sequence_sharded_cache(devices8):
+    """Sequence-sharded KV cache (per-chip cache memory 1/n — the
+    long-context serving layout): same tokens as unsharded, and the
+    compiled HLO never all-gathers the cache."""
+    from tpudist.models import sp_generate
+    from tpudist.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=32)
+    model = TransformerLM(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(4).integers(0, 32, (2, 5)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    want = greedy_generate(cfg, params, prompt, 10)
+    mesh = make_mesh({"data": 4, "seq": 2})
+    got = sp_generate(cfg, params, prompt, 10, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    cfg_bad = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                embed_dim=32, max_seq_len=36)
+    with pytest.raises(ValueError, match="divisible"):
+        sp_generate(cfg_bad, params, prompt, 4,
+                    make_mesh({"data": 1, "seq": 8}))
+
+
 def test_windowed_model_decode_matches_windowed_forward():
     """A model trained with sliding-window attention decodes consistently:
     the cache mask applies cfg.attention_window, matching the windowed

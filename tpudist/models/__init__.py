@@ -9,7 +9,12 @@ EmbeddingBag+Linear hybrid (`server_model_data_parallel.py:34-46`).
 
 from tpudist.models.convnet import ConvNet
 from tpudist.models.embedding import EmbeddingBagClassifier
-from tpudist.models.generate import greedy_generate, sample_generate, tp_generate
+from tpudist.models.generate import (
+    greedy_generate,
+    sample_generate,
+    sp_generate,
+    tp_generate,
+)
 from tpudist.models.mlp import MLP
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
 from tpudist.models.resnet import ResNet50, resnet50_stages
@@ -32,6 +37,7 @@ __all__ = [
     "TransformerLM",
     "greedy_generate",
     "sample_generate",
+    "sp_generate",
     "tp_generate",
     "resnet50_stages",
     "sdpa",

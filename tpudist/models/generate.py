@@ -190,6 +190,49 @@ def tp_generate(
         return jax.jit(run, static_argnums=())(sharded, prompt)
 
 
+def sp_generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "seq",
+    prefill_chunk: int | None = 512,
+) -> jnp.ndarray:
+    """Sequence-sharded-cache greedy decode: the KV cache's SEQUENCE
+    dimension is sharded over ``axis``, so per-chip cache memory is 1/n —
+    the layout that serves contexts larger than one chip's HBM (the
+    decode-side counterpart of ring attention).  Params stay replicated.
+
+    GSPMD partitions the cached attention into per-shard partial
+    attention + softmax reductions over the sharded axis; measured HLO
+    keeps the cache sharded end-to-end (all-reduces only — no cache
+    all-gather, and the per-token ``dynamic_update_slice`` stays local to
+    the owning shard).  Returns the same tokens as
+    :func:`greedy_generate`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if cfg.max_seq_len % mesh.shape[axis]:
+        raise ValueError(
+            f"max_seq_len {cfg.max_seq_len} not divisible by {axis!r} "
+            f"size {mesh.shape[axis]}")
+
+    def cache_constraint(leaf):
+        if leaf.ndim == 4:  # [B, S, H_kv, D]: shard the cache sequence
+            return NamedSharding(mesh, P(None, axis, None, None))
+        return NamedSharding(mesh, P())
+
+    def run(params, prompt):
+        return _rollout(
+            cfg, params, prompt, max_new_tokens,
+            lambda logits, _key: jnp.argmax(logits, axis=-1),
+            jax.random.key(0), cache_constraint=cache_constraint,
+            prefill_chunk=prefill_chunk)
+
+    with mesh:
+        return jax.jit(run)(params, prompt)
+
+
 def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     """Mask all but the k highest logits to -inf (last axis)."""
     if k >= logits.shape[-1]:
