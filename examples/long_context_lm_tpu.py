@@ -54,6 +54,10 @@ def main(argv=None) -> float:
                         help="rematerialize block activations "
                              "(jax.checkpoint): HBM for FLOPs")
     parser.add_argument("--log-every", default=10, type=int)
+    parser.add_argument("--generate", default=0, type=int,
+                        help="after training, greedy-decode this many "
+                             "tokens through the flash-decode serving path "
+                             "(one-shot prefill + per-token kernel steps)")
     args = parser.parse_args(argv)
     if args.sp > 1 and args.tp > 1:
         parser.error("--sp and --tp are separate strategies; pick one")
@@ -178,6 +182,26 @@ def main(argv=None) -> float:
         dt = time.perf_counter() - t0
         tps = (args.steps - steady_from) * tokens.size / dt
         print(f"throughput: {tps:,.0f} tokens/sec")
+
+    if args.generate > 0:
+        # the serving path: one-shot prompt prefill, then per-token
+        # flash-decode steps against the KV cache
+        from tpudist.models.generate import greedy_generate
+
+        # the pos-embed table is sized cfg.max_seq_len, so the decode cfg
+        # is the training cfg; prompt + generated must fit in it
+        prompt_len = min(args.seq_len // 4,
+                         cfg.max_seq_len - args.generate)
+        prompt = jnp.asarray(tokens[:2, :prompt_len])
+        t0 = time.time()
+        out = greedy_generate(
+            cfg, jax.device_get(state.params), prompt, args.generate,
+            decode_attention="flash")
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        print(f"generated {args.generate} tokens/seq "
+              f"(prompt {prompt.shape[1]}) in {dt:.2f}s; "
+              f"sample: {out[0, -16:].tolist()}")
     return loss
 
 
