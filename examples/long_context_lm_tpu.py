@@ -190,8 +190,11 @@ def main(argv=None) -> float:
 
         # the pos-embed table is sized cfg.max_seq_len, so the decode cfg
         # is the training cfg; prompt + generated must fit in it
-        prompt_len = min(args.seq_len // 4,
-                         cfg.max_seq_len - args.generate)
+        if args.generate >= cfg.max_seq_len:
+            parser.error(f"--generate must be < max_seq_len "
+                         f"({cfg.max_seq_len}); got {args.generate}")
+        prompt_len = max(1, min(args.seq_len // 4,
+                                cfg.max_seq_len - args.generate))
         prompt = jnp.asarray(tokens[:2, :prompt_len])
         t0 = time.time()
         out = greedy_generate(

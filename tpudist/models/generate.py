@@ -45,6 +45,8 @@ def _rollout(
     keeps long-context prefill feasible off the flash path (e.g. under
     GSPMD sharding, where the Pallas kernel cannot partition)."""
     b, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must hold at least one token")
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(
@@ -170,6 +172,12 @@ def tp_generate(
     if cfg.kv_heads % tp:
         raise ValueError(
             f"kv_heads {cfg.kv_heads} not divisible by {axis!r} size {tp}")
+    if decode_attention == "flash":
+        raise ValueError(
+            "tp_generate runs under GSPMD, which cannot partition the "
+            "Pallas decode kernel — use decode_attention='dense' (the "
+            "sharded einsums) here; the flash kernel serves the "
+            "single-chip path")
     specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
     sharded = shard_tree(params, mesh, specs)
 
