@@ -278,6 +278,8 @@ def sample_generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    decode_attention: str = "dense",
+    prefill_chunk: int | None = None,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` past ``prompt`` with the standard
     controls, all static-shape (one compiled rollout, like greedy):
@@ -305,4 +307,6 @@ def sample_generate(
             logits = top_p_filter(logits, top_p)
         return jax.random.categorical(step_key, logits, axis=-1)
 
-    return _rollout(cfg, params, prompt, max_new_tokens, select, key)
+    return _rollout(cfg, params, prompt, max_new_tokens, select, key,
+                    decode_attention=decode_attention,
+                    prefill_chunk=prefill_chunk)
