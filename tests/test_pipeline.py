@@ -97,20 +97,22 @@ class TestPackedPipeline:
     """Stage-sharded heterogeneous pipeline: same trajectory as sequential,
     per-device param bytes ≈ widest stage (not the sum) — VERDICT r1 #4."""
 
-    def test_matches_sequential_training(self):
+    @pytest.mark.parametrize("n_stages,num_mb", [(2, 4), (4, 2)])
+    def test_matches_sequential_training(self, n_stages, num_mb):
         from tpudist.parallel.pipeline import (
             make_packed_pipeline_train_step,
             pack_stage_params,
             unpack_stage_params,
         )
 
-        n_stages = 2
-        dims = [12, 24, 8]
+        dims = [12, 24, 16, 20, 8][: n_stages + 1]
         fns, params = zip(*[
             _dense_stage(dims[i], dims[i + 1], i) for i in range(n_stages)])
-        mesh = make_mesh({"data": 4, "stage": n_stages})
+        mesh = make_mesh({"data": 8 // n_stages, "stage": n_stages})
         flat, meta = pack_stage_params(params)
-        assert flat.shape == (n_stages, 12 * 24 + 24)  # widest stage
+        width = max(dims[i] * dims[i + 1] + dims[i + 1]
+                    for i in range(n_stages))
+        assert flat.shape == (n_stages, width)  # widest stage
 
         x = np.random.default_rng(7).standard_normal(
             (16, dims[0]), dtype=np.float32)
@@ -120,7 +122,7 @@ class TestPackedPipeline:
         tx = optax.adam(0.05)
         state = TrainState.create(lambda *a: None, flat, tx, rng=0)
         step = make_packed_pipeline_train_step(
-            list(fns), mse_loss, mesh, 4, meta, state, donate=False)
+            list(fns), mse_loss, mesh, num_mb, meta, state, donate=False)
 
         def seq_loss(flat_params, x, y):
             from tpudist.parallel.pipeline import unpack_stage
@@ -142,8 +144,8 @@ class TestPackedPipeline:
             rtol=1e-4, atol=1e-5)
         # round-trip: packed buffer unpacks back to per-stage trees
         trees = unpack_stage_params(new_state.params, meta)
-        assert trees[0]["w"].shape == (12, 24)
-        assert trees[1]["b"].shape == (8,)
+        assert trees[0]["w"].shape == (dims[0], dims[1])
+        assert trees[-1]["b"].shape == (dims[-1],)
 
     def test_per_device_param_memory_is_stage_local(self):
         """Each device's addressable shard of the packed params holds ONE
