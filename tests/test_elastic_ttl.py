@@ -71,6 +71,30 @@ def test_kill9_ttl_detection_rerendezvous_and_resume(tmp_path):
     assert d0["loss"] == d1["loss"]
 
 
+def test_double_kill_shrinks_to_one(tmp_path):
+    """Two sequential failures: 3 -> 2 at step 13, then 2 -> 1 at step 22.
+    The last survivor must detect both via TTL, roll back to the latest
+    commit each time, rescale the lr twice, and finish alone."""
+    rc = launch(
+        [sys.executable, WORKER], nprocs=3, min_nprocs=1,
+        elastic_inprocess=True,
+        env={"WORKER_OUT_DIR": str(tmp_path),
+             "WORKER_KILL_PLAN": "2:13,1:22"},
+    )
+    assert rc == 0
+    ev = _events(tmp_path, 0)
+    rounds = [e for e in ev if e["event"] == "round"]
+    assert [r["world"] for r in rounds] == [3, 2, 1]
+    assert rounds[1]["resume_batch"] == 10   # killed at 13, commit at 10
+    assert rounds[2]["resume_batch"] == 20   # killed at 22, commit at 20
+    resets = [(e["old_world"], e["new_world"])
+              for e in ev if e["event"] == "reset"]
+    assert resets == [(3, 2), (2, 1)]
+    done = [e for e in ev if e["event"] == "done"][-1]
+    assert done["steps"] == 30 and done["world"] == 1
+    assert done["lr"] == pytest.approx(0.1 * (2 / 3) * (1 / 2))
+
+
 def test_late_joiner_grows_world(tmp_path):
     """The GROW path (Horovod host-discovery add): a 2-worker gang is
     training when a third worker appears.  Its heartbeat makes the

@@ -39,8 +39,14 @@ GLOBAL_BATCH = 12  # divisible by both world sizes (3 and 2)
 BASE_LR = 0.1
 
 SPAWN_ID = os.environ.get("TPUDIST_PROCESS_ID", "x")
+# comma-separated spawn_id:step pairs, e.g. "2:13,1:22" for a double kill
+KILL_PLAN = dict(
+    pair.split(":") for pair in
+    os.environ.get("WORKER_KILL_PLAN", "").split(",") if pair)
 KILL_SPAWN_ID = os.environ.get("WORKER_KILL_SPAWN_ID")
 KILL_AT_STEP = int(os.environ.get("WORKER_KILL_AT_STEP", "13"))
+if KILL_SPAWN_ID is not None:
+    KILL_PLAN[KILL_SPAWN_ID] = str(KILL_AT_STEP)
 STEP_DELAY = float(os.environ.get("WORKER_STEP_DELAY", "0"))
 OUT = os.environ["WORKER_OUT_DIR"]
 
@@ -102,7 +108,7 @@ def main() -> int:
             state.state = state.state.apply_gradients(grads)
             state.host.batch = step + 1
             last_loss = float(gloss)
-            if KILL_SPAWN_ID == SPAWN_ID and step + 1 == KILL_AT_STEP:
+            if KILL_PLAN.get(SPAWN_ID) == str(step + 1):
                 emit("suicide", step=step + 1)
                 os.kill(os.getpid(), signal.SIGKILL)  # kill -9, no cleanup
             if (step + 1) % COMMIT_EVERY == 0:
