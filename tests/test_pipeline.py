@@ -430,6 +430,58 @@ class TestOneFOneB:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("P_,V,M", [(2, 2, 4), (2, 3, 6), (4, 2, 4)])
+    def test_interleaved_matches_sequential_training(self, P_, V, M):
+        """Interleaved (virtual-chunk) 1F1B — the full Megatron schedule —
+        trains bit-compatibly with the sequential model."""
+        from tpudist.parallel.pipeline import (
+            interleave_params, make_1f1b_pipeline_train_step,
+        )
+
+        d = 8
+        L = P_ * V
+        rng = np.random.default_rng(1)
+        params = {
+            "w": jnp.asarray(
+                rng.standard_normal((L, d, d), dtype=np.float32) * 0.2),
+            "b": jnp.zeros((L, d), jnp.float32),
+        }
+
+        def block(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        mesh = make_mesh({"data": 8 // P_, "stage": P_})
+        batch = M * (8 // P_)
+        x = rng.standard_normal((batch, d), dtype=np.float32)
+        y = rng.standard_normal((batch, d), dtype=np.float32)
+        tx = optax.sgd(0.2)
+
+        def seq_loss(params, x, y):
+            h = x
+            for c in range(L):
+                h = block(jax.tree.map(lambda p: p[c], params), h)
+            return mse_loss(h, y)
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(
+            params, jnp.asarray(x), jnp.asarray(y))
+
+        dev_params = interleave_params(params, P_, V)
+        state = TrainState.create(lambda *a: None, dev_params, tx, rng=0)
+        step = make_1f1b_pipeline_train_step(
+            block, mse_loss, mesh, num_microbatches=M, state_example=state,
+            donate=False, virtual_stages=V)
+        new_state, metrics = step(state, jnp.asarray(x), jnp.asarray(y))
+
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_loss), rtol=1e-5)
+        ref_state = TrainState.create(
+            lambda *a: None, interleave_params(params, P_, V), tx, rng=0
+        ).apply_gradients(interleave_params(ref_grads, P_, V))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            new_state.params, ref_state.params)
+
     def test_activation_memory_beats_gpipe(self):
         """The point of 1F1B: at M=8, P=2 the act buffer holds at most P
         in-flight micro-batches — GPipe's reverse-scan saves all M."""

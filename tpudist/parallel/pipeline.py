@@ -678,15 +678,17 @@ class _OneFOneBSchedule:
 
     kind   -1 idle / 0 forward / 1 backward
     m      micro-batch executed this tick
+    v      local virtual-chunk index executed this tick (0 when V == 1)
     frecv  act-buffer slot banking the activation arriving at tick start
     crecv  cot-buffer slot banking the cotangent arriving at tick start
     fread  act-buffer slot holding the executed micro-batch's INPUT
-           activation (-1: read from xs — stage 0); kept across fwd,
+           activation (-1: read from xs — chunk 0); kept across fwd,
            freed at bwd (the recompute source)
     cread  cot-buffer slot holding the incoming cotangent for a backward
-           tick (-1: last stage seeds from the loss)
+           tick (-1: the LAST chunk seeds from the loss)
     Qa/Qc  act/cot buffer sizes — Qa is THE 1F1B memory story: bounded by
-           the in-flight cap (~P), not by M as in GPipe
+           the in-flight cap (~P·V at the first device), not by M·V as in
+           GPipe
     """
 
     T: int
@@ -694,40 +696,50 @@ class _OneFOneBSchedule:
     Qc: int
     kind: np.ndarray
     m: np.ndarray
+    v: np.ndarray
     frecv: np.ndarray
     crecv: np.ndarray
     fread: np.ndarray
     cread: np.ndarray
 
 
-def _one_f_one_b_schedule(P: int, M: int) -> _OneFOneBSchedule:
-    """Event-driven simulation of the canonical 1F1B schedule.
+def _one_f_one_b_schedule(P: int, M: int, V: int = 1) -> _OneFOneBSchedule:
+    """Event-driven simulation of the 1F1B schedule, plain (V=1) or
+    interleaved (V>1 — the Megatron-LM schedule: chunk ``c = v·P + p``
+    lives on device ``c mod P``; micro-batches loop the ring V times in
+    forward and V times in reverse for backward).
 
-    Stage ``p`` may hold at most ``P - p`` micro-batches in flight
-    (forwarded, not yet backwarded) and prefers backward work — the two
-    rules that produce warmup ``P-1-p`` forwards, steady 1F1B alternation,
-    and cooldown drains, capping saved activations at O(P) per device
-    instead of GPipe's O(M).  Transport: a forward output hops to ``p+1``
-    and a cotangent to ``p-1``, both landing at the next tick's start; the
-    last stage's own backward becomes ready one tick after its forward
-    (loss-seeded locally, nothing travels)."""
-    caps = [P - p for p in range(P)]
-    act_slot: list[dict] = [dict() for _ in range(P)]
+    Rules: a device prefers backward work (oldest micro-batch, deepest
+    chunk first); otherwise it forwards (deepest ready chunk first) while
+    its in-flight count — forwarded-not-backwarded (m, v) pairs — stays
+    under its cap.  V=1 caps at ``P - p`` (the canonical warmup
+    ``P-1-p``); V>1 caps at Megatron's warmup bound
+    ``2(P-p-1) + (V-1)P + 1``.  Transport: a forward output hops one
+    device down the ring and a cotangent one device up, landing at the
+    next tick's start; the LAST chunk's backward self-unlocks one tick
+    after its forward (loss-seeded, nothing travels)."""
+    L = P * V
+    if V == 1:
+        caps = [P - p for p in range(P)]
+    else:
+        caps = [2 * (P - p - 1) + (V - 1) * P + 1 for p in range(P)]
+    act_slot: list[dict] = [dict() for _ in range(P)]   # (m, v) -> slot
     cot_slot: list[dict] = [dict() for _ in range(P)]
     free_a: list[list[int]] = [[] for _ in range(P)]
     free_c: list[list[int]] = [[] for _ in range(P)]
     next_a = [0] * P
     next_c = [0] * P
-    fwd_ready: list[set] = [set() for _ in range(P)]
+    fwd_ready: list[set] = [set() for _ in range(P)]    # {(m, v)}
     bwd_ready: list[set] = [set() for _ in range(P)]
-    arriving_f: list[int | None] = [None] * P
-    arriving_c: list[int | None] = [None] * P
-    self_ready: dict[int, int] = {}  # last stage: m -> tick its bwd unlocks
-    next_launch = 0  # stage 0 feeds micro-batches in order
+    arriving_f: list[tuple | None] = [None] * P
+    arriving_c: list[tuple | None] = [None] * P
+    self_ready: dict[int, int] = {}  # last chunk: m -> unlock tick
+    next_launch = 0  # chunk 0 (device 0, v 0) feeds micro-batches in order
     in_flight = [0] * P
     bwd_done = [0] * P
     cols: dict[str, list] = {k: [] for k in
-                             ("kind", "m", "frecv", "crecv", "fread", "cread")}
+                             ("kind", "m", "v", "frecv", "crecv",
+                              "fread", "cread")}
 
     def alloc(free: list[int], nxt: list[int], p: int) -> int:
         if free[p]:
@@ -736,71 +748,83 @@ def _one_f_one_b_schedule(P: int, M: int) -> _OneFOneBSchedule:
         return nxt[p] - 1
 
     t = 0
-    while any(d < M for d in bwd_done):
+    while any(d < M * V for d in bwd_done):
         row = {k: [-1] * P for k in cols}
         # 1. arrivals land
         nf, nc = [None] * P, [None] * P
         for p in range(P):
             if arriving_f[p] is not None:
-                m = arriving_f[p]
+                mv = arriving_f[p]
                 s = alloc(free_a, next_a, p)
-                act_slot[p][m] = s
+                act_slot[p][mv] = s
                 row["frecv"][p] = s
-                fwd_ready[p].add(m)
+                fwd_ready[p].add(mv)
             if arriving_c[p] is not None:
-                m = arriving_c[p]
+                mv = arriving_c[p]
                 s = alloc(free_c, next_c, p)
-                cot_slot[p][m] = s
+                cot_slot[p][mv] = s
                 row["crecv"][p] = s
-                bwd_ready[p].add(m)
-        if P >= 1:
-            for m, tick in list(self_ready.items()):
-                if tick <= t:
-                    bwd_ready[P - 1].add(m)
-                    del self_ready[m]
+                bwd_ready[p].add(mv)
+        for m, tick in list(self_ready.items()):
+            if tick <= t:
+                bwd_ready[P - 1].add((m, V - 1))
+                del self_ready[m]
         # 2. execution: backward first, else forward under the cap
         for p in range(P):
             if bwd_ready[p]:
-                m = min(bwd_ready[p])
-                bwd_ready[p].discard(m)
-                row["kind"][p], row["m"][p] = 1, m
-                if m in act_slot[p]:
-                    s = act_slot[p].pop(m)
+                m, v = min(bwd_ready[p], key=lambda mv: (mv[0], -mv[1]))
+                bwd_ready[p].discard((m, v))
+                row["kind"][p], row["m"][p], row["v"][p] = 1, m, v
+                if (m, v) in act_slot[p]:
+                    s = act_slot[p].pop((m, v))
                     row["fread"][p] = s
                     free_a[p].append(s)
-                if m in cot_slot[p]:
-                    s = cot_slot[p].pop(m)
+                if (m, v) in cot_slot[p]:
+                    s = cot_slot[p].pop((m, v))
                     row["cread"][p] = s
                     free_c[p].append(s)
                 in_flight[p] -= 1
                 bwd_done[p] += 1
-                if p > 0:
-                    nc[p - 1] = m
+                c = v * P + p
+                if c > 0:  # cotangent to chunk c-1 (one device up the ring)
+                    nc[(p - 1) % P] = (m, v - 1 if p == 0 else v)
                 continue
-            can_fwd = (next_launch < M) if p == 0 else bool(fwd_ready[p])
-            if can_fwd and in_flight[p] < caps[p]:
-                if p == 0:
-                    m = next_launch
-                    next_launch += 1
+            # chunk-0 launches appear as a virtual ready entry so the
+            # deepest-chunk-first priority arbitrates launches vs deeper
+            # forwards uniformly
+            candidates = set(fwd_ready[p])
+            if p == 0 and next_launch < M:
+                candidates.add((next_launch, 0))
+            if in_flight[p] >= caps[p]:
+                # the cap must never strangle the cotangent SOURCE: the
+                # last chunk's forward unlocks its own backward one tick
+                # later, so it is exempt (otherwise all devices can sit
+                # at cap waiting for cotangents only this forward creates)
+                candidates = {mv for mv in candidates
+                              if p == P - 1 and mv[1] == V - 1}
+            if candidates:
+                m, v = min(candidates, key=lambda mv: (-mv[1], mv[0]))
+                if (m, v) in fwd_ready[p]:
+                    fwd_ready[p].discard((m, v))
                 else:
-                    m = min(fwd_ready[p])
-                    fwd_ready[p].discard(m)
-                row["kind"][p], row["m"][p] = 0, m
-                row["fread"][p] = act_slot[p].get(m, -1)  # kept until bwd
+                    next_launch += 1
+                row["kind"][p], row["m"][p], row["v"][p] = 0, m, v
+                row["fread"][p] = act_slot[p].get((m, v), -1)
                 in_flight[p] += 1
-                if p < P - 1:
-                    nf[p + 1] = m
+                c = v * P + p
+                if c < L - 1:  # activation to chunk c+1 (one device down)
+                    nf[(p + 1) % P] = (m, v + 1 if p == P - 1 else v)
                 else:
                     self_ready[m] = t + 1
         arriving_f, arriving_c = nf, nc
         for k in cols:
             cols[k].append(row[k])
         t += 1
-        if t > 6 * P * M + 4 * P:  # pragma: no cover - schedule bug guard
+        if t > 8 * L * M + 6 * L:  # pragma: no cover - schedule bug guard
             raise RuntimeError("1F1B scheduler did not converge")
     return _OneFOneBSchedule(
         T=t, Qa=max(max(next_a), 1), Qc=max(max(next_c), 1),
-        **{k: np.asarray(v, np.int32) for k, v in cols.items()},
+        **{k: np.asarray(val, np.int32) for k, val in cols.items()},
     )
 
 
@@ -813,37 +837,46 @@ def make_1f1b_pipeline_train_step(
     data_axis: str = "data",
     stage_axis: str = "stage",
     donate: bool = True,
+    virtual_stages: int = 1,
 ):
     """1F1B pipeline of HOMOGENEOUS blocks with stage-sharded parameters.
 
     Same contract and numerics as :func:`make_stacked_pipeline_train_step`
-    (stacked ``[n_stages, ...]`` params sharded over the stage axis; the
-    block maps activations to same-shaped activations; ``loss_fn`` is a
-    mean over its batch), but the backward pass is SCHEDULED, not derived:
-    each scan tick executes either a forward (banking its input activation)
-    or a backward (``jax.vjp`` recomputed from the banked input — per-block
+    (stacked params sharded over the stage axis; the block maps activations
+    to same-shaped activations; ``loss_fn`` is a mean over its batch), but
+    the backward pass is SCHEDULED, not derived: each scan tick executes
+    either a forward (banking its input activation) or a backward
+    (``jax.vjp`` recomputed from the banked input — per-block
     rematerialization), interleaved 1F1B.  Activation memory is the
     schedule's act buffer: O(P) in-flight micro-batches per device versus
     GPipe's O(M) saved boundaries (`_OneFOneBSchedule.Qa`, asserted in
     tests) — the reason 1F1B is the production schedule at M >> P.
 
+    ``virtual_stages > 1`` selects the INTERLEAVED 1F1B schedule (the full
+    Megatron-LM schedule): chunk ``c = v·P + p`` of a ``P·V``-deep stack
+    runs on device ``c mod P``, shrinking the bubble by ~V at V extra ring
+    hops per micro-batch.  ``state.params`` leaves must then be stacked
+    ``[P·V, ...]`` in DEVICE order — build chunk-ordered params and apply
+    :func:`interleave_params` first.
+
     Cotangents ride the reverse ``ppermute`` ring one hop per tick; the
-    last stage seeds them from the loss (scaled 1/M so the summed
+    last CHUNK seeds them from the loss (scaled 1/M so the summed
     micro-batch gradients equal the full-batch gradient).
     """
     n_p = mesh.shape[stage_axis]
-    M = num_microbatches
-    sched = _one_f_one_b_schedule(n_p, M)
+    M, V = num_microbatches, virtual_stages
+    L = n_p * V
+    sched = _one_f_one_b_schedule(n_p, M, V)
     tbl = {k: jnp.asarray(getattr(sched, k))
-           for k in ("kind", "m", "frecv", "crecv", "fread", "cread")}
+           for k in ("kind", "m", "v", "frecv", "crecv", "fread", "cread")}
     for path, leaf in jax.tree_util.tree_leaves_with_path(state_example.params):
         if not (hasattr(leaf, "ndim") and leaf.ndim >= 1
-                and leaf.shape[0] == n_p):
+                and leaf.shape[0] == L):
             raise ValueError(
                 f"1F1B pipeline requires every param leaf stacked "
-                f"[{n_p}, ...]; {jax.tree_util.keystr(path)} has shape "
+                f"[{L}, ...] (P·V); {jax.tree_util.keystr(path)} has shape "
                 f"{getattr(leaf, 'shape', None)}")
-    state_specs = stacked_state_specs(state_example, n_p, stage_axis)
+    state_specs = stacked_state_specs(state_example, L, stage_axis)
     inv_m = 1.0 / M
 
     def _step(state, batch):
@@ -853,18 +886,18 @@ def make_1f1b_pipeline_train_step(
         xs = x.reshape(M, b // M, *x.shape[1:])
         ys = y.reshape(M, b // M, *y.shape[1:])
         my_p = lax.axis_index(stage_axis)
-        is_last = my_p == n_p - 1
         cols = tuple(
             lax.dynamic_index_in_dim(tbl[k], my_p, axis=1, keepdims=False)
-            for k in ("kind", "m", "frecv", "crecv", "fread", "cread"))
-        my_params = jax.tree.map(lambda p: p[0], state.params)
+            for k in ("kind", "m", "v", "frecv", "crecv", "fread", "cread"))
+        my_params = state.params  # local stack of V chunk slices
 
         def fwd_only(pp, aa):
             return block_fn(pp, aa)
 
         def tick(carry, col):
             buf_f, buf_c, act_q, cot_q, gacc, lacc = carry
-            kind, m, frecv, crecv, fread, cread = col
+            kind, m, ev, frecv, crecv, fread, cread = col
+            is_last = (my_p == n_p - 1) & (ev == V - 1)
             # 1. bank arrivals
             stored_a = lax.dynamic_update_index_in_dim(
                 act_q, buf_f, jnp.clip(frecv, 0), 0)
@@ -883,8 +916,11 @@ def make_1f1b_pipeline_train_step(
                 cot_q, jnp.clip(cread, 0), 0, keepdims=False)
             y_m = lax.dynamic_index_in_dim(
                 ys, jnp.clip(m, 0), 0, keepdims=False)
-
-            zero_g = jax.tree.map(jnp.zeros_like, my_params)
+            p_v = jax.tree.map(
+                lambda pr: lax.dynamic_index_in_dim(
+                    pr, jnp.clip(ev, 0), 0, keepdims=False),
+                my_params)
+            zero_g = jax.tree.map(jnp.zeros_like, p_v)
 
             def idle_branch(op):
                 _pp, a, _c, _ym = op
@@ -900,7 +936,7 @@ def make_1f1b_pipeline_train_step(
             def bwd_branch(op):
                 pp, a, c, ym = op
                 out, vjp = jax.vjp(fwd_only, pp, a)
-                # the last stage seeds from the loss; others use the
+                # the last CHUNK seeds from the loss; others use the
                 # cotangent that rode the reverse ring
                 l_m, vjp_l = jax.vjp(lambda o: loss_fn(o, ym), out)
                 (dout_loss,) = vjp_l(jnp.asarray(inv_m, l_m.dtype))
@@ -914,17 +950,19 @@ def make_1f1b_pipeline_train_step(
 
             send_f, send_c, gp, l_c = lax.switch(
                 kind + 1, [idle_branch, fwd_branch, bwd_branch],
-                (my_params, a_in, cot_in, y_m))
-            gacc = jax.tree.map(jnp.add, gacc, gp)
+                (p_v, a_in, cot_in, y_m))
+            gacc = jax.tree.map(
+                lambda acc, g: acc.at[jnp.clip(ev, 0)].add(g), gacc, gp)
             lacc = lacc + l_c
-            # 3. one hop each way
+            # 3. one hop each way around the (mod-P) ring; receivers
+            #    without a scheduled arrival discard via frecv/crecv = -1
             if n_p > 1:
                 buf_f = lax.ppermute(
                     send_f, stage_axis,
-                    [(i, i + 1) for i in range(n_p - 1)])
+                    [(i, (i + 1) % n_p) for i in range(n_p)])
                 buf_c = lax.ppermute(
                     send_c, stage_axis,
-                    [(i + 1, i) for i in range(n_p - 1)])
+                    [(i, (i - 1) % n_p) for i in range(n_p)])
             else:
                 buf_f, buf_c = send_f, send_c
             return (buf_f, buf_c, act_q, cot_q, gacc, lacc), None
@@ -939,8 +977,7 @@ def make_1f1b_pipeline_train_step(
             jnp.zeros((), jnp.float32),
         )
         (_, _, _, _, gacc, lacc), _ = lax.scan(tick, carry0, cols)
-        grads = jax.tree.map(lambda g: g[None], gacc)  # local [1, ...] slice
-        grads = lax.pmean(grads, data_axis)
+        grads = lax.pmean(gacc, data_axis)
         metrics = {"loss": lax.pmean(lax.psum(lacc, stage_axis), data_axis)}
         return state.apply_gradients(grads), metrics
 
