@@ -117,18 +117,27 @@ class HostCollectives:
 
     def allreduce_sum(self, tree: Any) -> Any:
         """Sum a pytree of arrays across all ranks (all-gather + local
-        reduce; payloads ride the store, O(world) per rank)."""
+        reduce; payloads ride the store, O(world) per rank).
+
+        The reduction runs in RANK ORDER on every participant — float
+        addition is non-associative, so a per-rank order (e.g. own shard
+        first) would leave replicas differing in ULPs and silently
+        diverging over steps (caught by the elastic grow test's bitwise
+        checksum)."""
         import jax
 
         leaves, treedef = jax.tree.flatten(tree)
         np_leaves = [np.asarray(x) for x in leaves]
         op = self._post(_dumps(np_leaves))
-        acc = [l.copy() for l in np_leaves]
+        acc: list[np.ndarray] | None = None
         for r in range(self.world):
-            if r == self.rank:
-                continue
-            for a, b in zip(acc, _loads(self._fetch(op, r))):
-                a += b
+            contrib = (np_leaves if r == self.rank
+                       else _loads(self._fetch(op, r)))
+            if acc is None:
+                acc = [np.array(c, copy=True) for c in contrib]
+            else:
+                for a, b in zip(acc, contrib):
+                    a += b
         return jax.tree.unflatten(treedef, acc)
 
     def allreduce_mean(self, tree: Any) -> Any:
