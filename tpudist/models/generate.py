@@ -147,8 +147,13 @@ def tp_generate(
     rules=None,
     decode_attention: str = "dense",
     prefill_chunk: int | None = 512,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Tensor-parallel greedy decode: Megatron-layout params sharded over
+    """Tensor-parallel decode (greedy by default; ``temperature``/``top_k``
+    / ``top_p`` + ``key`` select sampling): Megatron-layout params sharded over
     ``axis`` and the KV cache sharded over its HEADS dimension, so both
     weight and cache memory scale 1/tp per chip.  The whole rollout is one
     GSPMD program: qkv/up matmuls run column-sharded, the cache update and
@@ -186,11 +191,13 @@ def tp_generate(
             return NamedSharding(mesh, P(None, None, axis, None))
         return NamedSharding(mesh, P())  # cache_index scalars
 
+    select = _make_select(temperature, top_k, top_p)
+
     def run(params, prompt):
         return _rollout(
-            cfg, params, prompt, max_new_tokens,
-            lambda logits, _key: jnp.argmax(logits, axis=-1),
-            jax.random.key(0), decode_attention=decode_attention,
+            cfg, params, prompt, max_new_tokens, select,
+            key if key is not None else jax.random.key(0),
+            decode_attention=decode_attention,
             cache_constraint=cache_constraint,
             prefill_chunk=prefill_chunk)
 
@@ -206,8 +213,13 @@ def sp_generate(
     mesh,
     axis: str = "seq",
     prefill_chunk: int | None = 512,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Sequence-sharded-cache greedy decode: the KV cache's SEQUENCE
+    """Sequence-sharded-cache decode (greedy by default; the sampling
+    controls mirror :func:`sample_generate`): the KV cache's SEQUENCE
     dimension is sharded over ``axis``, so per-chip cache memory is 1/n —
     the layout that serves contexts larger than one chip's HBM (the
     decode-side counterpart of ring attention).  Params stay replicated.
@@ -230,11 +242,13 @@ def sp_generate(
             return NamedSharding(mesh, P(None, axis, None, None))
         return NamedSharding(mesh, P())
 
+    select = _make_select(temperature, top_k, top_p)
+
     def run(params, prompt):
         return _rollout(
-            cfg, params, prompt, max_new_tokens,
-            lambda logits, _key: jnp.argmax(logits, axis=-1),
-            jax.random.key(0), cache_constraint=cache_constraint,
+            cfg, params, prompt, max_new_tokens, select,
+            key if key is not None else jax.random.key(0),
+            cache_constraint=cache_constraint,
             prefill_chunk=prefill_chunk)
 
     with mesh:
@@ -289,6 +303,16 @@ def sample_generate(
     * ``top_p`` keeps the smallest nucleus whose cumulative probability
       reaches p (applied after top_k when both are set).
     """
+    select = _make_select(temperature, top_k, top_p)
+    return _rollout(cfg, params, prompt, max_new_tokens, select, key,
+                    decode_attention=decode_attention,
+                    prefill_chunk=prefill_chunk)
+
+
+def _make_select(temperature: float, top_k: Optional[int],
+                 top_p: Optional[float]) -> SelectFn:
+    """Validated token-selection fn shared by the local and sharded
+    rollouts (``temperature == 0`` reduces to greedy argmax)."""
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if top_k is not None and top_k < 1:
@@ -307,6 +331,4 @@ def sample_generate(
             logits = top_p_filter(logits, top_p)
         return jax.random.categorical(step_key, logits, axis=-1)
 
-    return _rollout(cfg, params, prompt, max_new_tokens, select, key,
-                    decode_attention=decode_attention,
-                    prefill_chunk=prefill_chunk)
+    return select
