@@ -48,6 +48,30 @@ def test_sp_attention_matches_sdpa(devices8, causal, fn_builder):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("h_kv", [2, 4])
+def test_ulysses_gqa_grouped_matches_sdpa(devices8, h_kv):
+    """GQA through Ulysses: with kv_heads divisible by the axis the K/V
+    stay GROUPED through the all-to-all (transport shrinks by the group
+    factor) and with kv_heads == axis-indivisible they expand — both must
+    match full-sequence sdpa."""
+    import functools
+
+    rng = np.random.default_rng(3)
+    b, s, h, d = 2, 32, 8, 8
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    want = sdpa(q, k, v, causal=True)
+    mesh = make_mesh({"seq": 4}, devices8[:4])
+    sharded = jax.jit(jax.shard_map(
+        functools.partial(ulysses_attention_fn("seq"), causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    got = sharded(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_ring_attention_8way(devices8):
     q, k, v = _qkv(b=1, s=64, h=2, d=4, seed=1)
     want = sdpa(q, k, v, causal=True)

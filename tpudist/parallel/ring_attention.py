@@ -125,9 +125,13 @@ def ulysses_attention_fn(axis_name: str = "seq") -> Callable:
                window: int | None = None):
         from tpudist.models.transformer import repeat_kv, sdpa
 
-        # GQA: expand grouped K/V before the all-to-all (head counts must
-        # match the axis split; the ring variants keep K/V grouped instead)
-        k, v = repeat_kv(q, k, v)
+        n = lax.axis_size(axis_name)
+        if k.shape[2] % n:
+            # GQA with fewer KV heads than the axis: expand first (head
+            # counts must divide the split)
+            k, v = repeat_kv(q, k, v)
+        # else: K/V stay GROUPED through the all-to-all — sdpa handles GQA
+        # natively, so the K/V transport shrinks by the group factor
 
         def gather_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
             return lax.all_to_all(
