@@ -196,6 +196,29 @@ class TestFlashDecode:
                     np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
                     err_msg=f"h={h} hkv={h_kv} w={window} len={cache_len}")
 
+    def test_indivisible_cache_uses_divisor_blocks(self):
+        """A cache length not divisible by block_k must fall back to the
+        largest multiple-of-8 divisor — NOT one whole-cache block (which
+        blows VMEM at large non-power-of-two max_seq_len) — and still be
+        exact (ADVICE r2)."""
+        from tpudist.models.transformer import _masked_attend, repeat_kv
+        from tpudist.ops.flash_decode import flash_decode
+
+        rng = np.random.default_rng(3)
+        b, s, h, d = 1, 3000, 4, 8  # 3000 % 1024 != 0; divisor path -> 1000
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        got = flash_decode(q, k, v, 2500)
+        mask = jnp.arange(s) < 2500
+        kf, vf = repeat_kv(q, k, v)
+        want = _masked_attend(q, kf, vf, mask[None, None, None, :])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            flash_decode(q, jnp.zeros((b, 4097, h, d)),
+                         jnp.zeros((b, 4097, h, d)), 8)
+
     def test_chunked_prefill_matches_one_shot(self):
         """prefill_chunk (the bounded-memory prefill for long context /
         GSPMD paths) must not change the tokens — uneven chunks included."""
@@ -213,6 +236,19 @@ class TestFlashDecode:
         got = greedy_generate(cfg, params, prompt, 10,
                               decode_attention="flash")
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_flash_prefill_odd_chunk_padded_to_sublane(self):
+        """Odd/short prefill chunks (3, 10, ...) are padded to the 8-row
+        sublane tile before the flash kernel — block_q < 8 doesn't lower
+        on real TPU (ADVICE r2).  Tokens must be unchanged."""
+        cfg, model, params, prompt = _model()
+        want = greedy_generate(cfg, params, prompt, 10)
+        for chunk in (3, 10):
+            got = greedy_generate(cfg, params, prompt, 10,
+                                  decode_attention="flash",
+                                  prefill_chunk=chunk)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=f"chunk={chunk}")
 
     def test_flash_decode_windowed_gqa_generation(self):
         cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=4,

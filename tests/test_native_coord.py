@@ -230,3 +230,43 @@ def test_elastic_rendezvous_restart_cycle(server):
     assert set(results) == {0, 1}
     assert {r for _, r, _ in results.values()} == {0, 1}  # dense new ranks
     assert all(w == 2 for _, _, w in results.values())
+
+
+def test_join_live_superseded_round_aborts(server):
+    """A worker lagging in a round the gang already moved past must abort
+    (TimeoutError) instead of settling into a splinter world of one
+    (code-review r3): the superseded_key publishes the highest FORMED
+    round; seeing a higher value kills the join immediately."""
+    c = CoordClient("127.0.0.1", server.port)
+    mon = ElasticMonitor(c, "laggard", ttl_s=2.0, interval_s=0.3)
+    mon.start(None)
+    c.set("elastic/round", "7")
+    rdzv = Rendezvous(c)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="superseded"):
+        rdzv.join_live(5, "laggard", timeout_s=30.0, min_world=2,
+                       superseded_key="elastic/round")
+    assert time.monotonic() - t0 < 5.0  # aborted, not timed out
+    mon.stop()
+    c.close()
+
+
+def test_stale_round_member_keys_swept(server):
+    """Rank 0 of a formed round sweeps dead rounds' member registrations
+    (the O(world)-keys-per-resize leak, ADVICE r2) without touching the
+    current round's."""
+    c = CoordClient("127.0.0.1", server.port)
+    # litter: two dead rounds' worth of member keys
+    for r in (0, 1):
+        for w in ("a", "b", "c"):
+            c.set(f"rdzv/{r}/member/{w}", b"1")
+    mon = ElasticMonitor(c, "w0", ttl_s=2.0, interval_s=0.3)
+    mon.start(None)
+    rank, world, members = Rendezvous(c).join_live(
+        2, "w0", timeout_s=10.0, settle_s=0.1)
+    assert (rank, world) == (0, 1) and members == ["w0"]
+    assert c.keys("rdzv/0/member/") == []
+    assert c.keys("rdzv/1/member/") == []
+    assert c.keys("rdzv/2/member/") == ["rdzv/2/member/w0"]
+    mon.stop()
+    c.close()

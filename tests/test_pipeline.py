@@ -570,7 +570,8 @@ class TestThreeDParallel:
                     "down": PS("stage", "model", None)})
         step = make_stacked_pipeline_train_step(
             tp_block, mse_loss, mesh, num_microbatches=M,
-            state_example=state, state_specs=state_specs, donate=False)
+            state_example=state, state_specs=state_specs, donate=False,
+            grad_sync_axes=("model",))
         new_state, metrics = step(state, x, y)
 
         np.testing.assert_allclose(
@@ -635,7 +636,8 @@ class TestThreeDParallel:
                     "down": PS("stage", "model", None)})
         step = make_stacked_pipeline_train_step(
             tp_block, mse_loss, mesh, num_microbatches=M,
-            state_example=state, state_specs=state_specs, donate=False)
+            state_example=state, state_specs=state_specs, donate=False,
+            grad_sync_axes=("model",))
         new_state, _ = step(state, x, y)
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
@@ -739,3 +741,24 @@ def test_stacked_specs_must_shard_stage_dim():
         make_stacked_pipeline_train_step(
             lambda p, x: x, mse_loss, mesh, 2, state_example=state,
             state_specs=bad)
+
+
+def test_stacked_specs_require_explicit_grad_sync_axes():
+    """state_specs on a mesh with extra axes must NOT silently infer the
+    grad psum — wrong-by-default for already-complete gradients (ADVICE
+    r2); the caller opts in explicitly."""
+    from jax.sharding import PartitionSpec as PS
+
+    from tpudist.parallel.pipeline import (
+        make_stacked_pipeline_train_step, state_specs_like,
+    )
+    from tpudist.ops.losses import mse_loss
+
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2})
+    params = {"w": jnp.zeros((2, 4, 4))}
+    state = TrainState.create(None, params, optax.sgd(0.1))
+    specs = state_specs_like(state, {"w": PS("stage", None, "model")})
+    with pytest.raises(ValueError, match="grad_sync_axes explicitly"):
+        make_stacked_pipeline_train_step(
+            lambda p, x: x, mse_loss, mesh, 2, state_example=state,
+            state_specs=specs)

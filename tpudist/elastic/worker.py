@@ -70,6 +70,16 @@ class ElasticContext:
 TrainFn = Callable[[ElasticState, ElasticContext], None]
 
 
+def _next_round(client: CoordClient, round_id: int) -> int:
+    """The round-advance rule, shared by every re-rendezvous path: strictly
+    forward, and past the highest round the gang already formed (the
+    published ``elastic/round``) — a laggard must chase the gang, never
+    re-form a round behind it."""
+    raw = client.get("elastic/round")
+    published = -1 if raw is None else int(raw)
+    return max(round_id + 1, published + 1)
+
+
 def _coord_client(coord_addr: str | None) -> CoordClient:
     addr = coord_addr or os.environ.get("TPUDIST_COORD_ADDR")
     if not addr:
@@ -111,38 +121,47 @@ def run_elastic_worker(
             try:
                 rank, world, members = rdzv.join_live(
                     round_id, wid, timeout_s=rendezvous_timeout_s,
-                    min_world=min_world)
+                    min_world=min_world, superseded_key="elastic/round")
             except TimeoutError:
                 rounds += 1
                 if rounds > max_rounds:
                     raise
-                raw = client.get("elastic/round")
-                published = -1 if raw is None else int(raw)
-                round_id = max(round_id + 1, published + 1)
+                round_id = _next_round(client, round_id)
                 continue
             monitor.resize(world)
             if rank == 0:
-                client.set("elastic/round", str(round_id))
+                # publish forward only: a lagging splinter round must never
+                # regress the counter fresh joiners key off.  Best-effort
+                # (get-then-set, not CAS): join_live's defer-while-live-
+                # non-members rule keeps concurrent round formation out of
+                # the steady state, and a racy regression only costs the
+                # next joiner one extra WorldChanged cycle.
+                raw = client.get("elastic/round")
+                if raw is None or int(raw) < round_id:
+                    client.set("elastic/round", str(round_id))
             coll = HostCollectives(client, rank, world, round_id,
                                    on_wait=monitor.check)
-            # bitwise state agreement across the new world (the
-            # hvd.broadcast_parameters / TorchState re-broadcast role) —
-            # INCLUDING the host position: a freshly-joined worker starts
-            # from scratch and must adopt rank 0's (epoch, batch), or its
-            # step stream would misalign with the incumbents'
-            synced = coll.broadcast(
-                {"state": tree_to_numpy(state.state),
-                 "host": np.asarray([state.host.epoch, state.host.batch])},
-                root=0)
-            state.state = jax.tree.map(
-                host_to_leaf, state.state, synced["state"])
-            state.host.epoch = int(synced["host"][0])
-            state.host.batch = int(synced["host"][1])
-            state.world_size = world
-            state.commit()  # the agreed state is the rollback point
-            log.info("round %d: rank %d of %d (%s)", round_id, rank, world,
-                     ",".join(members))
             try:
+                # bitwise state agreement across the new world (the
+                # hvd.broadcast_parameters / TorchState re-broadcast role) —
+                # INCLUDING the host position: a freshly-joined worker starts
+                # from scratch and must adopt rank 0's (epoch, batch), or its
+                # step stream would misalign with the incumbents'.  This
+                # runs INSIDE the WorldChanged/PeerLost handler: the full
+                # model state is transferred here, so a peer dying mid-
+                # broadcast must trigger re-rendezvous, not a crash.
+                synced = coll.broadcast(
+                    {"state": tree_to_numpy(state.state),
+                     "host": np.asarray([state.host.epoch, state.host.batch])},
+                    root=0)
+                state.state = jax.tree.map(
+                    host_to_leaf, state.state, synced["state"])
+                state.host.epoch = int(synced["host"][0])
+                state.host.batch = int(synced["host"][1])
+                state.world_size = world
+                state.commit()  # the agreed state is the rollback point
+                log.info("round %d: rank %d of %d (%s)", round_id, rank,
+                         world, ",".join(members))
                 train_fn(state, ElasticContext(rank, world, round_id, coll,
                                                monitor))
                 coll.barrier()  # all ranks finish before anyone leaves
@@ -158,7 +177,7 @@ def run_elastic_worker(
                     state._committed_host.epoch, state._committed_host.batch)
                 state.on_world_change(e.new_world_size)
                 coll.close_round()
-                round_id += 1
+                round_id = _next_round(client, round_id)
                 min_world = e.new_world_size
             except PeerLost as e:
                 # a wait deadline fired before the TTL did — treat as a
@@ -171,7 +190,7 @@ def run_elastic_worker(
                             e, live)
                 state.on_world_change(live)
                 coll.close_round()
-                round_id += 1
+                round_id = _next_round(client, round_id)
                 min_world = live
     finally:
         monitor.stop(graceful=True)

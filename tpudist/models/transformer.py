@@ -232,12 +232,25 @@ class CausalSelfAttention(nn.Module):
                 _auto_block, _flash_forward,
             )
 
+            # pad odd/short chunks to the 8-row sublane tile: an s of 3 or
+            # 10 would yield block_q < 8, which Mosaic can't lower on real
+            # TPU.  Padded query rows are causally garbage but independent
+            # of the real rows; they're sliced off below.
+            s_pad = -(-s // 8) * 8
+            q_in = q if s_pad == s else jnp.pad(
+                q, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+            block_k = _auto_block(cfg.max_seq_len)
+            if block_k < 8:  # the K side has the same sublane floor
+                raise ValueError(
+                    f"decode_attention='flash' needs a power-of-two factor "
+                    f">= 8 in max_seq_len (got {cfg.max_seq_len}); round "
+                    f"max_seq_len up to a multiple of 8")
             out, _ = _flash_forward(
-                q, k_all, v_all, True,
-                _auto_block(s), _auto_block(cfg.max_seq_len),
+                q_in, k_all, v_all, True,
+                _auto_block(s_pad), block_k,
                 jax.default_backend() == "cpu",
                 q_offset=idx, window=cfg.attention_window)
-            return out
+            return out[:, :s]
         q_pos = idx + jnp.arange(s)[:, None]                  # [s, 1]
         k_pos = jnp.arange(cfg.max_seq_len)[None, :]          # [1, S]
         mask = k_pos <= q_pos

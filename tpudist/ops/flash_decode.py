@@ -107,7 +107,25 @@ def flash_decode(
     gp = -(-g // 8) * 8  # pad the group to the 8-row sublane tile
     block_k = min(block_k, s)
     if s % block_k:
-        block_k = s  # degenerate small caches: one block
+        # indivisible cache: largest divisor of S up to the cap that keeps
+        # the 8-row sublane tile (mirrors flash_attention's _auto_block) —
+        # NOT one whole-cache block, whose [S, D] K/V tiles blow VMEM for
+        # large non-power-of-two max_seq_len
+        bk = block_k - block_k % 8
+        while bk >= 8 and s % bk:
+            bk -= 8
+        if bk >= 128 or (bk >= 8 and s > 4096):
+            block_k = bk
+        elif s <= 4096:
+            # small cache whose best divisor is tiny (e.g. S = 8·prime):
+            # one whole-cache block beats hundreds of sequential 8-row
+            # grid steps, and [S, D] tiles at S <= 4096 fit VMEM
+            block_k = s
+        else:
+            raise ValueError(
+                f"cache length {s} has no block divisor that is a multiple "
+                f"of 8 up to {min(block_k, s)}; allocate the cache at a "
+                f"multiple of 8 (e.g. {-(-s // 8) * 8})")
     num_kb = s // block_k
     if interpret is None:
         interpret = jax.default_backend() == "cpu"

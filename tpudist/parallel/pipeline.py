@@ -358,9 +358,10 @@ def make_stacked_pipeline_train_step(
     block) receives per-shard PARTIAL gradients — Megatron cotangents
     between the f/g collectives are partial sums — so its grads are
     ``psum``'d over every ``grad_sync_axes`` axis missing from its spec.
-    ``grad_sync_axes`` defaults to all mesh axes except ``data_axis`` and
-    ``stage_axis`` when ``state_specs`` is given (the 3-D contract:
-    ``block_fn`` distributes compute over every extra mesh axis).
+    ``grad_sync_axes`` is REQUIRED (explicitly, possibly ``()``) whenever
+    ``state_specs`` is given and the mesh has axes beyond ``data_axis`` /
+    ``stage_axis`` — the sync is an opt-in, because for leaves whose
+    gradient is already complete it would silently scale by the axis size.
 
     The psum is only correct for replicated leaves whose cotangents are
     per-shard partials (used strictly between the f/g collectives); a
@@ -399,8 +400,22 @@ def make_stacked_pipeline_train_step(
                     f"must shard its leading (stage) dim over "
                     f"{stage_axis!r}; got {spec}")
         if grad_sync_axes is None:
-            grad_sync_axes = tuple(a for a in mesh.axis_names
-                                   if a not in (data_axis, stage_axis))
+            # Explicit opt-in (ADVICE r2): inferring "every extra mesh axis"
+            # silently psums already-complete gradients (the row-parallel-
+            # bias case) and scales them by the axis size.  The caller
+            # knows which leaves carry partial cotangents; we don't.
+            extra = tuple(a for a in mesh.axis_names
+                          if a not in (data_axis, stage_axis))
+            if not extra:
+                grad_sync_axes = ()
+            else:
+                raise ValueError(
+                    f"state_specs given with extra mesh axes {extra}: pass "
+                    f"grad_sync_axes explicitly — grad_sync_axes={extra} "
+                    f"to psum partial-cotangent replicated leaves over "
+                    f"them, grad_sync_axes=() if every replicated leaf's "
+                    f"gradient is already complete, or a per-leaf pytree "
+                    f"for mixed blocks (see docstring)")
     # Per-leaf static plan: which sync axes each param leaf's spec leaves
     # it replicated over (its grads there are per-shard partials that the
     # data-axis mean alone would silently desync — see docstring).

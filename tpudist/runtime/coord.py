@@ -213,6 +213,7 @@ class Rendezvous:
         settle_s: float = 0.3,
         min_world: int = 1,
         min_world_grace_s: float = 10.0,
+        superseded_key: str | None = None,
     ) -> tuple[int, int, list[str]]:
         """Dynamic-membership rendezvous: the round's world is whatever set
         of LIVE workers registers before membership stabilizes — the c10d
@@ -237,6 +238,14 @@ class Rendezvous:
         (liveness over the target: a pre-registration death must not hang
         the gang).
 
+        ``superseded_key`` names a store key publishing the highest round
+        already FORMED (the elastic loop's ``elastic/round``).  If its
+        value ever exceeds ``round``, this round is dead — the gang moved
+        on while we lagged — and joining raises :class:`TimeoutError`
+        immediately instead of settling into a splinter world of the
+        leftovers (the caller's timeout handler re-reads the published
+        round and jumps forward).
+
         Returns ``(rank, world_size, members)``; ranks are the sorted
         member order — dense, deterministic, identical everywhere.
         """
@@ -252,12 +261,34 @@ class Rendezvous:
                 raise TimeoutError(
                     f"rendezvous round {round}: membership never stabilized "
                     f"(last saw {sorted(prev)})")
+            if superseded_key is not None:
+                raw = self.client.get(superseded_key)
+                if raw is not None and int(raw) > round:
+                    raise TimeoutError(
+                        f"rendezvous round {round} superseded: round "
+                        f"{int(raw)} already formed")
             live = self.client.live()
-            members = frozenset(
-                k[len(prefix):] for k in self.client.keys(prefix)
-            ) & live
+            registered = frozenset(
+                k[len(prefix):] for k in self.client.keys(prefix))
+            if worker_id not in registered:
+                # re-assert: a concurrent newer round's rank 0 may have
+                # swept this round's member keys while we were settling
+                # (the rounds-form-concurrently race) — registration must
+                # survive the sweep or we poll into the timeout
+                self.client.set(f"{prefix}{worker_id}", b"1")
+                registered = registered | {worker_id}
+            members = registered & live
             now = time.monotonic()
-            if len(members) < min_world and now < grace_end:
+            if len(members) < min_world and (now < grace_end
+                                             or live - members):
+                # Defer sub-target formation while workers are ALIVE but
+                # not yet registered here: their heartbeats force the
+                # incumbents' next check() to raise WorldChanged, so they
+                # WILL arrive (or we time out and jump forward).  Without
+                # this, a laggard whose grace expires before the gang's
+                # next commit point forms a splinter world of one.  A
+                # worker that died pre-registration is not in live(), so
+                # the documented liveness-over-target rule still holds.
                 prev, stable_since = members, now
                 time.sleep(0.05)
                 continue
@@ -279,12 +310,34 @@ class Rendezvous:
                     f"{self.ns}/{round}/agree/"
                     f"{zlib.crc32(fingerprint.encode())}/{len(ordered)}",
                     len(ordered), timeout_s=max(2 * settle_s, 1.0)):
-                return ordered.index(worker_id), len(ordered), ordered
+                rank = ordered.index(worker_id)
+                if rank == 0:
+                    self._sweep_stale_rounds(round)
+                return rank, len(ordered), ordered
             # Disagreement: reset `prev` so the next poll re-arms the
             # settle clock and the barrier is retried — clearing only
             # stable_since would livelock when membership stays unchanged
             # (prev == members would skip every re-arm branch forever).
             prev = frozenset()
+
+    def _sweep_stale_rounds(self, current_round: int) -> None:
+        """Delete dead rounds' member registrations — without this a
+        long-lived elastic job leaks O(world) store keys per resize round
+        (ADVICE r2).  Run by the new round's rank 0 after agreement; old
+        rounds' keys are only read during their own join, so survivors of
+        round N never look at round < N again."""
+        prefix = f"{self.ns}/"
+        for k in self.client.keys(prefix):
+            rest = k[len(prefix):]
+            head, _, tail = rest.partition("/")
+            if not tail.startswith("member/"):
+                continue
+            try:
+                r = int(head)
+            except ValueError:
+                continue
+            if r < current_round:
+                self.client.delete(k)
 
 
 class ElasticMonitor:
