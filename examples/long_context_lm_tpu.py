@@ -197,13 +197,16 @@ def main(argv=None) -> float:
                                 cfg.max_seq_len - args.generate))
         prompt = jnp.asarray(tokens[:2, :prompt_len])
         t0 = time.time()
-        out = greedy_generate(
+        # stop_tokens: EOS semantics under static shapes — sequences
+        # freeze at their first stop token and report true lengths
+        out, lengths = greedy_generate(
             cfg, jax.device_get(state.params), prompt, args.generate,
-            decode_attention="flash")
+            decode_attention="flash", stop_tokens=[0])
         jax.block_until_ready(out)
         dt = time.time() - t0
         print(f"generated {args.generate} tokens/seq "
               f"(prompt {prompt.shape[1]}) in {dt:.2f}s; "
+              f"lengths (EOS=0): {lengths.tolist()}; "
               f"sample: {out[0, -16:].tolist()}")
     return loss
 

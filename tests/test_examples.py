@@ -83,3 +83,16 @@ def test_long_context_lm_twin(extra):
          "--log-every", "10", *extra]
     )
     assert loss == loss and loss < 7.0  # finite, sane
+
+
+def test_long_context_lm_generation_demo():
+    """The serving demo end-to-end: flash prefill + decode with EOS
+    stop_tokens and reported lengths."""
+    import long_context_lm_tpu
+
+    loss = long_context_lm_tpu.main(
+        ["--seq-len", "128", "--batch-size", "8", "--steps", "2",
+         "--layers", "1", "--heads", "4", "--embed-dim", "64",
+         "--log-every", "10", "--generate", "8"]
+    )
+    assert loss == loss

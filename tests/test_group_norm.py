@@ -1,0 +1,110 @@
+"""Closed-form-VJP GroupNorm vs flax's autodiff GroupNorm: same forward,
+same gradients (the op exists purely for backward speed — see
+tpudist/ops/group_norm.py for the measured motivation)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.ops.group_norm import GroupNormFast, group_norm
+
+
+@pytest.mark.parametrize("shape,groups", [
+    ((2, 8, 8, 32), 32),
+    ((3, 4, 4, 64), 32),
+    ((2, 5, 7, 16), 4),   # odd spatial dims
+    ((1, 2, 2, 8), 1),    # layer-norm-like single group
+])
+def test_matches_flax_forward_and_grads(shape, groups):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(shape[-1]),
+                        jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(shape[-1]), jnp.float32)
+    ref = nn.GroupNorm(num_groups=groups, use_scale=True, use_bias=True)
+    ref_params = {"scale": scale, "bias": bias}
+
+    got = group_norm(x, scale, bias, groups)
+    want = ref.apply({"params": ref_params}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_fast(x, s, b):
+        return jnp.sum(jnp.tanh(group_norm(x, s, b, groups)))
+
+    def loss_flax(x, s, b):
+        return jnp.sum(jnp.tanh(
+            ref.apply({"params": {"scale": s, "bias": b}}, x)))
+
+    g_fast = jax.grad(loss_fast, argnums=(0, 1, 2))(x, scale, bias)
+    g_flax = jax.grad(loss_flax, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, name in zip(g_fast, g_flax, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_bf16_io_f32_stats():
+    """bf16 in/out with f32 statistics (the ResNet compute contract)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 32)), jnp.bfloat16)
+    scale = jnp.ones((32,), jnp.float32)
+    bias = jnp.zeros((32,), jnp.float32)
+    y = group_norm(x, scale, bias, 8)
+    assert y.dtype == jnp.bfloat16
+    y32 = group_norm(x.astype(jnp.float32), scale, bias, 8)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(y32),
+                               rtol=0.05, atol=0.05)
+    # grads flow and keep dtypes
+    dx, ds, db = jax.grad(
+        lambda x, s, b: jnp.sum(group_norm(x, s, b, 8).astype(jnp.float32)),
+        argnums=(0, 1, 2))(x, scale, bias)
+    assert dx.dtype == jnp.bfloat16 and ds.dtype == jnp.float32
+
+
+def test_module_param_compat_with_flax():
+    """GroupNormFast reads/writes the same param tree as nn.GroupNorm
+    (scale/bias of [C]) — checkpoints transfer both ways."""
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 4, 4, 16)),
+                    jnp.float32)
+    fast = GroupNormFast(num_groups=4)
+    flax_mod = nn.GroupNorm(num_groups=4)
+    p_fast = fast.init(jax.random.key(0), x)["params"]
+    p_flax = flax_mod.init(jax.random.key(0), x)["params"]
+    assert jax.tree.map(jnp.shape, p_fast) == jax.tree.map(jnp.shape, p_flax)
+    np.testing.assert_allclose(
+        np.asarray(fast.apply({"params": p_flax}, x)),
+        np.asarray(flax_mod.apply({"params": p_fast}, x)),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_resnet_group_matches_flax_group_training_step():
+    """norm='group' (fast) and norm='group_flax' must produce the same
+    loss and gradients on a ResNet block stack — the swap is purely a
+    backward-speed change."""
+    import optax
+
+    from tpudist.models.resnet import Bottleneck
+
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 8, 64)),
+                    jnp.float32)
+
+    def make(norm):
+        m = Bottleneck(features=64, strides=1, norm=norm,
+                       compute_dtype=jnp.float32)
+        return m, m.init(jax.random.key(0), x)["params"]
+
+    m_fast, p = make("group")
+    m_flax, p_flax = make("group_flax")
+    assert jax.tree.map(jnp.shape, p) == jax.tree.map(jnp.shape, p_flax)
+
+    def loss(m):
+        return lambda p: jnp.mean(
+            jnp.square(m.apply({"params": p}, x)))
+
+    l1, g1 = jax.value_and_grad(loss(m_fast))(p)
+    l2, g2 = jax.value_and_grad(loss(m_flax))(p)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4), g1, g2)

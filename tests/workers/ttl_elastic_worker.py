@@ -39,7 +39,9 @@ GLOBAL_BATCH = 12  # divisible by both world sizes (3 and 2)
 BASE_LR = 0.1
 
 SPAWN_ID = os.environ.get("TPUDIST_PROCESS_ID", "x")
-# comma-separated spawn_id:step pairs, e.g. "2:13,1:22" for a double kill
+# comma-separated spawn_id:step pairs, e.g. "2:13,1:22" for a double kill;
+# only armed on the FIRST gang attempt — a launcher-restarted gang (the
+# full-gang-loss test) must run to completion
 KILL_PLAN = dict(
     pair.split(":") for pair in
     os.environ.get("WORKER_KILL_PLAN", "").split(",") if pair)
@@ -47,6 +49,9 @@ KILL_SPAWN_ID = os.environ.get("WORKER_KILL_SPAWN_ID")
 KILL_AT_STEP = int(os.environ.get("WORKER_KILL_AT_STEP", "13"))
 if KILL_SPAWN_ID is not None:
     KILL_PLAN[KILL_SPAWN_ID] = str(KILL_AT_STEP)
+if int(os.environ.get("TPUDIST_RESTART_ATTEMPT", "0")) > 0:
+    KILL_PLAN = {}
+CKPT_DIR = os.environ.get("WORKER_CKPT_DIR")
 STEP_DELAY = float(os.environ.get("WORKER_STEP_DELAY", "0"))
 OUT = os.environ["WORKER_OUT_DIR"]
 
@@ -72,7 +77,19 @@ def main() -> int:
     # `horovod_mnist_elastic.py:80-82`)
     tx = optax.inject_hyperparams(optax.sgd)(learning_rate=BASE_LR)
     train_state = TrainState.create(model.apply, params, tx, rng=0)
-    state = ElasticState(train_state, host=HostDataState())
+    ckpt = None
+    if CKPT_DIR:
+        # per-worker directory: each process is its own orbax "host" here
+        # (independent runtimes), so they must not race on one manager dir
+        from tpudist.elastic.orbax_ckpt import OrbaxCheckpointer
+
+        ckpt = OrbaxCheckpointer(
+            os.path.join(CKPT_DIR, f"w{SPAWN_ID}"), keep=3)
+    state = ElasticState(train_state, host=HostDataState(),
+                         checkpointer=ckpt)
+    if state.restored_step is not None:
+        emit("restored", step=state.restored_step,
+             batch=state.host.batch)
 
     def on_reset(s: ElasticState, old: int, new: int) -> None:
         lr = float(s.state.opt_state.hyperparams["learning_rate"]) * new / old

@@ -50,6 +50,7 @@ class ElasticState:
         host: HostDataState | None = None,
         checkpointer: Checkpointer | None = None,
         world_size: int = 1,
+        restore: bool = True,
     ) -> None:
         self.state = state
         self.host = host or HostDataState()
@@ -60,6 +61,23 @@ class ElasticState:
         self._committed_host: HostDataState | None = None
         self.commits = 0
         self.rollbacks = 0
+        # Durable resume (``restore=False`` opts out): adopt the latest
+        # checkpoint BEFORE the initial commit, so a restarted gang — even
+        # a FULL-gang loss, where no surviving peer can re-broadcast the
+        # state — resumes from the last durable commit rather than from
+        # scratch (the torch.save/load snapshot contract,
+        # `mnist_ddp_elastic.py:95-104`, extended to elastic restarts).
+        self.restored_step: int | None = None
+        if checkpointer is not None and restore:
+            hit = checkpointer.restore_latest(self.state)
+            if hit is not None:
+                step, tree, meta = hit
+                self.state = tree
+                self.host.epoch = int(meta.get("epoch", self.host.epoch))
+                self.host.batch = int(meta.get("batch", self.host.batch))
+                if "world_size" in meta:
+                    self.world_size = int(meta["world_size"])
+                self.restored_step = step
         self.commit()  # initial state is always restorable
 
     def register_reset_callbacks(self, callbacks: Sequence[ResetCallback]) -> None:
@@ -96,6 +114,19 @@ class ElasticState:
         worker add/drop (`horovod_mnist_elastic.py:80-82`: lr/√N rescale)."""
         old = self.world_size
         self.rollback()
+        self.world_size = new_world_size
+        for cb in self._reset_callbacks:
+            cb(self, old, new_world_size)
+
+    def apply_world(self, new_world_size: int) -> None:
+        """Adopt a world size WITHOUT rolling back, firing reset callbacks
+        if it differs.  The rendezvous-exit hook: a restarted gang that
+        restored a durable commit taken at a different world (or a gang
+        that lost ANOTHER member while re-rendezvousing) must still
+        rescale its world-dependent hyperparameters."""
+        if new_world_size == self.world_size:
+            return
+        old = self.world_size
         self.world_size = new_world_size
         for cb in self._reset_callbacks:
             cb(self, old, new_world_size)

@@ -116,6 +116,7 @@ def run_elastic_worker(
     # soft assembly target for round 0: the launcher-declared gang size
     min_world = int(os.environ.get("TPUDIST_NUM_PROCESSES", "1"))
     rounds = 0
+    first_round = True
     try:
         while True:
             try:
@@ -152,13 +153,27 @@ def run_elastic_worker(
                 # broadcast must trigger re-rendezvous, not a crash.
                 synced = coll.broadcast(
                     {"state": tree_to_numpy(state.state),
-                     "host": np.asarray([state.host.epoch, state.host.batch])},
+                     "host": np.asarray([state.host.epoch, state.host.batch,
+                                         state.world_size])},
                     root=0)
                 state.state = jax.tree.map(
                     host_to_leaf, state.state, synced["state"])
                 state.host.epoch = int(synced["host"][0])
                 state.host.batch = int(synced["host"][1])
-                state.world_size = world
+                if first_round and state.restored_step is None:
+                    # initial formation of a fresh state: its base
+                    # hyperparameters are DEFINED for this world — no
+                    # rescale (the constructor's world_size default is a
+                    # placeholder, not a formed world)
+                    state.world_size = world
+                else:
+                    # rank 0's recorded world is the uniform "old" for
+                    # the rescale (a restored durable commit may carry a
+                    # world the restarted gang no longer has; a second
+                    # death during re-rendezvous shifts it again)
+                    state.world_size = int(synced["host"][2])
+                    state.apply_world(world)  # fires reset callbacks if !=
+                first_round = False
                 state.commit()  # the agreed state is the rollback point
                 log.info("round %d: rank %d of %d (%s)", round_id, rank,
                          world, ",".join(members))

@@ -10,7 +10,7 @@ dynamic shapes.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +20,30 @@ from tpudist.models.transformer import TransformerConfig, TransformerLM
 
 # (logits [B, V], key) -> next token [B] int32
 SelectFn = Callable[[jnp.ndarray, jax.Array], jnp.ndarray]
+
+
+def _stop_array(stop_tokens: Sequence[int] | None) -> jnp.ndarray | None:
+    if stop_tokens is None:
+        return None
+    toks = tuple(int(t) for t in stop_tokens)
+    if not toks:
+        raise ValueError("stop_tokens must be non-empty when given")
+    return jnp.asarray(toks, jnp.int32)
+
+
+def _is_stop(tokens: jnp.ndarray, stop_arr: jnp.ndarray) -> jnp.ndarray:
+    """Membership mask against the stop set, over the last axis appended:
+    ``[...]`` int tokens -> ``[...]`` bool."""
+    return jnp.any(tokens[..., None] == stop_arr, axis=-1)
+
+
+def sequence_lengths(generated: jnp.ndarray, stop_arr: jnp.ndarray,
+                     prompt_len: int) -> jnp.ndarray:
+    """Per-sequence total lengths: prompt + generated up to and INCLUDING
+    the first stop token (or all of ``generated`` if none fired)."""
+    hit = _is_stop(generated, stop_arr)
+    strictly_after = jnp.cumsum(hit, axis=1) - hit  # stops before position
+    return prompt_len + jnp.sum(strictly_after == 0, axis=1)
 
 
 def _rollout(
@@ -32,11 +56,20 @@ def _rollout(
     decode_attention: str = "dense",
     cache_constraint=None,
     prefill_chunk: int | None = None,
-) -> jnp.ndarray:
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Shared KV-cached decode loop; ``select`` picks the next token from
     each step's last-position logits (argmax for greedy, a sampler
     otherwise).  ``cache_constraint`` (leaf -> sharding or None) pins the
     cache layout for sharded decoding (:func:`tp_generate`).
+
+    ``stop_tokens`` enables EOS semantics under static shapes: once a
+    sequence emits a stop token, every later emitted position is frozen to
+    ``pad_token`` (the model still runs — SIMD lanes can't retire early in
+    a ``lax.scan`` — but its selections are masked, so the output is
+    deterministic past EOS).  The return becomes ``(tokens, lengths)``
+    with ``lengths[b]`` = prompt + generated up to and including the stop.
 
     ``prefill_chunk`` bounds prefill memory: the prompt is ingested in
     chunks of that many tokens (each attending causally over everything
@@ -45,6 +78,7 @@ def _rollout(
     keeps long-context prefill feasible off the flash path (e.g. under
     GSPMD sharding, where the Pallas kernel cannot partition)."""
     b, prompt_len = prompt.shape
+    stop_arr = _stop_array(stop_tokens)  # validate before any device work
     if prompt_len < 1:
         raise ValueError("prompt must hold at least one token")
     total = prompt_len + max_new_tokens
@@ -82,11 +116,13 @@ def _rollout(
         )
         cache = mutated["cache"]
     first = select(logits[:, -1], keys[0]).astype(jnp.int32)
+    done0 = (_is_stop(first, stop_arr) if stop_arr is not None
+             else jnp.zeros((b,), bool))
 
     # ... then DECODE one token a step.
     def step(carry, inputs):
         t, step_key = inputs
-        cache, prev = carry
+        cache, prev, done = carry
         logits, mutated = model.apply(
             {"params": params, "cache": cache},
             prev[:, None],
@@ -94,18 +130,24 @@ def _rollout(
             mutable=["cache"],
         )
         nxt = select(logits[:, -1], step_key).astype(jnp.int32)
-        return (mutated["cache"], nxt), prev
+        if stop_arr is not None:
+            nxt = jnp.where(done, jnp.int32(pad_token), nxt)
+            done = done | _is_stop(nxt, stop_arr)
+        return (mutated["cache"], nxt, done), prev
 
     if max_new_tokens > 1:
         # emits the token it consumes, so `toks` is [g0 .. g_{n-2}] and the
         # final carry holds g_{n-1}
-        (_, last), toks = lax.scan(
-            step, (cache, first),
+        (_, last, _), toks = lax.scan(
+            step, (cache, first, done0),
             (jnp.arange(1, max_new_tokens), keys[1:]))
         generated = jnp.concatenate([toks.T, last[:, None]], axis=1)
     else:
         generated = first[:, None]
-    return jnp.concatenate([prompt, generated], axis=1)
+    out = jnp.concatenate([prompt, generated], axis=1)
+    if stop_arr is None:
+        return out
+    return out, sequence_lengths(generated, stop_arr, prompt_len)
 
 
 def greedy_generate(
@@ -115,7 +157,9 @@ def greedy_generate(
     max_new_tokens: int,
     decode_attention: str = "dense",
     prefill_chunk: int | None = None,
-) -> jnp.ndarray:
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Greedy-decode ``max_new_tokens`` past ``prompt``.
 
     Args:
@@ -124,17 +168,22 @@ def greedy_generate(
         implementation — the cache path recomputes attention itself).
       prompt: ``[batch, prompt_len]`` int32 tokens, ``prompt_len >= 1``.
       max_new_tokens: tokens to append.
+      stop_tokens: optional EOS set; positions past a sequence's first
+        stop token freeze to ``pad_token`` and per-sequence lengths are
+        returned alongside the tokens.
 
     Returns:
       ``[batch, prompt_len + max_new_tokens]`` int32: prompt + greedy
-      continuation.  ``prompt_len + max_new_tokens`` must fit in
+      continuation (plus ``[batch]`` lengths when ``stop_tokens`` is
+      given).  ``prompt_len + max_new_tokens`` must fit in
       ``cfg.max_seq_len``.
     """
     return _rollout(
         cfg, params, prompt, max_new_tokens,
         lambda logits, _key: jnp.argmax(logits, axis=-1),
         jax.random.key(0), decode_attention=decode_attention,
-        prefill_chunk=prefill_chunk)
+        prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+        pad_token=pad_token)
 
 
 def tp_generate(
@@ -151,7 +200,9 @@ def tp_generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
-) -> jnp.ndarray:
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Tensor-parallel decode (greedy by default; ``temperature``/``top_k``
     / ``top_p`` + ``key`` select sampling): Megatron-layout params sharded over
     ``axis`` and the KV cache sharded over its HEADS dimension, so both
@@ -199,7 +250,8 @@ def tp_generate(
             key if key is not None else jax.random.key(0),
             decode_attention=decode_attention,
             cache_constraint=cache_constraint,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+            pad_token=pad_token)
 
     with mesh:
         return jax.jit(run, static_argnums=())(sharded, prompt)
@@ -217,7 +269,9 @@ def sp_generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
-) -> jnp.ndarray:
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Sequence-sharded-cache decode (greedy by default; the sampling
     controls mirror :func:`sample_generate`): the KV cache's SEQUENCE
     dimension is sharded over ``axis``, so per-chip cache memory is 1/n —
@@ -249,7 +303,8 @@ def sp_generate(
             cfg, params, prompt, max_new_tokens, select,
             key if key is not None else jax.random.key(0),
             cache_constraint=cache_constraint,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+            pad_token=pad_token)
 
     with mesh:
         return jax.jit(run)(params, prompt)
@@ -294,19 +349,24 @@ def sample_generate(
     top_p: Optional[float] = None,
     decode_attention: str = "dense",
     prefill_chunk: int | None = None,
-) -> jnp.ndarray:
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Sample ``max_new_tokens`` past ``prompt`` with the standard
     controls, all static-shape (one compiled rollout, like greedy):
 
     * ``temperature`` scales logits (0 → greedy argmax);
     * ``top_k`` keeps only the k highest-probability tokens;
     * ``top_p`` keeps the smallest nucleus whose cumulative probability
-      reaches p (applied after top_k when both are set).
+      reaches p (applied after top_k when both are set);
+    * ``stop_tokens`` freezes a sequence at its first stop token (see
+      :func:`greedy_generate`); returns ``(tokens, lengths)`` when set.
     """
     select = _make_select(temperature, top_k, top_p)
     return _rollout(cfg, params, prompt, max_new_tokens, select, key,
                     decode_attention=decode_attention,
-                    prefill_chunk=prefill_chunk)
+                    prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+                    pad_token=pad_token)
 
 
 def _make_select(temperature: float, top_k: Optional[int],

@@ -34,11 +34,25 @@ STAGE_WIDTHS = (64, 128, 256, 512)
 
 def _norm(norm: str, dtype: Any) -> Callable[..., nn.Module]:
     if norm == "group":
+        # custom-VJP GroupNorm: autodiff of the flax two-pass stats emits
+        # badly-fused backward HLO (~6 ms/step of ResNet50 at batch 128 —
+        # measured in scripts/resnet_mfu_sweep.py); the closed-form
+        # gradient is two group reductions + elementwise
+        from tpudist.ops.group_norm import GroupNorm
+
+        return lambda: GroupNorm(num_groups=32, dtype=dtype, param_dtype=jnp.float32)
+    if norm == "group_flax":  # the autodiff baseline, kept for comparison
         return lambda: nn.GroupNorm(num_groups=32, dtype=dtype, param_dtype=jnp.float32)
     if norm == "batch":
         return lambda: nn.BatchNorm(
             use_running_average=False, momentum=0.9, dtype=dtype, axis_name="data"
         )
+    if norm == "batch_local":  # per-replica statistics (single-chip runs)
+        return lambda: nn.BatchNorm(
+            use_running_average=False, momentum=0.9, dtype=dtype
+        )
+    if norm == "none":  # ablation/benchmark control: no normalization
+        return lambda: (lambda x: x)
     raise ValueError(f"unknown norm {norm!r}")
 
 

@@ -253,6 +253,13 @@ class Rendezvous:
         start = time.monotonic()
         deadline = start + timeout_s
         grace_end = start + min(min_world_grace_s, timeout_s / 2)
+        # second, longer grace for the defer-on-live-non-members rule
+        # below: enough for a training gang to reach its next commit
+        # point, but BOUNDED so one live-but-hung worker (heartbeat
+        # thread alive, main thread wedged) cannot stall rendezvous to
+        # the full timeout forever
+        live_grace_end = start + min(3 * min_world_grace_s,
+                                     0.75 * timeout_s)
         prefix = f"{self.ns}/{round}/member/"
         stable_since: float | None = None
         prev: frozenset[str] = frozenset()
@@ -279,8 +286,9 @@ class Rendezvous:
                 registered = registered | {worker_id}
             members = registered & live
             now = time.monotonic()
-            if len(members) < min_world and (now < grace_end
-                                             or live - members):
+            if len(members) < min_world and (
+                    now < grace_end
+                    or (live - members and now < live_grace_end)):
                 # Defer sub-target formation while workers are ALIVE but
                 # not yet registered here: their heartbeats force the
                 # incumbents' next check() to raise WorldChanged, so they
@@ -288,7 +296,10 @@ class Rendezvous:
                 # this, a laggard whose grace expires before the gang's
                 # next commit point forms a splinter world of one.  A
                 # worker that died pre-registration is not in live(), so
-                # the documented liveness-over-target rule still holds.
+                # the liveness-over-target rule still holds — and the
+                # defer itself expires at live_grace_end, so a hung-but-
+                # heartbeating worker can only delay formation, not
+                # starve it into the max_rounds crash.
                 prev, stable_since = members, now
                 time.sleep(0.05)
                 continue
