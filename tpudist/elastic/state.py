@@ -78,21 +78,25 @@ class ElasticState:
                 if "world_size" in meta:
                     self.world_size = int(meta["world_size"])
                 self.restored_step = step
-        self.commit()  # initial state is always restorable
+        # initial state is always restorable; when it was JUST restored,
+        # skip the durable re-write (identical content — re-saving on
+        # every restart would churn the keep window and pay a full
+        # serialize for zero new durability)
+        self.commit(durable=self.restored_step is None)
 
     def register_reset_callbacks(self, callbacks: Sequence[ResetCallback]) -> None:
         """`state.register_reset_callbacks([on_state_reset])` parity
         (`horovod_mnist_elastic.py:105`)."""
         self._reset_callbacks.extend(callbacks)
 
-    def commit(self) -> None:
+    def commit(self, durable: bool = True) -> None:
         """Consistency point: snapshot device state to host memory; also a
         durable checkpoint when a checkpointer is attached (strictly stronger
         than the reference's memory-only commit)."""
         self._committed_state = tree_to_numpy(self.state)
         self._committed_host = dataclasses.replace(self.host)
         self.commits += 1
-        if self.checkpointer is not None:
+        if durable and self.checkpointer is not None:
             self.checkpointer.save(
                 int(jax.device_get(self.state.step)) if hasattr(self.state, "step")
                 else self.commits,

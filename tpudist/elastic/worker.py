@@ -151,26 +151,30 @@ def run_elastic_worker(
                 # runs INSIDE the WorldChanged/PeerLost handler: the full
                 # model state is transferred here, so a peer dying mid-
                 # broadcast must trigger re-rendezvous, not a crash.
+                fresh = first_round and state.restored_step is None
                 synced = coll.broadcast(
                     {"state": tree_to_numpy(state.state),
                      "host": np.asarray([state.host.epoch, state.host.batch,
-                                         state.world_size])},
+                                         state.world_size, int(fresh)])},
                     root=0)
                 state.state = jax.tree.map(
                     host_to_leaf, state.state, synced["state"])
                 state.host.epoch = int(synced["host"][0])
                 state.host.batch = int(synced["host"][1])
-                if first_round and state.restored_step is None:
-                    # initial formation of a fresh state: its base
-                    # hyperparameters are DEFINED for this world — no
-                    # rescale (the constructor's world_size default is a
-                    # placeholder, not a formed world)
+                # The rescale decision is keyed on RANK 0's flags, not the
+                # local ones: everyone just adopted rank 0's state (incl.
+                # the lr inside opt_state), so a rank-local decision would
+                # let ranks with asymmetric checkpoint availability apply
+                # different rescales to the identical synced state.
+                if int(synced["host"][3]):
+                    # root broadcast a fresh state's initial formation:
+                    # its base hyperparameters are DEFINED for this world
                     state.world_size = world
                 else:
-                    # rank 0's recorded world is the uniform "old" for
-                    # the rescale (a restored durable commit may carry a
-                    # world the restarted gang no longer has; a second
-                    # death during re-rendezvous shifts it again)
+                    # root's recorded world is the uniform "old" for the
+                    # rescale (a restored durable commit may carry a world
+                    # the restarted gang no longer has; a second death
+                    # during re-rendezvous shifts it again)
                     state.world_size = int(synced["host"][2])
                     state.apply_world(world)  # fires reset callbacks if !=
                 first_round = False

@@ -44,10 +44,18 @@ def _norm(norm: str, dtype: Any) -> Callable[..., nn.Module]:
     if norm == "group_flax":  # the autodiff baseline, kept for comparison
         return lambda: nn.GroupNorm(num_groups=32, dtype=dtype, param_dtype=jnp.float32)
     if norm == "batch":
-        return lambda: nn.BatchNorm(
+        from tpudist.ops.batch_norm import BatchNorm
+
+        return lambda: BatchNorm(
             use_running_average=False, momentum=0.9, dtype=dtype, axis_name="data"
         )
-    if norm == "batch_local":  # per-replica statistics (single-chip runs)
+    if norm == "batch_local":  # per-replica statistics, closed-form VJP
+        from tpudist.ops.batch_norm import BatchNorm
+
+        return lambda: BatchNorm(
+            use_running_average=False, momentum=0.9, dtype=dtype
+        )
+    if norm == "batch_flax":  # the autodiff baseline, kept for comparison
         return lambda: nn.BatchNorm(
             use_running_average=False, momentum=0.9, dtype=dtype
         )
@@ -58,7 +66,15 @@ def _norm(norm: str, dtype: Any) -> Callable[..., nn.Module]:
 
 class Bottleneck(nn.Module):
     """1x1 reduce → 3x3 → 1x1 expand (×4), with projection shortcut when
-    shape changes (`model_parallel_ResNet50.py:64-76` equivalent)."""
+    shape changes (`model_parallel_ResNet50.py:64-76` equivalent).
+
+    Norm placement is the standard post-norm bottleneck; ``norm="group"``
+    routes through the closed-form custom-VJP GroupNorm
+    (:mod:`tpudist.ops.group_norm`).  NOTE (measured, round 3): the
+    slab-resident Pallas GN kernels were tried here and made training
+    2.3× SLOWER — XLA fuses the forward GN into the conv epilogues for
+    free, and the kernel boundary destroyed that fusion (9.5 ms fwd vs
+    1.24 ms).  Keep norms as XLA-fusible jnp ops in this model."""
 
     features: int
     strides: int = 1
@@ -68,19 +84,29 @@ class Bottleneck(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         mk_norm = _norm(self.norm, self.compute_dtype)
-        residual = x
+
+        def norm_relu(y):
+            return nn.relu(mk_norm()(y))
+
+        out_c = self.features * 4
+        needs_proj = x.shape[-1] != out_c or self.strides != 1
         y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.compute_dtype)(x)
-        y = nn.relu(mk_norm()(y))
+        y = norm_relu(y)
         y = nn.Conv(
             self.features, (3, 3), strides=(self.strides, self.strides),
             padding="SAME", use_bias=False, dtype=self.compute_dtype,
         )(y)
-        y = nn.relu(mk_norm()(y))
-        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, dtype=self.compute_dtype)(y)
+        y = norm_relu(y)
+        y = nn.Conv(out_c, (1, 1), use_bias=False, dtype=self.compute_dtype)(y)
+        # norm instantiation order (y-branch norm BEFORE the projection
+        # norm) is load-bearing: flax auto-names follow call order, and
+        # swapping them would silently cross-load same-shaped checkpoint
+        # leaves between the two norms
         y = mk_norm()(y)
-        if residual.shape != y.shape:
+        residual = x
+        if needs_proj:
             residual = nn.Conv(
-                self.features * 4, (1, 1), strides=(self.strides, self.strides),
+                out_c, (1, 1), strides=(self.strides, self.strides),
                 use_bias=False, dtype=self.compute_dtype,
             )(residual)
             residual = mk_norm()(residual)

@@ -25,7 +25,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -95,6 +95,20 @@ def sdpa(
     return _masked_attend(q, k, v, mask)
 
 
+def _head_sharded(decode_shard, fn, q, k, v, scalar):
+    """Run ``fn(q, k, v, scalar)`` per shard over the HEAD dim of q/k/v
+    (``scalar`` replicated) — the shard_map island that lets Pallas
+    attention kernels compose with a GSPMD rollout (GSPMD cannot
+    partition a pallas_call; heads are embarrassingly parallel)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh, ax = decode_shard
+    spec = P(None, None, ax, None)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec, P()),
+        out_specs=spec, check_vma=False)(q, k, v, scalar)
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 256
@@ -132,6 +146,13 @@ class CausalSelfAttention(nn.Module):
     # Pallas flash-decode kernel (tpudist.ops.flash_decode) — same numerics,
     # one cache read per KV head, the long-context serving path.
     decode_attention: str = "dense"
+    # (mesh, axis): run the flash decode/prefill kernels PER SHARD over the
+    # cache's head dimension via shard_map — GSPMD cannot partition a
+    # Pallas call, but heads are embarrassingly parallel (each shard owns
+    # whole KV-head groups), so a manual island inside the otherwise-GSPMD
+    # program composes TP serving with the kernels (the decode-side twin of
+    # ring_attention's shard_map + per-shard kernel pattern).
+    decode_shard: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
@@ -210,6 +231,12 @@ class CausalSelfAttention(nn.Module):
         if self.decode_attention == "flash":
             from tpudist.ops.flash_decode import flash_decode
 
+            if self.decode_shard is not None:
+                return _head_sharded(
+                    self.decode_shard,
+                    lambda qs, ks, vs, n: flash_decode(
+                        qs, ks, vs, n, window=cfg.attention_window),
+                    q, k_all, v_all, idx + 1)
             return flash_decode(q, k_all, v_all, idx + 1,
                                 window=cfg.attention_window)
         mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
@@ -245,10 +272,20 @@ class CausalSelfAttention(nn.Module):
                     f"decode_attention='flash' needs a power-of-two factor "
                     f">= 8 in max_seq_len (got {cfg.max_seq_len}); round "
                     f"max_seq_len up to a multiple of 8")
+            interp = jax.default_backend() == "cpu"
+            bq = _auto_block(s_pad)
+            if self.decode_shard is not None:
+                def local(qs, ks, vs, off):
+                    out, _ = _flash_forward(
+                        qs, ks, vs, True, bq, block_k, interp,
+                        q_offset=off, window=cfg.attention_window)
+                    return out
+
+                out = _head_sharded(self.decode_shard, local,
+                                    q_in, k_all, v_all, idx)
+                return out[:, :s]
             out, _ = _flash_forward(
-                q_in, k_all, v_all, True,
-                _auto_block(s_pad), block_k,
-                jax.default_backend() == "cpu",
+                q_in, k_all, v_all, True, bq, block_k, interp,
                 q_offset=idx, window=cfg.attention_window)
             return out[:, :s]
         q_pos = idx + jnp.arange(s)[:, None]                  # [s, 1]
@@ -278,6 +315,7 @@ class DecoderBlock(nn.Module):
     attention_fn: AttentionFn = sdpa
     decode: bool = False
     decode_attention: str = "dense"
+    decode_shard: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, causal: bool = True) -> jnp.ndarray:
@@ -287,6 +325,7 @@ class DecoderBlock(nn.Module):
         x = x + CausalSelfAttention(self.cfg, self.attention_fn,
                                     decode=self.decode,
                                     decode_attention=self.decode_attention,
+                                    decode_shard=self.decode_shard,
                                     name="attn")(h, causal=causal)
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
         return x + MLPBlock(self.cfg, name="mlp")(h)
@@ -305,6 +344,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
     remat: bool = False
     decode_attention: str = "dense"
+    decode_shard: Any = None
 
     @nn.compact
     def __call__(
@@ -331,6 +371,7 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(cfg, self.attention_fn, decode=self.decode,
                           decode_attention=self.decode_attention,
+                          decode_shard=self.decode_shard,
                           name=f"block{i}")(x, causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,

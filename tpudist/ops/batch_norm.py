@@ -1,0 +1,129 @@
+"""BatchNorm with a closed-form custom VJP — the same backward fix as
+:mod:`tpudist.ops.group_norm`, for the reference's own normalization.
+
+The reference's ResNet50 uses BatchNorm (torchvision Bottleneck,
+`rpc/model_parallel_ResNet50.py:43-139`).  Measured on the v5e
+(`scripts/resnet_mfu_sweep.py`): flax ``nn.BatchNorm`` trains ResNet50 at
+11.2 ms/step vs 6.75 no-norm — like GroupNorm, nearly all of the cost is
+autodiff's backward of the stats computation.  The closed-form gradient
+
+    x̂  = (x - μ_c) · rstd_c          (per-channel stats over B, H, W)
+    g   = dy · γ
+    dx  = rstd · (g - mean_c(g) - x̂ · mean_c(g · x̂))
+    dγ  = Σ dy · x̂                    dβ = Σ dy
+
+is two per-channel reductions + elementwise — XLA-fusible passes.
+
+``BatchNorm`` here is parameter- AND collection-compatible with
+``flax.linen.BatchNorm`` (params ``scale``/``bias``, batch_stats
+``mean``/``var``, same auto-name prefix), so models and checkpoints swap
+freely.  Running statistics update with the standard momentum rule and are
+treated as non-differentiable exports (stop-gradient semantics), exactly
+like flax's.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def batch_norm_train(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+                     eps: float = 1e-5):
+    """Training-mode batch norm over NHWC (stats per channel, f32).
+
+    Returns ``(y, mean, var)``; ``mean``/``var`` are NON-differentiable
+    exports for the running-average update (their cotangents are ignored).
+    """
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 2))
+    var = jnp.mean(jnp.square(x32), axis=(0, 1, 2)) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = ((x32 - mean) * rstd * scale.astype(jnp.float32)
+         + bias.astype(jnp.float32)).astype(x.dtype)
+    return y, mean, var
+
+
+def _bn_fwd(x, scale, bias, eps):
+    y, mean, var = batch_norm_train(x, scale, bias, eps)
+    rstd = jax.lax.rsqrt(var + eps)
+    return (y, mean, var), (x, scale, mean, rstd)
+
+
+def _bn_bwd(eps, res, cts):
+    dy, _dmean, _dvar = cts  # stats are non-differentiable exports
+    x, scale, mean, rstd = res
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean) * rstd
+    g = dy32 * scale.astype(jnp.float32)
+    m1 = jnp.sum(g, axis=(0, 1, 2)) / n
+    m2 = jnp.sum(g * xhat, axis=(0, 1, 2)) / n
+    dx = (rstd * (g - m1 - xhat * m2)).astype(x.dtype)
+    dscale = jnp.sum(dy32 * xhat, axis=(0, 1, 2)).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=(0, 1, 2)).astype(scale.dtype)
+    return dx, dscale, dbias
+
+
+batch_norm_train.defvjp(_bn_fwd, _bn_bwd)
+
+
+class BatchNorm(nn.Module):
+    """``flax.linen.BatchNorm`` twin backed by :func:`batch_norm_train`
+    (same params, same ``batch_stats`` collection, same auto-name)."""
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: jnp.dtype | None = None
+    param_dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if self.use_running_average:
+            rstd = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            y = ((x.astype(jnp.float32) - ra_mean.value) * rstd
+                 * scale.astype(jnp.float32)
+                 + bias.astype(jnp.float32)).astype(x.dtype)
+        elif self.axis_name is not None:
+            # cross-replica statistics (the DDP SyncBatchNorm story) stay
+            # on plain autodiff: the custom VJP treats exported stats as
+            # constants, which would silently freeze the statistics'
+            # gradient contribution through the pmean
+            x32 = x.astype(jnp.float32)
+            mean = jax.lax.pmean(
+                jnp.mean(x32, axis=(0, 1, 2)), self.axis_name)
+            var = jax.lax.pmean(
+                jnp.mean(jnp.square(x32), axis=(0, 1, 2)),
+                self.axis_name) - jnp.square(mean)
+            rstd = jax.lax.rsqrt(var + self.epsilon)
+            y = ((x32 - mean) * rstd * scale.astype(jnp.float32)
+                 + bias.astype(jnp.float32)).astype(x.dtype)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = (m * ra_mean.value
+                                 + (1 - m) * jax.lax.stop_gradient(mean))
+                ra_var.value = (m * ra_var.value
+                                + (1 - m) * jax.lax.stop_gradient(var))
+        else:
+            y, mean, var = batch_norm_train(x, scale, bias, self.epsilon)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * jax.lax.stop_gradient(mean)
+                ra_var.value = m * ra_var.value + (1 - m) * jax.lax.stop_gradient(var)
+        return y.astype(self.dtype) if self.dtype is not None else y

@@ -99,28 +99,291 @@ def _gn_bwd(groups, eps, res, dy):
 group_norm.defvjp(_gn_fwd, _gn_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Pallas fused path: GroupNorm (+ReLU, +residual-add) in ONE slab-resident
+# pass per direction.  MEASURED NEGATIVE RESULT (round 3,
+# scripts/resnet_mfu_sweep.py): inside ResNet50 these kernels LOSE to the
+# XLA closed-form path — 30.1 ms/step vs 12.9 (fwd 9.5 ms vs 1.24) —
+# because XLA fuses the forward norm into conv epilogues at ~zero cost and
+# the pallas_call boundary forces the very materialization passes the
+# kernel was meant to remove.  They are kept (tested, numerically exact)
+# as standalone ops for norm-dominated elementwise stacks where no
+# producer fusion exists, and as the documented experiment record; the
+# ResNet models deliberately do NOT use them.
+# ---------------------------------------------------------------------------
+
+
+def _group_matrix(c: int, groups: int) -> jnp.ndarray:
+    """One-hot [C, G] channel→group map.  Group reductions and expansions
+    become tiny matmuls (``[1,C] @ M`` / ``[1,G] @ Mᵀ``) — Mosaic lowers
+    these cleanly, whereas a ``[HW, G, C/G]`` reshape (tiny lane dim) does
+    not."""
+    ci = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
+    gi = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
+    return (ci // (c // groups) == gi).astype(jnp.float32)
+
+
+def _fused_fwd_kernel(x_ref, scale_ref, bias_ref, *rest, groups: int,
+                      eps: float, mode: str):
+    if mode == "add_relu":
+        res_ref, y_ref, mean_ref, rstd_ref = rest
+    else:
+        y_ref, mean_ref, rstd_ref = rest
+    x = x_ref[0].astype(jnp.float32)                       # [HW, C]
+    hw, c = x.shape
+    n = hw * (c // groups)
+    m = _group_matrix(c, groups)
+    mean_g = (jnp.sum(x, 0, keepdims=True) @ m) / n        # [1, G]
+    sumsq_g = jnp.sum(x * x, 0, keepdims=True) @ m
+    var_g = sumsq_g / n - mean_g * mean_g
+    rstd_g = jax.lax.rsqrt(var_g + eps)
+    mean_c = mean_g @ m.T                                  # [1, C]
+    rstd_c = rstd_g @ m.T
+    y = ((x - mean_c) * rstd_c * scale_ref[...].astype(jnp.float32)
+         + bias_ref[...].astype(jnp.float32))
+    if mode == "add_relu":
+        y = y + res_ref[0].astype(jnp.float32)
+    if mode in ("relu", "add_relu"):
+        y = jnp.maximum(y, 0.0)
+    y_ref[0] = y.astype(y_ref.dtype)
+    mean_ref[0] = mean_g
+    rstd_ref[0] = rstd_g
+
+
+def _fused_bwd_kernel(x_ref, dy_ref, scale_ref, bias_ref, mean_ref,
+                      rstd_ref, *rest, groups: int, mode: str):
+    if mode == "add_relu":
+        res_ref, dx_ref, dscale_ref, dbias_ref, dres_ref = rest
+    else:
+        dx_ref, dscale_ref, dbias_ref = rest
+    x = x_ref[0].astype(jnp.float32)                       # [HW, C]
+    dy = dy_ref[0].astype(jnp.float32)
+    hw, c = x.shape
+    n = hw * (c // groups)
+    m = _group_matrix(c, groups)
+    mean_c = mean_ref[0] @ m.T                             # [1, C]
+    rstd_c = rstd_ref[0] @ m.T
+    scale = scale_ref[...].astype(jnp.float32)             # [1, C]
+    xhat = (x - mean_c) * rstd_c
+    if mode in ("relu", "add_relu"):
+        pre = xhat * scale + bias_ref[...].astype(jnp.float32)
+        if mode == "add_relu":
+            pre = pre + res_ref[0].astype(jnp.float32)
+        dy = jnp.where(pre > 0.0, dy, 0.0)
+    if mode == "add_relu":
+        dres_ref[0] = dy.astype(dres_ref.dtype)
+    g = dy * scale
+    m1_c = ((jnp.sum(g, 0, keepdims=True) @ m) / n) @ m.T
+    m2_c = ((jnp.sum(g * xhat, 0, keepdims=True) @ m) / n) @ m.T
+    dx = rstd_c * (g - m1_c - xhat * m2_c)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+    dscale_ref[0] = jnp.sum(dy * xhat, 0, keepdims=True)   # [1, C] partial
+    dbias_ref[0] = jnp.sum(dy, 0, keepdims=True)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _fused_call_fwd(x, scale, bias, residual, groups, eps, mode):
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = x.shape
+    hw = h * w
+    x3 = x.reshape(b, hw, c)
+    args = [x3, scale.reshape(1, c), bias.reshape(1, c)]
+    in_specs = [
+        pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, c), lambda i: (0, 0)),
+        pl.BlockSpec((1, c), lambda i: (0, 0)),
+    ]
+    if mode == "add_relu":
+        args.append(residual.reshape(b, hw, c))
+        in_specs.append(pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)))
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fused_fwd_kernel, groups=groups, eps=eps,
+                          mode=mode),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, groups), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return y.reshape(b, h, w, c), mean, rstd
+
+
+def _fused_call_bwd(x, dy, scale, bias, mean, rstd, residual, groups, mode):
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = x.shape
+    hw = h * w
+    args = [x.reshape(b, hw, c), dy.reshape(b, hw, c),
+            scale.reshape(1, c), bias.reshape(1, c), mean, rstd]
+    in_specs = [
+        pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, c), lambda i: (0, 0)),
+        pl.BlockSpec((1, c), lambda i: (0, 0)),
+        pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+        jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+        jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+    ]
+    if mode == "add_relu":
+        args.append(residual.reshape(b, hw, c))
+        in_specs.append(pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)))
+        out_specs.append(pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, hw, c), residual.dtype))
+    outs = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, groups=groups, mode=mode),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(*args)
+    dx = outs[0].reshape(b, h, w, c)
+    dscale = jnp.sum(outs[1][:, 0], axis=0).astype(scale.dtype)  # -> [C]
+    dbias = jnp.sum(outs[2][:, 0], axis=0).astype(bias.dtype)
+    dres = outs[3].reshape(b, h, w, c) if mode == "add_relu" else None
+    return dx, dscale, dbias, dres
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_norm_act(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+                   groups: int = 32, eps: float = 1e-6,
+                   mode: str = "relu") -> jnp.ndarray:
+    """Fused ``act(group_norm(x))`` (``mode`` in {"plain", "relu"}) as one
+    Pallas kernel per direction — minimal HBM traffic (docstring above)."""
+    y, _, _ = _fused_call_fwd(x, scale, bias, None, groups, eps, mode)
+    return y
+
+
+def _gna_fwd(x, scale, bias, groups, eps, mode):
+    y, mean, rstd = _fused_call_fwd(x, scale, bias, None, groups, eps, mode)
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _gna_bwd(groups, eps, mode, res, dy):
+    x, scale, bias, mean, rstd = res
+    dx, dscale, dbias, _ = _fused_call_bwd(
+        x, dy, scale, bias, mean, rstd, None, groups, mode)
+    return dx, dscale, dbias
+
+
+group_norm_act.defvjp(_gna_fwd, _gna_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def group_norm_add_relu(x: jnp.ndarray, scale: jnp.ndarray,
+                        bias: jnp.ndarray, residual: jnp.ndarray,
+                        groups: int = 32, eps: float = 1e-6) -> jnp.ndarray:
+    """Fused ``relu(group_norm(x) + residual)`` — the Bottleneck tail
+    (`model_parallel_ResNet50.py:64-76`'s out += identity; relu) in one
+    slab-resident kernel per direction."""
+    y, _, _ = _fused_call_fwd(x, scale, bias, residual, groups, eps,
+                              "add_relu")
+    return y
+
+
+def _gnar_fwd(x, scale, bias, residual, groups, eps):
+    y, mean, rstd = _fused_call_fwd(x, scale, bias, residual, groups, eps,
+                                    "add_relu")
+    return y, (x, scale, bias, mean, rstd, residual)
+
+
+def _gnar_bwd(groups, eps, res, dy):
+    x, scale, bias, mean, rstd, residual = res
+    dx, dscale, dbias, dres = _fused_call_bwd(
+        x, dy, scale, bias, mean, rstd, residual, groups, "add_relu")
+    return dx, dscale, dbias, dres
+
+
+group_norm_add_relu.defvjp(_gnar_fwd, _gnar_bwd)
+
+
+# Above this per-sample-slab f32 size the fused kernels would overflow
+# VMEM (the backward holds ~6 slab-sized intermediates); fall back to the
+# XLA closed-form path.  Every ResNet50 site at 128 px is <= 1 MB.
+_FUSED_SLAB_LIMIT_BYTES = 2 * 1024 * 1024
+
+
+def _slab_fits(x: jnp.ndarray) -> bool:
+    b, h, w, c = x.shape
+    return h * w * c * 4 <= _FUSED_SLAB_LIMIT_BYTES
+
+
 class GroupNorm(nn.Module):
-    """Drop-in ``nn.GroupNorm`` twin backed by :func:`group_norm` — same
-    param names/shapes (``scale``/``bias`` of [C]) AND the same flax
-    auto-name prefix (``GroupNorm_N``), so whole-model param trees are
-    interchangeable with flax-normed ones."""
+    """Drop-in ``nn.GroupNorm`` twin — same param names/shapes
+    (``scale``/``bias`` of [C]) AND the same flax auto-name prefix
+    (``GroupNorm_N``), so whole-model param trees are interchangeable with
+    flax-normed ones.
+
+    ``fused``:
+      * ``"none"`` — :func:`group_norm` (closed-form VJP, XLA-fused);
+      * ``"relu"`` — ``relu(gn(x))`` through the slab-resident Pallas
+        kernel pair;
+      * ``"add_relu"`` — ``relu(gn(x) + residual)`` (pass ``residual``),
+        the ResNet Bottleneck tail.
+    Oversized slabs (> ~2 MB f32 per sample) silently use the unfused
+    closed-form math — identical numerics, just without the traffic win.
+    """
 
     num_groups: int = 32
     epsilon: float = 1e-6
     dtype: jnp.dtype | None = None
     param_dtype: jnp.dtype = jnp.float32
+    fused: str = "none"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 residual: jnp.ndarray | None = None) -> jnp.ndarray:
         c = x.shape[-1]
         if c % self.num_groups:
             raise ValueError(
                 f"channels {c} not divisible by num_groups {self.num_groups}")
+        if (residual is not None) != (self.fused == "add_relu"):
+            raise ValueError(
+                f"residual must be passed exactly when fused='add_relu' "
+                f"(got fused={self.fused!r})")
         scale = self.param("scale", nn.initializers.ones, (c,),
                            self.param_dtype)
         bias = self.param("bias", nn.initializers.zeros, (c,),
                           self.param_dtype)
-        y = group_norm(x, scale, bias, self.num_groups, self.epsilon)
+        g, eps = self.num_groups, self.epsilon
+        if self.fused == "none":
+            y = group_norm(x, scale, bias, g, eps)
+        elif self.fused == "relu":
+            if _slab_fits(x):
+                y = group_norm_act(x, scale, bias, g, eps, "relu")
+            else:
+                y = jax.nn.relu(group_norm(x, scale, bias, g, eps))
+        elif self.fused == "add_relu":
+            if _slab_fits(x):
+                y = group_norm_add_relu(x, scale, bias, residual, g, eps)
+            else:
+                y = jax.nn.relu(
+                    group_norm(x, scale, bias, g, eps)
+                    + residual.astype(x.dtype))
+        else:
+            raise ValueError(f"unknown fused mode {self.fused!r}")
         return y.astype(self.dtype) if self.dtype is not None else y
 
 
