@@ -476,10 +476,14 @@ def bench_moe(on_tpu: bool) -> None:
 
     from tpudist.models.moe import MoEConfig, MoEMLP
 
-    d, f = (1024, 4096) if on_tpu else (64, 128)
-    tokens = 8192 if on_tpu else 64
+    # sized under the tunnel's remote-compile request limit (HTTP 413 at
+    # d=1024/f=4096/T=8192)
+    d, f = (512, 2048) if on_tpu else (64, 128)
+    tokens = 4096 if on_tpu else 64
     top_k, experts = 2, 8
-    reps = 30 if on_tpu else 2
+    # the dense twin's step is ~0.3 ms — reps must push BOTH windows well
+    # past the tunnel RTT or the ratio is noise
+    reps = 400 if on_tpu else 2
     n_win = 5 if on_tpu else 2
     x = jax.random.normal(jax.random.key(0), (tokens, d),
                           jnp.bfloat16 if on_tpu else jnp.float32)
@@ -539,7 +543,7 @@ def bench_flash_decode_bandwidth(on_tpu: bool) -> None:
 
     b, s, h_kv, g, d_h = (4, 8192, 8, 4, 128) if on_tpu else (2, 128, 2, 2, 8)
     h = h_kv * g
-    reps = 60 if on_tpu else 2
+    reps = 400 if on_tpu else 2
     n_win = 6 if on_tpu else 2
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     q = jax.random.normal(jax.random.key(0), (b, 1, h, d_h), dtype)
@@ -628,21 +632,39 @@ def bench_tp_flash_decode(on_tpu: bool) -> None:
     mesh = make_mesh({"model": 1}, jax.devices()[:1])
     n_win = 3 if on_tpu else 2
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudist.models.generate import _make_select, _rollout
+
+    def constraint(leaf):
+        if leaf.ndim == 4:
+            return NamedSharding(mesh, P(None, None, "model", None))
+        return NamedSharding(mesh, P())
+
     def timed(attn):
-        def call():
-            out = tp_generate(cfg, params, prompt, new_tokens, mesh,
-                              decode_attention=attn)
-            return int(out[0, -1])
+        # jit ONCE outside the timing loop: tp_generate's public wrapper
+        # re-traces per call, which would time tracing, not decode
+        def run(p, t):
+            return _rollout(
+                cfg, p, t, new_tokens, _make_select(0.0, None, None),
+                jax.random.key(0), decode_attention=attn,
+                cache_constraint=constraint, prefill_chunk=512,
+                decode_shard=(mesh, "model") if attn == "flash" else None)
 
-        call()
-        return _best_window(call, n_win, lambda: None)
+        with mesh:
+            fn = jax.jit(run)
+            int(fn(params, prompt)[0, -1])  # compile + warmup
+            return _best_window(
+                lambda: int(fn(params, prompt)[0, -1]), n_win,
+                lambda: None)
 
-    t_flash = timed("flash")
-    t_dense = timed("dense")
+    t_flash, sh_f = _net(timed("flash"))
+    t_dense, _ = _net(timed("dense"))
     _emit("tp_decode_flash_vs_dense", round(t_dense / t_flash, 2), "x",
           None, context=cfg.max_seq_len, batch=batch,
           generated=new_tokens, flash_s=round(t_flash, 3),
-          dense_s=round(t_dense, 3), rtt_ms=round(_RTT * 1e3, 1))
+          dense_s=round(t_dense, 3), rtt_ms=round(_RTT * 1e3, 1),
+          rtt_shadowed=sh_f)
 
 
 def main() -> None:

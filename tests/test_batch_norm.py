@@ -70,6 +70,16 @@ def test_module_collections_match_flax():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_sync_axis_init_outside_mapped_axis():
+    """axis_name modules must init OUTSIDE pmap/shard_map (the flax
+    convention: params are created unmapped) without an unbound-axis
+    error — code-review r3 regression guard."""
+    x, _, _ = _data(4)
+    v = BatchNorm(use_running_average=False, axis_name="data").init(
+        jax.random.key(0), x)
+    assert set(v) == {"params", "batch_stats"}
+
+
 def test_sync_axis_matches_global_batch():
     """axis_name statistics == one big batch: pmapped sync-BN over 2
     shards must equal unsharded BN over the concatenated batch."""

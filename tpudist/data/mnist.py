@@ -132,6 +132,8 @@ def load_real_digits(split: str = "train",
 
     The file stores a fixed shuffle; ``split`` takes the deterministic
     head ("train") or tail ("test", last ``test_fraction``)."""
+    if split not in ("train", "test"):
+        raise KeyError(f"unknown split {split!r} (expected train|test)")
     p = Path(path) if path else (
         Path(__file__).resolve().parents[2] / "data" / "real_digits.npz")
     with np.load(p) as z:

@@ -100,11 +100,14 @@ class BatchNorm(nn.Module):
             y = ((x.astype(jnp.float32) - ra_mean.value) * rstd
                  * scale.astype(jnp.float32)
                  + bias.astype(jnp.float32)).astype(x.dtype)
-        elif self.axis_name is not None:
+        elif self.axis_name is not None and not self.is_initializing():
             # cross-replica statistics (the DDP SyncBatchNorm story) stay
             # on plain autodiff: the custom VJP treats exported stats as
             # constants, which would silently freeze the statistics'
-            # gradient contribution through the pmean
+            # gradient contribution through the pmean.  During init the
+            # axis is unbound (params are created OUTSIDE pmap/shard_map,
+            # the flax convention), so init falls through to the local
+            # branch below — exactly nn.BatchNorm's behavior.
             x32 = x.astype(jnp.float32)
             mean = jax.lax.pmean(
                 jnp.mean(x32, axis=(0, 1, 2)), self.axis_name)
