@@ -285,6 +285,65 @@ class TestFlashDecode:
             flash_decode(q, jnp.zeros((b, 4097, h, d)),
                          jnp.zeros((b, 4097, h, d)), 8)
 
+    def test_lse_and_offset_outputs(self):
+        """return_lse + pos_offset: the partial-softmax merge identity
+        must reconstruct the full attention from two half-cache calls —
+        the sequence-parallel decode contract."""
+        from tpudist.models.transformer import _masked_attend, repeat_kv
+        from tpudist.ops.flash_decode import flash_decode
+
+        rng = np.random.default_rng(11)
+        b, s, h, d = 2, 32, 4, 8
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        for cache_len in (9, 16, 25, 32):  # spans one / both halves
+            parts = []
+            for i in (0, 1):
+                sl = slice(i * 16, (i + 1) * 16)
+                parts.append(flash_decode(
+                    q, k[:, sl], v[:, sl], cache_len, block_k=8,
+                    pos_offset=i * 16, return_lse=True))
+            (o0, l0), (o1, l1) = parts
+            new_lse = jnp.logaddexp(l0, l1)
+            merged = (o0 * jnp.exp(l0 - new_lse)[:, None, :, None]
+                      + o1 * jnp.exp(l1 - new_lse)[:, None, :, None])
+            mask = jnp.arange(s) < cache_len
+            kf, vf = repeat_kv(q, k, v)
+            want = _masked_attend(q, kf, vf, mask[None, None, None, :])
+            np.testing.assert_allclose(
+                np.asarray(merged), np.asarray(want), rtol=1e-5,
+                atol=1e-5, err_msg=f"len={cache_len}")
+
+    def test_sp_flash_decode_in_shard_map(self, devices8):
+        """sp_flash_decode under a real shard_map over 8 shards ==
+        unsharded flash_decode, GQA + window included."""
+        from tpudist.models.transformer import _masked_attend, repeat_kv
+        from tpudist.ops.flash_decode import flash_decode, sp_flash_decode
+        from tpudist.runtime.mesh import make_mesh
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.default_rng(12)
+        b, s, h, h_kv, d = 2, 64, 4, 2, 8
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+        mesh = make_mesh({"seq": 8})
+        kv_spec = P(None, "seq", None, None)
+        for window, cache_len in ((None, 40), (None, 64), (12, 50)):
+            fn = jax.shard_map(
+                lambda qs, ks, vs: sp_flash_decode(
+                    qs, ks, vs, cache_len, "seq", window=window,
+                    block_k=8),
+                mesh=mesh, in_specs=(P(), kv_spec, kv_spec),
+                out_specs=P(), check_vma=False)
+            got = fn(q, k, v)
+            want = flash_decode(q, k, v, cache_len, window=window,
+                                block_k=8)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
+                err_msg=f"window={window} len={cache_len}")
+
     def test_chunked_prefill_matches_one_shot(self):
         """prefill_chunk (the bounded-memory prefill for long context /
         GSPMD paths) must not change the tokens — uneven chunks included."""
@@ -452,6 +511,49 @@ def test_sp_generate_sequence_sharded_cache(devices8):
     with pytest.raises(ValueError, match="divisible"):
         sp_generate(cfg_bad, params, prompt, 4,
                     make_mesh({"data": 1, "seq": 8}))
+
+
+def test_sp_generate_flash_kernel_per_shard(devices8):
+    """SP decode through the kernels: flash_decode per cache shard +
+    log-sum-exp merge must be token-exact vs the unsharded rollout —
+    windowed GQA and stop tokens included."""
+    from tpudist.models import sp_generate
+    from tpudist.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=32)
+    model = TransformerLM(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(13).integers(0, 32, (2, 5)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    want = greedy_generate(cfg, params, prompt, 10, decode_attention="flash")
+    mesh = make_mesh({"data": 4, "seq": 2})
+    got = sp_generate(cfg, params, prompt, 10, mesh,
+                      decode_attention="flash")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    stop = int(np.asarray(want)[0, prompt.shape[1] + 2])
+    want_s, want_len = greedy_generate(
+        cfg, params, prompt, 10, decode_attention="flash",
+        stop_tokens=[stop])
+    got_s, got_len = sp_generate(cfg, params, prompt, 10, mesh,
+                                 decode_attention="flash",
+                                 stop_tokens=[stop])
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
+
+    cfgw = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                             num_kv_heads=2, embed_dim=32, max_seq_len=24,
+                             attention_window=6)
+    promptw = jnp.asarray(
+        np.random.default_rng(14).integers(0, 32, (2, 4)), jnp.int32)
+    paramsw = TransformerLM(cfgw).init(jax.random.key(0), promptw)["params"]
+    wantw = greedy_generate(cfgw, paramsw, promptw, 12,
+                            decode_attention="flash")
+    gotw = sp_generate(cfgw, paramsw, promptw, 12,
+                       make_mesh({"data": 2, "seq": 4}),
+                       decode_attention="flash")
+    np.testing.assert_array_equal(np.asarray(gotw), np.asarray(wantw))
 
 
 def test_sharded_sampling_matches_unsharded(devices8):

@@ -273,6 +273,7 @@ def sp_generate(
     top_p: Optional[float] = None,
     stop_tokens: Sequence[int] | None = None,
     pad_token: int = 0,
+    decode_attention: str = "dense",
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Sequence-sharded-cache decode (greedy by default; the sampling
     controls mirror :func:`sample_generate`): the KV cache's SEQUENCE
@@ -280,18 +281,24 @@ def sp_generate(
     the layout that serves contexts larger than one chip's HBM (the
     decode-side counterpart of ring attention).  Params stay replicated.
 
-    GSPMD partitions the cached attention into per-shard partial
-    attention + softmax reductions over the sharded axis; measured HLO
-    keeps the cache sharded end-to-end (all-reduces only — no cache
-    all-gather, and the per-token ``dynamic_update_slice`` stays local to
-    the owning shard).  Returns the same tokens as
-    :func:`greedy_generate`."""
+    ``decode_attention="dense"``: GSPMD partitions the cached attention
+    into per-shard partial attention + softmax reductions over the
+    sharded axis; measured HLO keeps the cache sharded end-to-end
+    (all-reduces only — no cache all-gather, and the per-token
+    ``dynamic_update_slice`` stays local to the owning shard).
+    ``decode_attention="flash"``: per-token steps run
+    :func:`tpudist.ops.flash_decode.sp_flash_decode` — each shard's
+    flash kernel over its own cache slice, partial softmaxes merged by
+    log-sum-exp (prefill stays on the dense partitioned path).  Returns
+    the same tokens as :func:`greedy_generate`."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if cfg.max_seq_len % mesh.shape[axis]:
         raise ValueError(
             f"max_seq_len {cfg.max_seq_len} not divisible by {axis!r} "
             f"size {mesh.shape[axis]}")
+    decode_shard = ((mesh, axis, "seq") if decode_attention == "flash"
+                    else None)
 
     def cache_constraint(leaf):
         if leaf.ndim == 4:  # [B, S, H_kv, D]: shard the cache sequence
@@ -304,9 +311,10 @@ def sp_generate(
         return _rollout(
             cfg, params, prompt, max_new_tokens, select,
             key if key is not None else jax.random.key(0),
+            decode_attention=decode_attention,
             cache_constraint=cache_constraint,
             prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
-            pad_token=pad_token)
+            pad_token=pad_token, decode_shard=decode_shard)
 
     with mesh:
         return jax.jit(run)(params, prompt)

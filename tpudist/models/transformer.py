@@ -95,6 +95,10 @@ def sdpa(
     return _masked_attend(q, k, v, mask)
 
 
+def _shard_kind(decode_shard) -> str:
+    return decode_shard[2] if len(decode_shard) > 2 else "heads"
+
+
 def _head_sharded(decode_shard, fn, q, k, v, scalar):
     """Run ``fn(q, k, v, scalar)`` per shard over the HEAD dim of q/k/v
     (``scalar`` replicated) — the shard_map island that lets Pallas
@@ -102,11 +106,29 @@ def _head_sharded(decode_shard, fn, q, k, v, scalar):
     partition a pallas_call; heads are embarrassingly parallel)."""
     from jax.sharding import PartitionSpec as P
 
-    mesh, ax = decode_shard
+    mesh, ax = decode_shard[0], decode_shard[1]
     spec = P(None, None, ax, None)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P()),
         out_specs=spec, check_vma=False)(q, k, v, scalar)
+
+
+def _seq_sharded_decode(decode_shard, q, k_all, v_all, n, window):
+    """Sequence-sharded kernelized decode: cache slices stay put, each
+    shard runs flash_decode with global masking, partial softmaxes merge
+    by log-sum-exp (one [B, H] all-gather + one psum — no cache
+    movement)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudist.ops.flash_decode import sp_flash_decode
+
+    mesh, ax = decode_shard[0], decode_shard[1]
+    kv_spec = P(None, ax, None, None)
+    return jax.shard_map(
+        lambda qs, ks, vs, nn_: sp_flash_decode(
+            qs, ks, vs, nn_, ax, window=window),
+        mesh=mesh, in_specs=(P(), kv_spec, kv_spec, P()),
+        out_specs=P(), check_vma=False)(q, k_all, v_all, n)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,12 +168,15 @@ class CausalSelfAttention(nn.Module):
     # Pallas flash-decode kernel (tpudist.ops.flash_decode) — same numerics,
     # one cache read per KV head, the long-context serving path.
     decode_attention: str = "dense"
-    # (mesh, axis): run the flash decode/prefill kernels PER SHARD over the
-    # cache's head dimension via shard_map — GSPMD cannot partition a
-    # Pallas call, but heads are embarrassingly parallel (each shard owns
-    # whole KV-head groups), so a manual island inside the otherwise-GSPMD
-    # program composes TP serving with the kernels (the decode-side twin of
-    # ring_attention's shard_map + per-shard kernel pattern).
+    # (mesh, axis) or (mesh, axis, kind): run the flash kernels PER SHARD
+    # via shard_map — GSPMD cannot partition a Pallas call.  kind="heads"
+    # (default, the TP layout): heads are embarrassingly parallel, each
+    # shard owns whole KV-head groups; both prefill and decode kernelize.
+    # kind="seq" (the SP layout, cache sequence-sharded): each shard runs
+    # flash_decode on its cache slice with GLOBAL masking and partial
+    # softmaxes merge by log-sum-exp (tpudist.ops.flash_decode.
+    # sp_flash_decode); prefill stays on the dense GSPMD path (queries
+    # must attend across every shard's slice).
     decode_shard: Any = None
 
     @nn.compact
@@ -232,6 +257,10 @@ class CausalSelfAttention(nn.Module):
             from tpudist.ops.flash_decode import flash_decode
 
             if self.decode_shard is not None:
+                if _shard_kind(self.decode_shard) == "seq":
+                    return _seq_sharded_decode(
+                        self.decode_shard, q, k_all, v_all, idx + 1,
+                        cfg.attention_window)
                 return _head_sharded(
                     self.decode_shard,
                     lambda qs, ks, vs, n: flash_decode(
@@ -254,7 +283,14 @@ class CausalSelfAttention(nn.Module):
         pruned); the dense path builds the banded mask explicitly."""
         cfg = self.cfg
         s = q.shape[1]
-        if self.decode_attention == "flash":
+        seq_sharded = (self.decode_shard is not None
+                       and _shard_kind(self.decode_shard) == "seq")
+        # seq-sharded prefill stays on the dense GSPMD path below: the
+        # queries attend across every shard's cache slice, which GSPMD
+        # partitions into per-shard partial attention + reductions
+        # (measured HLO: no cache all-gather), while a Pallas call cannot
+        # be partitioned at all
+        if self.decode_attention == "flash" and not seq_sharded:
             from tpudist.ops.flash_attention import (
                 _auto_block, _flash_forward,
             )

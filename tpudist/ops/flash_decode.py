@@ -33,11 +33,19 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_BIG = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, scale: float, block_k: int, num_kb: int,
-                   window: int | None):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+                   block_k: int, num_kb: int, window: int | None,
+                   with_lse: bool):
+    if with_lse:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     kj = pl.program_id(1)
     cache_len = len_ref[0, 0]
+    # this shard's cache buffer starts at GLOBAL position `offset`
+    # (sequence-parallel decode: each shard owns a slice of the cache;
+    # 0 for the whole-cache case)
+    offset = len_ref[0, 1]
 
     @pl.when(kj == 0)
     def _init():
@@ -45,14 +53,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(kj * block_k < cache_len)
+    @pl.when(offset + kj * block_k < cache_len)
     def _compute():
         q, kb, vb = q_ref[0], k_ref[0], v_ref[0]     # [gp, D], [bk, D]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [gp, bk]
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
+        k_pos = offset + kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)                   # GLOBAL positions
         keep = k_pos < cache_len
         if window is not None:
             keep = jnp.logical_and(keep, k_pos >= cache_len - window)
@@ -72,6 +80,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if with_lse:
+            # log-sum-exp of this shard's scores: the merge key for
+            # sequence-parallel decode (out = Σ out_i·exp(lse_i − LSE))
+            lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
 
 
 def flash_decode(
@@ -83,7 +95,9 @@ def flash_decode(
     window: int | None = None,
     block_k: int = 1024,
     interpret: bool | None = None,
-) -> jnp.ndarray:
+    pos_offset: jnp.ndarray | int = 0,
+    return_lse: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """One decode step of attention.
 
     Args:
@@ -92,11 +106,17 @@ def flash_decode(
         (GQA: ``H_kv`` may divide ``H``); slots ``>= cache_len`` are
         ignored.
       cache_len: number of valid cache positions INCLUDING the current
-        token (the flax ``cache_index + 1``); may be traced.
+        token (the flax ``cache_index + 1``); may be traced.  With
+        ``pos_offset`` it stays GLOBAL: this buffer's slot ``j`` holds
+        global position ``pos_offset + j`` (the sequence-parallel shard
+        layout); validity and windowing are evaluated globally.
       window: sliding-window width (attend to the last ``window``
         positions only), matching :func:`tpudist.models.sdpa` semantics.
+      return_lse: also return the per-head log-sum-exp ``[B, H]`` — the
+        merge key for combining partial attention across cache shards
+        (:func:`sp_flash_decode`).
 
-    Returns ``[B, 1, H, D]``.
+    Returns ``[B, 1, H, D]`` (plus ``[B, H]`` lse when requested).
     """
     b, s_q, h, d = q.shape
     assert s_q == 1, "flash_decode consumes one query token"
@@ -136,12 +156,20 @@ def flash_decode(
     q3 = q3.reshape(b * h_kv, gp, d)
     k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
     v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
-    len_arg = jnp.asarray(cache_len, jnp.int32).reshape(1, 1)
+    len_arg = jnp.stack([
+        jnp.asarray(cache_len, jnp.int32),
+        jnp.asarray(pos_offset, jnp.int32)]).reshape(1, 2)
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((1, 1, gp), lambda g_, j: (g_, 0, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h_kv, 1, gp), jnp.float32))
+    outs = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=d ** -0.5, block_k=block_k,
-            num_kb=num_kb, window=window),
+            num_kb=num_kb, window=window, with_lse=return_lse),
         grid=(b * h_kv, num_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -149,8 +177,8 @@ def flash_decode(
             pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype),
+        out_specs=out_specs if return_lse else out_specs[0],
+        out_shape=out_shape if return_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((gp, 1), jnp.float32),
             pltpu.VMEM((gp, 1), jnp.float32),
@@ -160,4 +188,47 @@ def flash_decode(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(len_arg, q3, k3, v3)
-    return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
+    if not return_lse:
+        out = outs
+        return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
+    out, lse = outs
+    out = out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
+    lse = lse.reshape(b, h_kv, gp)[:, :, :g].reshape(b, h)
+    return out, lse
+
+
+def sp_flash_decode(
+    q: jnp.ndarray,
+    k_shard: jnp.ndarray,
+    v_shard: jnp.ndarray,
+    cache_len: jnp.ndarray | int,
+    axis_name: str,
+    *,
+    window: int | None = None,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Sequence-parallel flash decode: the KV cache's SEQUENCE dim is
+    sharded over ``axis_name`` (shard i owns global slots
+    ``[i·S_loc, (i+1)·S_loc)``); each shard runs :func:`flash_decode` on
+    its slice with GLOBAL masking, then partial softmaxes merge with the
+    log-sum-exp identity — one tiny ``[B, H]`` all-gather plus one psum
+    of the output, no cache movement (the "flash decoding" parallelism,
+    decode-side twin of ring attention's training split).
+
+    Call inside a ``shard_map`` over ``axis_name`` with q replicated and
+    k/v sequence-sharded.  Returns the replicated ``[B, 1, H, D]``.
+    """
+    from jax import lax
+
+    i = lax.axis_index(axis_name)
+    s_loc = k_shard.shape[1]
+    out, lse = flash_decode(
+        q, k_shard, v_shard, cache_len, window=window, block_k=block_k,
+        interpret=interpret, pos_offset=i * s_loc, return_lse=True)
+    all_lse = lax.all_gather(lse, axis_name)             # [n, B, H]
+    new_lse = jax.nn.logsumexp(all_lse, axis=0)          # [B, H]
+    w = jnp.exp(lse - new_lse)
+    return lax.psum(
+        out.astype(jnp.float32) * w[:, None, :, None], axis_name
+    ).astype(q.dtype)
