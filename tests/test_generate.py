@@ -556,6 +556,49 @@ def test_sp_generate_flash_kernel_per_shard(devices8):
     np.testing.assert_array_equal(np.asarray(gotw), np.asarray(wantw))
 
 
+def test_tp_sp_generate_2d_sharded_decode(devices8):
+    """The full 2-D serving layout (Megatron weights + cache sharded over
+    heads AND sequence): kernelized decode must be token-exact vs the
+    unsharded flash rollout, stop tokens included; dense mode agrees."""
+    from tpudist.models import tp_sp_generate
+    from tpudist.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=2, num_heads=4,
+                            num_kv_heads=2, embed_dim=32, max_seq_len=32)
+    model = TransformerLM(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(15).integers(0, 32, (2, 5)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    want = greedy_generate(cfg, params, prompt, 10, decode_attention="flash")
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+    got = tp_sp_generate(cfg, params, prompt, 10, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    got_d = tp_sp_generate(cfg, params, prompt, 10, mesh,
+                           decode_attention="dense")
+    np.testing.assert_array_equal(np.asarray(got_d), np.asarray(want))
+
+    stop = int(np.asarray(want)[0, prompt.shape[1] + 2])
+    want_s, want_len = greedy_generate(
+        cfg, params, prompt, 10, decode_attention="flash",
+        stop_tokens=[stop])
+    got_s, got_len = tp_sp_generate(cfg, params, prompt, 10, mesh,
+                                    stop_tokens=[stop])
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
+
+    with pytest.raises(ValueError, match="kv_heads"):
+        tp_sp_generate(cfg, params, prompt, 4,
+                       make_mesh({"model": 4, "seq": 2}))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        tp_sp_generate(
+            TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                              num_kv_heads=2, embed_dim=32,
+                              max_seq_len=35),
+            params, prompt, 4,
+            make_mesh({"data": 2, "model": 2, "seq": 2}))
+
+
 def test_sharded_sampling_matches_unsharded(devices8):
     """Sampling through the sharded rollouts: same key + controls must
     reproduce sample_generate's tokens exactly (identical key schedule)."""
@@ -578,6 +621,13 @@ def test_sharded_sampling_matches_unsharded(devices8):
                          make_mesh({"data": 4, "seq": 2}),
                          key=jax.random.key(7), temperature=0.9, top_k=8)
     np.testing.assert_array_equal(np.asarray(got_sp), np.asarray(want))
+    from tpudist.models import tp_sp_generate
+
+    got_2d = tp_sp_generate(cfg, params, prompt, 8,
+                            make_mesh({"data": 2, "model": 2, "seq": 2}),
+                            key=jax.random.key(7), temperature=0.9,
+                            top_k=8)
+    np.testing.assert_array_equal(np.asarray(got_2d), np.asarray(want))
 
 
 def test_sharded_stop_tokens_match_unsharded(devices8):

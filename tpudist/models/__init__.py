@@ -14,6 +14,7 @@ from tpudist.models.generate import (
     sample_generate,
     sp_generate,
     tp_generate,
+    tp_sp_generate,
 )
 from tpudist.models.mlp import MLP
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
@@ -39,6 +40,7 @@ __all__ = [
     "sample_generate",
     "sp_generate",
     "tp_generate",
+    "tp_sp_generate",
     "resnet50_stages",
     "sdpa",
 ]

@@ -188,6 +188,38 @@ def greedy_generate(
         pad_token=pad_token)
 
 
+def _sharded_generate(cfg, params, prompt, max_new_tokens, mesh, *,
+                      cache_spec, decode_shard, decode_attention,
+                      prefill_chunk, key, temperature, top_k, top_p,
+                      stop_tokens, pad_token):
+    """Common tail of the sharded decode entry points (tp / sp / tp_sp):
+    a jitted :func:`_rollout` under the mesh, with the 4-D cache buffers
+    pinned to ``cache_spec`` and scalars replicated, optionally routing
+    the attention through per-shard kernel islands (``decode_shard``).
+    Kept in ONE place so the key default, stop-token plumbing, and
+    sampling selector can never drift between the three layouts."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def cache_constraint(leaf):
+        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
+            return NamedSharding(mesh, cache_spec)
+        return NamedSharding(mesh, P())  # cache_index scalars
+
+    select = _make_select(temperature, top_k, top_p)
+
+    def run(params, prompt):
+        return _rollout(
+            cfg, params, prompt, max_new_tokens, select,
+            key if key is not None else jax.random.key(0),
+            decode_attention=decode_attention,
+            cache_constraint=cache_constraint,
+            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+            pad_token=pad_token, decode_shard=decode_shard)
+
+    with mesh:
+        return jax.jit(run)(params, prompt)
+
+
 def tp_generate(
     cfg: TransformerConfig,
     params: Any,
@@ -218,7 +250,7 @@ def tp_generate(
     Requires ``cfg.kv_heads % tp == 0`` (each shard owns whole KV heads).
     Returns the same tokens as :func:`greedy_generate`.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpudist.parallel.tensor_parallel import (
         shard_tree,
@@ -235,28 +267,15 @@ def tp_generate(
     # each shard's own (whole) KV-head groups inside a shard_map island —
     # the decode twin of the training-side ring_attention pattern
     # (VERDICT r2 #3; the old ValueError is gone).
-    decode_shard = (mesh, axis) if decode_attention == "flash" else None
     specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
-    sharded = shard_tree(params, mesh, specs)
-
-    def cache_constraint(leaf):
-        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers: shard the heads
-            return NamedSharding(mesh, P(None, None, axis, None))
-        return NamedSharding(mesh, P())  # cache_index scalars
-
-    select = _make_select(temperature, top_k, top_p)
-
-    def run(params, prompt):
-        return _rollout(
-            cfg, params, prompt, max_new_tokens, select,
-            key if key is not None else jax.random.key(0),
-            decode_attention=decode_attention,
-            cache_constraint=cache_constraint,
-            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
-            pad_token=pad_token, decode_shard=decode_shard)
-
-    with mesh:
-        return jax.jit(run, static_argnums=())(sharded, prompt)
+    return _sharded_generate(
+        cfg, shard_tree(params, mesh, specs), prompt, max_new_tokens, mesh,
+        cache_spec=P(None, None, axis, None),
+        decode_shard=((mesh, axis) if decode_attention == "flash"
+                      else None),
+        decode_attention=decode_attention, prefill_chunk=prefill_chunk,
+        key=key, temperature=temperature, top_k=top_k, top_p=top_p,
+        stop_tokens=stop_tokens, pad_token=pad_token)
 
 
 def sp_generate(
@@ -291,33 +310,78 @@ def sp_generate(
     flash kernel over its own cache slice, partial softmaxes merged by
     log-sum-exp (prefill stays on the dense partitioned path).  Returns
     the same tokens as :func:`greedy_generate`."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     if cfg.max_seq_len % mesh.shape[axis]:
         raise ValueError(
             f"max_seq_len {cfg.max_seq_len} not divisible by {axis!r} "
             f"size {mesh.shape[axis]}")
-    decode_shard = ((mesh, axis, "seq") if decode_attention == "flash"
-                    else None)
+    return _sharded_generate(
+        cfg, params, prompt, max_new_tokens, mesh,
+        cache_spec=P(None, axis, None, None),
+        decode_shard=((mesh, axis, "seq") if decode_attention == "flash"
+                      else None),
+        decode_attention=decode_attention, prefill_chunk=prefill_chunk,
+        key=key, temperature=temperature, top_k=top_k, top_p=top_p,
+        stop_tokens=stop_tokens, pad_token=pad_token)
 
-    def cache_constraint(leaf):
-        if leaf.ndim == 4:  # [B, S, H_kv, D]: shard the cache sequence
-            return NamedSharding(mesh, P(None, axis, None, None))
-        return NamedSharding(mesh, P())
 
-    select = _make_select(temperature, top_k, top_p)
+def tp_sp_generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "model",
+    seq_axis: str = "seq",
+    rules=None,
+    decode_attention: str = "flash",
+    prefill_chunk: int | None = 512,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
+    """2-D sharded decode — the full distributed-serving layout: params
+    Megatron-sharded over ``axis`` (weight memory 1/tp), the KV cache
+    sharded over BOTH its head dim (``axis``) and its sequence dim
+    (``seq_axis``), so per-chip cache memory is 1/(tp·sp) — contexts
+    larger than any single chip's HBM with tensor-parallel weights.
 
-    def run(params, prompt):
-        return _rollout(
-            cfg, params, prompt, max_new_tokens, select,
-            key if key is not None else jax.random.key(0),
-            decode_attention=decode_attention,
-            cache_constraint=cache_constraint,
-            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
-            pad_token=pad_token, decode_shard=decode_shard)
+    ``decode_attention="flash"`` (default): each shard runs the flash
+    kernel on its own (head-group × cache-slice) block and the partial
+    softmaxes merge by log-sum-exp over ``seq_axis`` only — heads need no
+    collective.  ``"dense"`` leaves the partitioning to GSPMD.  Prefill
+    runs on the dense partitioned path either way (queries must attend
+    across every sequence shard).  Same tokens as
+    :func:`greedy_generate`; sampling/stop controls as elsewhere."""
+    from jax.sharding import PartitionSpec as P
 
-    with mesh:
-        return jax.jit(run)(params, prompt)
+    from tpudist.parallel.tensor_parallel import (
+        shard_tree,
+        spec_tree_from_rules,
+        transformer_tp_rules,
+    )
+
+    tp, sp = mesh.shape[axis], mesh.shape[seq_axis]
+    if cfg.kv_heads % tp:
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} not divisible by {axis!r} size {tp}")
+    if cfg.max_seq_len % sp:
+        raise ValueError(
+            f"max_seq_len {cfg.max_seq_len} not divisible by "
+            f"{seq_axis!r} size {sp}")
+    specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
+    return _sharded_generate(
+        cfg, shard_tree(params, mesh, specs), prompt, max_new_tokens, mesh,
+        cache_spec=P(None, seq_axis, axis, None),
+        decode_shard=((mesh, (axis, seq_axis), "heads_seq")
+                      if decode_attention == "flash" else None),
+        decode_attention=decode_attention, prefill_chunk=prefill_chunk,
+        key=key, temperature=temperature, top_k=top_k, top_p=top_p,
+        stop_tokens=stop_tokens, pad_token=pad_token)
 
 
 def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:

@@ -117,18 +117,26 @@ def _seq_sharded_decode(decode_shard, q, k_all, v_all, n, window):
     """Sequence-sharded kernelized decode: cache slices stay put, each
     shard runs flash_decode with global masking, partial softmaxes merge
     by log-sum-exp (one [B, H] all-gather + one psum — no cache
-    movement)."""
+    movement).  With the 2-D ``"heads_seq"`` kind the axis pair
+    ``(head_axis, seq_axis)`` shards heads AND sequence: each shard
+    kernels its own (head slice × cache slice) and the merge runs over
+    the sequence axis only — heads need no collective at all."""
     from jax.sharding import PartitionSpec as P
 
     from tpudist.ops.flash_decode import sp_flash_decode
 
     mesh, ax = decode_shard[0], decode_shard[1]
-    kv_spec = P(None, ax, None, None)
+    if isinstance(ax, tuple):
+        hax, sax = ax
+    else:
+        hax, sax = None, ax
+    q_spec = P(None, None, hax, None)
+    kv_spec = P(None, sax, hax, None)
     return jax.shard_map(
         lambda qs, ks, vs, nn_: sp_flash_decode(
-            qs, ks, vs, nn_, ax, window=window),
-        mesh=mesh, in_specs=(P(), kv_spec, kv_spec, P()),
-        out_specs=P(), check_vma=False)(q, k_all, v_all, n)
+            qs, ks, vs, nn_, sax, window=window),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, P()),
+        out_specs=q_spec, check_vma=False)(q, k_all, v_all, n)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +185,9 @@ class CausalSelfAttention(nn.Module):
     # softmaxes merge by log-sum-exp (tpudist.ops.flash_decode.
     # sp_flash_decode); prefill stays on the dense GSPMD path (queries
     # must attend across every shard's slice).
+    # kind="heads_seq" (the 2-D TP×SP layout): axis is the PAIR
+    # (head_axis, seq_axis) — each shard kernels its own (head-group ×
+    # cache-slice) block and the merge runs over seq_axis only.
     decode_shard: Any = None
 
     @nn.compact
@@ -257,7 +268,7 @@ class CausalSelfAttention(nn.Module):
             from tpudist.ops.flash_decode import flash_decode
 
             if self.decode_shard is not None:
-                if _shard_kind(self.decode_shard) == "seq":
+                if _shard_kind(self.decode_shard) in ("seq", "heads_seq"):
                     return _seq_sharded_decode(
                         self.decode_shard, q, k_all, v_all, idx + 1,
                         cfg.attention_window)
@@ -284,7 +295,8 @@ class CausalSelfAttention(nn.Module):
         cfg = self.cfg
         s = q.shape[1]
         seq_sharded = (self.decode_shard is not None
-                       and _shard_kind(self.decode_shard) == "seq")
+                       and _shard_kind(self.decode_shard)
+                       in ("seq", "heads_seq"))
         # seq-sharded prefill stays on the dense GSPMD path below: the
         # queries attend across every shard's cache slice, which GSPMD
         # partitions into per-shard partial attention + reductions
