@@ -518,8 +518,13 @@ def bench_moe(on_tpu: bool) -> None:
             lambda: float(many(x)), n_win, lambda: None))
         return best / reps, shadowed
 
+    ragged = MoEMLP(d, f, MoEConfig(num_experts=experts, top_k=top_k,
+                                    dispatch="ragged"))
+
     t_moe, sh1 = timed(
         lambda p, xc: moe.apply({"params": p}, xc)[0], moe_params)
+    t_ragged, sh3 = timed(
+        lambda p, xc: ragged.apply({"params": p}, xc)[0], moe_params)
     t_dense, sh2 = timed(
         lambda p, xc: dense.apply({"params": p}, xc), dense_params)
     # expert-MLP FLOPs both sides: tokens * top_k * 2 matmuls * 2*d*f
@@ -530,6 +535,12 @@ def bench_moe(on_tpu: bool) -> None:
           moe_tflops=round(core_flops / t_moe / 1e12, 1),
           dense_tflops=round(core_flops / t_dense / 1e12, 1),
           rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh1 or sh2)
+    _emit("moe_ragged_dispatch_overhead", round(t_ragged / t_dense, 2),
+          "x", None, tokens=tokens, experts=experts, top_k=top_k,
+          ragged_ms=round(t_ragged * 1e3, 2),
+          vs_einsum_dispatch=round(t_moe / t_ragged, 2),
+          ragged_tflops=round(core_flops / t_ragged / 1e12, 1),
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh3 or sh2)
 
 
 def bench_flash_decode_bandwidth(on_tpu: bool) -> None:

@@ -37,6 +37,28 @@ class MoEConfig:
     top_k: int = 2
     capacity_factor: float = 2.0
     aux_loss_weight: float = 1e-2
+    # "einsum": the GShard [T, E, C] one-hot dispatch/combine (capacity-
+    # bounded, drops overflow tokens; the formulation EP's all-to-all
+    # transports).  "ragged": sorted dispatch + jax.lax.ragged_dot grouped
+    # matmuls — no [T, E, C] einsums (which at small E cost MORE FLOPs
+    # than the experts themselves: measured 6.5× overhead in bench.py),
+    # no capacity, no token dropping.  Single-shard only (ep_axis needs
+    # the block layout).
+    dispatch: str = "einsum"
+
+
+def _gate_choices(gates: jnp.ndarray, top_k: int):
+    """Shared routing head: top-k expert choices with renormalised gate
+    mass + the Switch load-balancing aux loss."""
+    e = gates.shape[-1]
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)
+    top_vals = top_vals / jnp.maximum(
+        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], e, dtype=gates.dtype), axis=0)
+    mean_gates = jnp.mean(gates, axis=0)
+    aux = jnp.sum(frac_tokens * mean_gates) * e
+    return top_vals, top_idx, aux
 
 
 def _top_k_routing(gates: jnp.ndarray, top_k: int, capacity: int):
@@ -48,9 +70,7 @@ def _top_k_routing(gates: jnp.ndarray, top_k: int, capacity: int):
     """
     t, e = gates.shape
     # [T, k] indices of the chosen experts, gate mass renormalised over them.
-    top_vals, top_idx = jax.lax.top_k(gates, top_k)
-    top_vals = top_vals / jnp.maximum(
-        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+    top_vals, top_idx, aux = _gate_choices(gates, top_k)
 
     dispatch = jnp.zeros((t, e, capacity), gates.dtype)
     combine = jnp.zeros((t, e, capacity), gates.dtype)
@@ -67,12 +87,29 @@ def _top_k_routing(gates: jnp.ndarray, top_k: int, capacity: int):
         combine = combine + sel * top_vals[:, k, None, None]
         counts = counts + jnp.sum(onehot, axis=0)
 
-    # Load-balancing aux loss (Switch eq. 4): encourages uniform routing.
-    frac_tokens = jnp.mean(
-        jax.nn.one_hot(top_idx[:, 0], e, dtype=gates.dtype), axis=0)
-    mean_gates = jnp.mean(gates, axis=0)
-    aux = jnp.sum(frac_tokens * mean_gates) * e
     return dispatch, combine, aux
+
+
+def _ragged_moe(x: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
+                top_idx: jnp.ndarray, top_vals: jnp.ndarray) -> jnp.ndarray:
+    """Sorted dispatch + grouped matmuls: every (token, choice) assignment
+    is sorted by expert id (stable argsort — static [T·k] shape), expert
+    MLPs run as TWO ``jax.lax.ragged_dot`` calls over the contiguous
+    groups, and the inverse permutation + gate-weighted sum combines.
+    Zero [T, E, C] one-hots, zero capacity padding, zero dropped tokens.
+    """
+    t, d = x.shape
+    k = top_idx.shape[1]
+    e = w_up.shape[0]
+    flat_e = top_idx.reshape(-1)                        # [T·k]
+    order = jnp.argsort(flat_e, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    xs = x[order // k]                                  # assignment -> token
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    h = jax.nn.gelu(jax.lax.ragged_dot(xs, w_up, group_sizes))
+    ys = jax.lax.ragged_dot(h, w_down, group_sizes)     # [T·k, d]
+    y = ys[inv].reshape(t, k, d)
+    return jnp.sum(y * top_vals[:, :, None].astype(y.dtype), axis=1)
 
 
 class MoEMLP(nn.Module):
@@ -107,6 +144,25 @@ class MoEMLP(nn.Module):
             1, int(self.moe.capacity_factor * t * self.moe.top_k / e))
         gates = jax.nn.softmax(
             nn.Dense(e, use_bias=False, name="router")(x).astype(jnp.float32))
+        if self.moe.dispatch == "ragged":
+            if self.ep_axis is not None:
+                raise ValueError(
+                    "dispatch='ragged' is single-shard (the EP all-to-all "
+                    "transports the [E, C, d] block layout); use "
+                    "dispatch='einsum' with ep_axis")
+            top_vals, top_idx, aux = _gate_choices(gates, self.moe.top_k)
+            w_up = self.param(
+                "w_up", nn.initializers.lecun_normal(),
+                (e, self.d_model, self.d_ff)).astype(x.dtype)
+            w_down = self.param(
+                "w_down", nn.initializers.lecun_normal(),
+                (e, self.d_ff, self.d_model)).astype(x.dtype)
+            out = _ragged_moe(x, w_up, w_down, top_idx, top_vals)
+            return out, aux.astype(jnp.float32)
+        if self.moe.dispatch != "einsum":
+            raise ValueError(
+                f"unknown dispatch {self.moe.dispatch!r} "
+                f"(expected einsum|ragged)")
         dispatch, combine, aux = _top_k_routing(
             gates, self.moe.top_k, capacity)
 
