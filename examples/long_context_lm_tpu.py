@@ -185,8 +185,13 @@ def main(argv=None) -> float:
 
     if args.generate > 0:
         # the serving path: one-shot prompt prefill, then per-token
-        # flash-decode steps against the KV cache
-        from tpudist.models.generate import greedy_generate
+        # flash-decode steps against the KV cache — through the SAME
+        # sharded layout the training run used (tp → head-sharded cache
+        # with per-shard kernels; sp → sequence-sharded cache with the
+        # log-sum-exp merge; plain dp → single-program flash decode)
+        from tpudist.models.generate import (
+            greedy_generate, sp_generate, tp_generate,
+        )
 
         # the pos-embed table is sized cfg.max_seq_len, so the decode cfg
         # is the training cfg; prompt + generated must fit in it
@@ -197,14 +202,30 @@ def main(argv=None) -> float:
                                 cfg.max_seq_len - args.generate))
         prompt = jnp.asarray(tokens[:2, :prompt_len])
         t0 = time.time()
+        # params stay on device: the tp path is ALREADY in the Megatron
+        # layout tp_generate wants (shard_tree re-placement is a no-op),
+        # and a device_get here would gather the whole tree to host just
+        # to re-upload it
         # stop_tokens: EOS semantics under static shapes — sequences
         # freeze at their first stop token and report true lengths
-        out, lengths = greedy_generate(
-            cfg, jax.device_get(state.params), prompt, args.generate,
-            decode_attention="flash", stop_tokens=[0])
+        if args.tp > 1 and cfg.kv_heads % args.tp == 0:
+            out, lengths = tp_generate(
+                cfg, state.params, prompt, args.generate, mesh,
+                decode_attention="flash", stop_tokens=[0])
+            serve = f"tp{args.tp} flash"
+        elif args.sp > 1 and cfg.max_seq_len % args.sp == 0:
+            out, lengths = sp_generate(
+                cfg, state.params, prompt, args.generate, mesh,
+                decode_attention="flash", stop_tokens=[0])
+            serve = f"sp{args.sp} flash"
+        else:
+            out, lengths = greedy_generate(
+                cfg, state.params, prompt, args.generate,
+                decode_attention="flash", stop_tokens=[0])
+            serve = "single-program flash"
         jax.block_until_ready(out)
         dt = time.time() - t0
-        print(f"generated {args.generate} tokens/seq "
+        print(f"generated {args.generate} tokens/seq via {serve} "
               f"(prompt {prompt.shape[1]}) in {dt:.2f}s; "
               f"lengths (EOS=0): {lengths.tolist()}; "
               f"sample: {out[0, -16:].tolist()}")

@@ -85,14 +85,20 @@ def test_long_context_lm_twin(extra):
     assert loss == loss and loss < 7.0  # finite, sane
 
 
-def test_long_context_lm_generation_demo():
+@pytest.mark.parametrize("extra", [
+    [],                               # single-program flash serving
+    ["--tp", "2"],                    # head-sharded serving (tp_generate)
+    ["--sp", "2", "--attn", "ulysses"],  # seq-sharded serving (sp_generate)
+])
+def test_long_context_lm_generation_demo(extra):
     """The serving demo end-to-end: flash prefill + decode with EOS
-    stop_tokens and reported lengths."""
+    stop_tokens and reported lengths, through the same sharded layout the
+    training run used."""
     import long_context_lm_tpu
 
     loss = long_context_lm_tpu.main(
         ["--seq-len", "128", "--batch-size", "8", "--steps", "2",
          "--layers", "1", "--heads", "4", "--embed-dim", "64",
-         "--log-every", "10", "--generate", "8"]
+         "--log-every", "10", "--generate", "8", *extra]
     )
     assert loss == loss
