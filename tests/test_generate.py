@@ -344,6 +344,45 @@ class TestFlashDecode:
                 np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
                 err_msg=f"window={window} len={cache_len}")
 
+    def test_q8_cache_matches_bf16_within_quant_tolerance(self):
+        """int8 KV cache decode (flash_decode_q8 + quantize_kv): same
+        attention within int8 rounding — the bandwidth-halving serving
+        option for long context."""
+        from tpudist.ops.flash_decode import (
+            flash_decode, flash_decode_q8, quantize_kv,
+        )
+
+        rng = np.random.default_rng(21)
+        for h, h_kv, window in [(4, 4, None), (8, 2, None), (4, 2, 5)]:
+            b, s, d = 2, 32, 16
+            q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)),
+                            jnp.float32)
+            v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)),
+                            jnp.float32)
+            kq, ks, vq, vs = quantize_kv(k, v)
+            assert kq.dtype == jnp.int8 and ks.shape == (b, s, h_kv, 1)
+            for cache_len in (7, 20, 32):
+                got = flash_decode_q8(q, kq, ks, vq, vs, cache_len,
+                                      window=window, block_k=8)
+                want = flash_decode(q, k, v, cache_len, window=window,
+                                    block_k=8)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=0.03,
+                    err_msg=f"h={h} hkv={h_kv} w={window} len={cache_len}")
+
+    def test_quantize_kv_roundtrip_error_bounded(self):
+        from tpudist.ops.flash_decode import quantize_kv
+
+        x = jnp.asarray(
+            np.random.default_rng(22).standard_normal((2, 16, 2, 8)) * 5,
+            jnp.float32)
+        kq, ks, _, _ = quantize_kv(x, x)
+        deq = kq.astype(jnp.float32) * ks
+        # symmetric per-row int8: error <= scale/2 = rowmax/254
+        bound = np.asarray(jnp.max(jnp.abs(x), -1, keepdims=True)) / 254.0
+        assert (np.abs(np.asarray(deq - x)) <= bound + 1e-6).all()
+
     def test_chunked_prefill_matches_one_shot(self):
         """prefill_chunk (the bounded-memory prefill for long context /
         GSPMD paths) must not change the tokens — uneven chunks included."""

