@@ -237,6 +237,72 @@ class TestFilters:
 
 
 class TestFlashDecode:
+    def test_windowed_grid_trim(self):
+        """With ``window`` the grid streams only the blocks intersecting
+        [cache_len - window, cache_len): numerics must match the dense
+        reference across block boundaries, partial fills, shard offsets
+        (start_block > 0 paths), and the int8 cache."""
+        from tpudist.models.transformer import _masked_attend, repeat_kv
+        from tpudist.ops.flash_decode import (
+            flash_decode, flash_decode_q8, quantize_kv,
+        )
+
+        rng = np.random.default_rng(3)
+        b, s, h, h_kv, d = 2, 64, 4, 2, 8
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+        for window, cache_len in [(8, 64), (8, 61), (12, 33), (24, 10),
+                                  (64, 40), (16, 5)]:
+            got = flash_decode(q, k, v, cache_len, window=window,
+                               block_k=8)
+            pos = jnp.arange(s)
+            mask = (pos < cache_len) & (pos >= cache_len - window)
+            kf, vf = repeat_kv(q, k, v)
+            want = _masked_attend(q, kf, vf, mask[None, None, None, :])
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
+                err_msg=f"w={window} len={cache_len}")
+            kq, ks, vq, vs = quantize_kv(k, v)
+            got8 = flash_decode_q8(q, kq, ks, vq, vs, cache_len,
+                                   window=window, block_k=8)
+            np.testing.assert_allclose(
+                np.asarray(got8), np.asarray(want), rtol=0.05, atol=0.05,
+                err_msg=f"q8 w={window} len={cache_len}")
+
+    def test_windowed_trim_with_offset_lse_merge(self):
+        """Sharded-cache windowed decode: each shard trims its grid to
+        its own slice of the global window span; the lse merge must
+        still reconstruct the full windowed attention."""
+        from tpudist.models.transformer import _masked_attend, repeat_kv
+        from tpudist.ops.flash_decode import flash_decode
+
+        rng = np.random.default_rng(5)
+        b, s, h, d = 2, 64, 4, 8
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        window = 24
+        for cache_len in (20, 33, 40, 64):  # window straddles the shards
+            parts = []
+            for i in (0, 1):
+                sl = slice(i * 32, (i + 1) * 32)
+                parts.append(flash_decode(
+                    q, k[:, sl], v[:, sl], cache_len, window=window,
+                    block_k=8, pos_offset=i * 32, return_lse=True))
+            (o0, l0), (o1, l1) = parts
+            new_lse = jnp.logaddexp(l0, l1)
+            w0 = jnp.exp(l0 - new_lse)[:, None, :, None]
+            w1 = jnp.exp(l1 - new_lse)[:, None, :, None]
+            merged = jnp.nan_to_num(o0) * w0 + jnp.nan_to_num(o1) * w1
+            pos = jnp.arange(s)
+            mask = (pos < cache_len) & (pos >= cache_len - window)
+            kf, vf = repeat_kv(q, k, v)
+            want = _masked_attend(q, kf, vf, mask[None, None, None, :])
+            np.testing.assert_allclose(
+                np.asarray(merged), np.asarray(want), rtol=1e-5,
+                atol=1e-5, err_msg=f"len={cache_len}")
+
     def test_kernel_matches_dense_cached_attend(self):
         """flash_decode == masked softmax over the cache, across GQA
         grouping, partial fills, and sliding windows."""

@@ -9,12 +9,17 @@ trick is to put the GQA *query-head group* on the sublane axis instead: with
 stream, so the cache is read ONCE per kv head (the memory-bound quantity at
 long context) while the MXU sees a real tile.
 
-Grid: ``(B·H_kv, S/block_k)``, K sequential innermost with the online-
-softmax recurrence in VMEM scratch — the same structure as the training
-kernel's K loop.  Blocks past ``cache_len`` skip their FLOPs under
-``pl.when`` (the fetch still streams, bounded by the allocated cache);
-positions beyond the cache index — and, with ``window``, older than the
-sliding window — mask to -inf.
+Grid: ``(B·H_kv, nb)``, K sequential innermost with the online-softmax
+recurrence in VMEM scratch — the same structure as the training kernel's
+K loop.  Without ``window``, ``nb = S/block_k`` and blocks past
+``cache_len`` skip their FLOPs under ``pl.when`` (the fetch still
+streams, bounded by the allocated cache).  With ``window`` the grid is
+TRIMMED: a scalar-prefetch ``start_block`` points the block index maps
+at the ~``window/block_k`` blocks intersecting the window span, so a
+windowed decode streams ~``window`` positions per step instead of the
+whole cache — at the bandwidth-bound decode op that is a ~S/window
+speedup.  Positions beyond the cache index, or older than the window,
+mask to -inf as before.
 
 Reference scope note: the reference suite is training-only (SURVEY.md §2 —
 no inference path anywhere); this kernel + the TP rollout in
@@ -33,10 +38,18 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_BIG = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, *rest, scale: float,
+def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
                    block_k: int, num_kb: int, window: int | None,
                    with_lse: bool, quant: bool):
     """Online-softmax decode over one (batch·kv-head) row of the cache.
+
+    ``meta_ref`` is the scalar-prefetch vector ``[cache_len, offset,
+    start_block]``: ``offset`` is this shard's global cache start
+    (sequence-parallel decode; 0 for the whole-cache case), and
+    ``start_block`` trims the K grid to the sliding window — with
+    ``window`` the grid runs only the ~``window/block_k`` blocks that
+    intersect it, so a windowed decode STREAMS ~``window`` positions
+    instead of the whole cache (bandwidth is the decode bound).
 
     ``quant``: K/V tiles are int8 with per-token scales riding the LANE
     axis ([1, bk] blocks — a [bk, 1] layout would pad every scale to a
@@ -54,11 +67,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, *rest, scale: float,
     else:
         o_ref, m_scr, l_scr, acc_scr = rest
     kj = pl.program_id(1)
-    cache_len = len_ref[0, 0]
-    # this shard's cache buffer starts at GLOBAL position `offset`
-    # (sequence-parallel decode: each shard owns a slice of the cache;
-    # 0 for the whole-cache case)
-    offset = len_ref[0, 1]
+    cache_len = meta_ref[0]
+    offset = meta_ref[1]
+    kb_idx = meta_ref[2] + kj  # grid step kj streams cache block kb_idx
 
     @pl.when(kj == 0)
     def _init():
@@ -66,7 +77,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, *rest, scale: float,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(offset + kj * block_k < cache_len)
+    @pl.when(offset + kb_idx * block_k < cache_len)
     def _compute():
         q = q_ref[0]                                 # [gp, D]
         if quant:
@@ -79,7 +90,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, *rest, scale: float,
             s = jax.lax.dot_general(
                 q, k_ref[0], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-        k_pos = offset + kj * block_k + jax.lax.broadcasted_iota(
+        k_pos = offset + kb_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)                   # GLOBAL positions
         keep = k_pos < cache_len
         if window is not None:
@@ -187,9 +198,23 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     g = h // h_kv
     gp = -(-g // 8) * 8  # pad the group to the 8-row sublane tile
     block_k = _pick_block_k(s, block_k)
-    num_kb = s // block_k
+    num_kb_full = s // block_k
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+
+    cache_len = jnp.asarray(cache_len, jnp.int32)
+    offset = jnp.asarray(pos_offset, jnp.int32)
+    if window is None:
+        nb = num_kb_full
+        start_block = jnp.int32(0)
+    else:
+        # grid trimming: only blocks intersecting the window's GLOBAL
+        # span [cache_len - window, cache_len) are streamed — a windowed
+        # decode reads ~window positions, not the whole cache
+        nb = min(num_kb_full, -(-window // block_k) + 1)
+        start_block = jnp.clip(
+            (cache_len - window - offset) // block_k, 0, num_kb_full - nb)
+    meta = jnp.stack([cache_len, offset, start_block])
 
     # [B, 1, H, D] -> [B·Hkv, gp, D]
     q3 = q.reshape(b, h_kv, g, d)
@@ -197,19 +222,17 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     q3 = q3.reshape(b * h_kv, gp, d)
     k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
     v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
-    len_arg = jnp.stack([
-        jnp.asarray(cache_len, jnp.int32),
-        jnp.asarray(pos_offset, jnp.int32)]).reshape(1, 2)
 
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0))
+    # index maps see the prefetched meta first: grid step j streams cache
+    # block meta[2] + j
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j, m: (g_, m[2] + j, 0))
     # scales as [B·Hkv, 1, S]: the sequence dim rides the LANE axis so a
     # block is a dense [1, block_k] row, not a strided [block_k, 1]
     # column (measured 2× on the whole kernel)
-    sc_spec = pl.BlockSpec((1, 1, block_k), lambda g_, j: (g_, 0, j))
-    args = [len_arg, q3, k3]
+    sc_spec = pl.BlockSpec((1, 1, block_k), lambda g_, j, m: (g_, 0, m[2] + j))
+    args = [meta, q3, k3]
     in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0)),
+        pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0)),
         kv_spec,
     ]
     if quant:
@@ -221,26 +244,30 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         args.append(v_scale[..., 0].swapaxes(1, 2).reshape(b * h_kv, 1, s))
         in_specs.append(sc_spec)
 
-    out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j: (g_, 0, 0))]
+    out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
     if return_lse:
-        out_specs.append(pl.BlockSpec((1, 1, gp), lambda g_, j: (g_, 0, 0)))
+        out_specs.append(
+            pl.BlockSpec((1, 1, gp), lambda g_, j, m: (g_, 0, 0)))
         out_shape.append(
             jax.ShapeDtypeStruct((b * h_kv, 1, gp), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=d ** -0.5, block_k=block_k,
-            num_kb=num_kb, window=window, with_lse=return_lse,
+            num_kb=nb, window=window, with_lse=return_lse,
             quant=quant),
-        grid=(b * h_kv, num_kb),
-        in_specs=in_specs,
-        out_specs=out_specs if return_lse else out_specs[0],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h_kv, nb),
+            in_specs=in_specs,
+            out_specs=out_specs if return_lse else out_specs[0],
+            scratch_shapes=[
+                pltpu.VMEM((gp, 1), jnp.float32),
+                pltpu.VMEM((gp, 1), jnp.float32),
+                pltpu.VMEM((gp, d), jnp.float32),
+            ],
+        ),
         out_shape=out_shape if return_lse else out_shape[0],
-        scratch_shapes=[
-            pltpu.VMEM((gp, 1), jnp.float32),
-            pltpu.VMEM((gp, 1), jnp.float32),
-            pltpu.VMEM((gp, d), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
