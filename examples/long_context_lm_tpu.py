@@ -58,9 +58,25 @@ def main(argv=None) -> float:
                         help="after training, greedy-decode this many "
                              "tokens through the flash-decode serving path "
                              "(one-shot prefill + per-token kernel steps)")
+    parser.add_argument("--speculative", default=0, type=int, metavar="K",
+                        help="with --generate (plain dp only): decode "
+                             "via draft/verify speculative decoding — a "
+                             "1-layer draft trained on the same stream "
+                             "proposes K tokens per verify round")
     args = parser.parse_args(argv)
     if args.sp > 1 and args.tp > 1:
         parser.error("--sp and --tp are separate strategies; pick one")
+    if args.speculative > 0:
+        if args.tp > 1 or args.sp > 1:
+            parser.error("--speculative is a single-program rollout; it "
+                         "does not compose with --tp/--sp serving")
+        # verify chunks write K-1 slots past the last emitted token; the
+        # prompt must keep that headroom in the cache
+        if args.generate + args.speculative - 1 >= args.seq_len:
+            parser.error(
+                f"--generate {args.generate} + --speculative "
+                f"{args.speculative} - 1 must leave room for a prompt "
+                f"inside max_seq_len ({args.seq_len})")
 
     import jax
     import jax.numpy as jnp
@@ -198,8 +214,11 @@ def main(argv=None) -> float:
         if args.generate >= cfg.max_seq_len:
             parser.error(f"--generate must be < max_seq_len "
                          f"({cfg.max_seq_len}); got {args.generate}")
-        prompt_len = max(1, min(args.seq_len // 4,
-                                cfg.max_seq_len - args.generate))
+        # speculative verify chunks write up to K-1 slots past the last
+        # emitted token, so leave that headroom in the cache
+        prompt_len = max(1, min(
+            args.seq_len // 4,
+            cfg.max_seq_len - args.generate - max(args.speculative - 1, 0)))
         prompt = jnp.asarray(tokens[:2, :prompt_len])
         t0 = time.time()
         # params stay on device: the tp path is ALREADY in the Megatron
@@ -218,6 +237,46 @@ def main(argv=None) -> float:
                 cfg, state.params, prompt, args.generate, mesh,
                 decode_attention="flash", stop_tokens=[0])
             serve = f"sp{args.sp} flash"
+        elif args.speculative > 0:
+            # draft/verify speculative decoding: a 1-layer draft trained
+            # briefly on the same stream proposes K tokens per round; the
+            # target verifies them in one chunked forward and its output
+            # distribution is preserved exactly
+            from tpudist.models.speculative import speculative_generate
+
+            draft_cfg = TransformerConfig(
+                vocab_size=cfg.vocab_size, num_layers=1,
+                num_heads=cfg.num_heads, embed_dim=cfg.embed_dim // 2,
+                max_seq_len=cfg.max_seq_len,
+                compute_dtype=cfg.compute_dtype)
+            draft_model = TransformerLM(draft_cfg)
+            d_params = draft_model.init(
+                jax.random.key(1), tokens[:1, :64])["params"]
+            d_opt = optax.adam(args.lr)
+            d_opt_state = d_opt.init(d_params)
+
+            @jax.jit
+            def d_step(p, o):
+                def lf(p):
+                    logits = draft_model.apply({"params": p}, tokens)
+                    return cross_entropy(
+                        logits[:, :-1].reshape(-1, args.vocab),
+                        tokens[:, 1:].reshape(-1))
+                loss, g = jax.value_and_grad(lf)(p)
+                upd, o = d_opt.update(g, o)
+                return optax.apply_updates(p, upd), o, loss
+
+            for _ in range(max(args.steps // 2, 5)):
+                d_params, d_opt_state, d_loss = d_step(d_params, d_opt_state)
+            out, lengths, stats = speculative_generate(
+                cfg, state.params, draft_cfg, d_params, prompt,
+                args.generate, num_draft=args.speculative,
+                decode_attention="flash", draft_decode_attention="flash",
+                stop_tokens=[0], return_stats=True)
+            rounds = max(int(stats["rounds"]), 1)
+            serve = (f"speculative K={args.speculative} (draft loss "
+                     f"{float(d_loss):.3f}, accept rate "
+                     f"{int(stats['draft_accepted']) / (rounds * args.speculative):.2f})")
         else:
             out, lengths = greedy_generate(
                 cfg, state.params, prompt, args.generate,

@@ -89,6 +89,7 @@ def test_long_context_lm_twin(extra):
     [],                               # single-program flash serving
     ["--tp", "2"],                    # head-sharded serving (tp_generate)
     ["--sp", "2", "--attn", "ulysses"],  # seq-sharded serving (sp_generate)
+    ["--speculative", "3"],           # draft/verify speculative decoding
 ])
 def test_long_context_lm_generation_demo(extra):
     """The serving demo end-to-end: flash prefill + decode with EOS

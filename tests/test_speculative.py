@@ -1,0 +1,197 @@
+"""Speculative decoding: exactness, distribution correctness, EOS, stats.
+
+The load-bearing properties:
+
+* greedy speculative decoding emits BIT-IDENTICAL tokens to plain greedy
+  decoding of the target, for ANY draft (acceptance only changes speed);
+* the accept/resample rule's output distribution is exactly the
+  target's (Monte-Carlo against the analytic categorical);
+* cache rollback keeps later rounds consistent (covered implicitly by
+  the equivalence tests: a bad rollback diverges after the first
+  rejection).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.generate import greedy_generate, sample_generate
+from tpudist.models.speculative import (
+    _accept_and_next,
+    speculative_generate,
+)
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+
+def _make(cfg, seed):
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.key(seed),
+        jnp.zeros((1, 2), jnp.int32))["params"]
+    return params
+
+
+TARGET_CFG = TransformerConfig(
+    vocab_size=64, num_layers=2, num_heads=4, embed_dim=64,
+    max_seq_len=96)
+DRAFT_CFG = TransformerConfig(
+    vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+    max_seq_len=96)
+
+
+class TestGreedyExactness:
+    @pytest.mark.parametrize("num_draft", [1, 3, 4])
+    def test_matches_greedy_any_draft(self, num_draft):
+        """An UNRELATED random draft (acceptance ~ chance) must still
+        reproduce the target's greedy tokens exactly — the accept rule
+        plus rollback, not draft quality, carries correctness."""
+        tp = _make(TARGET_CFG, 0)
+        dp = _make(DRAFT_CFG, 1)
+        prompt = jax.random.randint(jax.random.key(2), (3, 5), 0, 64)
+        want = greedy_generate(TARGET_CFG, tp, prompt, 20)
+        got = speculative_generate(
+            TARGET_CFG, tp, DRAFT_CFG, dp, prompt, 20,
+            num_draft=num_draft)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_matches_greedy_draft_is_target(self):
+        """With draft == target every draft is accepted; output is still
+        exactly greedy, and the stats confirm full acceptance."""
+        tp = _make(TARGET_CFG, 0)
+        prompt = jax.random.randint(jax.random.key(3), (2, 4), 0, 64)
+        want = greedy_generate(TARGET_CFG, tp, prompt, 24)
+        got, stats = speculative_generate(
+            TARGET_CFG, tp, TARGET_CFG, tp, prompt, 24, num_draft=4,
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        rounds = int(stats["rounds"])
+        assert int(stats["draft_accepted"]) == 4 * rounds
+        # full acceptance advances 5 tokens/round: ceil(23 / 5) rounds
+        # after the prefill token
+        assert rounds == -(-23 // 5)
+
+    def test_jittable(self):
+        tp = _make(TARGET_CFG, 0)
+        dp = _make(DRAFT_CFG, 1)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        fn = jax.jit(lambda t, d, p: speculative_generate(
+            TARGET_CFG, t, DRAFT_CFG, d, p, 12, num_draft=3))
+        want = greedy_generate(TARGET_CFG, tp, prompt, 12)
+        np.testing.assert_array_equal(
+            np.asarray(fn(tp, dp, prompt)), np.asarray(want))
+
+
+class TestAcceptRule:
+    def test_output_distribution_is_target(self):
+        """Monte-Carlo: for fixed p != q, the emitted token at the first
+        position (accepted draft or residual resample) must follow p
+        exactly — the core speculative-sampling identity."""
+        v = 8
+        key = jax.random.key(0)
+        p_row = jax.nn.softmax(jax.random.normal(jax.random.key(1), (v,)))
+        q_row = jax.nn.softmax(
+            jax.random.normal(jax.random.key(2), (v,)) * 1.5)
+        n = 200_000
+        # one draft position (K=1), n independent rows
+        p = jnp.broadcast_to(p_row, (n, 2, v))  # [B, K+1, V]
+        q = jnp.broadcast_to(q_row, (n, 1, v))  # [B, K, V]
+        k1, k2 = jax.random.split(key)
+        draft = jax.random.categorical(
+            k1, jnp.log(q_row), shape=(n, 1))
+
+        # Evaluate the rule row-wise (batch size 1 per row, so the
+        # lockstep batch-min is the row's own acceptance).  The FIRST
+        # emitted token is the draft when accepted, the residual
+        # resample otherwise — that is the token whose law must be p.
+        def one(pr, qr, dr, kk):
+            _, e, acc = _accept_and_next(pr[None], qr[None], dr[None], kk)
+            return jnp.where(acc[0] > 0, dr[0], e[0])
+
+        keys = jax.random.split(k2, n)
+        first_tok = jax.vmap(one)(p, q, draft, keys)
+        counts = np.bincount(np.asarray(first_tok), minlength=v) / n
+        np.testing.assert_allclose(counts, np.asarray(p_row), atol=0.006)
+
+    def test_greedy_rule(self):
+        """Zero-temperature (one-hot) p/q: accept iff draft == target
+        argmax, emit the target argmax on rejection."""
+        v = 6
+        p_tok, q_tok = 2, 4
+        p = jnp.broadcast_to(jax.nn.one_hot(p_tok, v), (1, 2, v))
+        q = jnp.broadcast_to(jax.nn.one_hot(q_tok, v), (1, 1, v))
+        # draft proposes q's argmax (4) which is NOT p's argmax (2)
+        m, emit, accepted = _accept_and_next(
+            p, q, jnp.array([[q_tok]]), jax.random.key(0))
+        assert int(m) == 0 and int(accepted[0]) == 0
+        assert int(emit[0]) == p_tok
+        # and acceptance when they agree
+        q2 = jnp.broadcast_to(jax.nn.one_hot(p_tok, v), (1, 1, v))
+        m, emit, accepted = _accept_and_next(
+            p, q2, jnp.array([[p_tok]]), jax.random.key(0))
+        assert int(m) == 1 and int(accepted[0]) == 1
+        # bonus token after full acceptance: p[:, K] argmax
+        assert int(emit[0]) == p_tok
+
+
+class TestSampling:
+    def test_sampled_rollout_plausible(self):
+        """Sampled speculative rollout: tokens are valid, vary with the
+        key, and with draft == target the acceptance is total (sampling
+        from identical distributions accepts with probability 1)."""
+        tp = _make(TARGET_CFG, 0)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        toks, stats = speculative_generate(
+            TARGET_CFG, tp, TARGET_CFG, tp, prompt, 16, num_draft=4,
+            temperature=1.0, key=jax.random.key(7), return_stats=True)
+        assert toks.shape == (2, 20)
+        assert int(stats["draft_accepted"]) == 4 * int(stats["rounds"])
+        toks2 = speculative_generate(
+            TARGET_CFG, tp, TARGET_CFG, tp, prompt, 16, num_draft=4,
+            temperature=1.0, key=jax.random.key(8))
+        assert not np.array_equal(np.asarray(toks), np.asarray(toks2))
+
+    def test_matches_vocab_range(self):
+        tp = _make(TARGET_CFG, 0)
+        dp = _make(DRAFT_CFG, 1)
+        prompt = jnp.ones((2, 3), jnp.int32)
+        toks = speculative_generate(
+            TARGET_CFG, tp, DRAFT_CFG, dp, prompt, 10, num_draft=2,
+            temperature=0.8, top_k=8, key=jax.random.key(1))
+        t = np.asarray(toks)
+        assert t.min() >= 0 and t.max() < 64
+
+
+class TestStopTokens:
+    def test_eos_freezes_and_lengths(self):
+        tp = _make(TARGET_CFG, 0)
+        dp = _make(DRAFT_CFG, 1)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        plain, plain_len = greedy_generate(
+            TARGET_CFG, tp, prompt, 16, stop_tokens=(3,), pad_token=0)
+        spec, spec_len = speculative_generate(
+            TARGET_CFG, tp, DRAFT_CFG, dp, prompt, 16, num_draft=3,
+            stop_tokens=(3,), pad_token=0)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+        np.testing.assert_array_equal(
+            np.asarray(spec_len), np.asarray(plain_len))
+
+
+class TestValidation:
+    def test_vocab_mismatch(self):
+        bad = TransformerConfig(vocab_size=32, max_seq_len=96)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(
+                TARGET_CFG, None, bad, None, jnp.ones((1, 2), jnp.int32), 4)
+
+    def test_too_long(self):
+        with pytest.raises(ValueError, match="max_seq_len"):
+            speculative_generate(
+                TARGET_CFG, None, DRAFT_CFG, None,
+                jnp.ones((1, 90), jnp.int32), 8)
+
+    def test_bad_num_draft(self):
+        with pytest.raises(ValueError, match="num_draft"):
+            speculative_generate(
+                TARGET_CFG, None, DRAFT_CFG, None,
+                jnp.ones((1, 2), jnp.int32), 4, num_draft=0)

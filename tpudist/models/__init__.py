@@ -17,6 +17,7 @@ from tpudist.models.generate import (
     tp_sp_generate,
 )
 from tpudist.models.mlp import MLP
+from tpudist.models.speculative import speculative_generate
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
 from tpudist.models.resnet import ResNet50, resnet50_stages
 from tpudist.models.transformer import (
@@ -39,6 +40,7 @@ __all__ = [
     "greedy_generate",
     "sample_generate",
     "sp_generate",
+    "speculative_generate",
     "tp_generate",
     "tp_sp_generate",
     "resnet50_stages",

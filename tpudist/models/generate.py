@@ -46,6 +46,35 @@ def sequence_lengths(generated: jnp.ndarray, stop_arr: jnp.ndarray,
     return prompt_len + jnp.sum(strictly_after == 0, axis=1)
 
 
+def _blank_cache(model, batch: int):
+    """Fresh zeroed KV cache for ``model`` (cache_index 0, empty slots);
+    shapes via ``eval_shape`` — no FLOPs, no throwaway params."""
+    struct = jax.eval_shape(
+        model.init, jax.random.key(0), jnp.zeros((batch, 1), jnp.int32),
+        positions=jnp.zeros((batch, 1), jnp.int32))["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), struct)
+
+
+def _prefill(model, params, cache, prompt: jnp.ndarray,
+             prefill_chunk: int | None):
+    """Ingest the prompt into the cache in chunks of ``prefill_chunk``
+    tokens (None = one shot), each attending causally over everything
+    cached so far.  Returns ``(cache, last-chunk logits)`` — the serving
+    split's prompt half, shared by the plain and speculative rollouts."""
+    prompt_len = prompt.shape[1]
+    chunk = prompt_len if prefill_chunk is None else min(
+        prefill_chunk, prompt_len)
+    for lo in range(0, prompt_len, chunk):
+        piece = prompt[:, lo:lo + chunk]
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, piece,
+            positions=jnp.arange(lo, lo + piece.shape[1])[None, :],
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+    return cache, logits
+
+
 def _rollout(
     cfg: TransformerConfig,
     params: Any,
@@ -89,13 +118,7 @@ def _rollout(
             f"max_seq_len {cfg.max_seq_len}")
     model = TransformerLM(cfg, decode=True, decode_attention=decode_attention,
                           decode_shard=decode_shard)
-    # Cache shapes via eval_shape (no FLOPs, no throwaway params), zeros =
-    # a blank cache (cache_index 0, empty slots).
-    cache_struct = jax.eval_shape(
-        model.init, jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
-        positions=jnp.zeros((b, 1), jnp.int32))["cache"]
-    cache = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_struct)
+    cache = _blank_cache(model, b)
     if cache_constraint is not None:
         cache = jax.tree.map(
             lambda x: (x if cache_constraint(x) is None
@@ -107,16 +130,7 @@ def _rollout(
     # PREFILL: the prompt through batched forwards (the serving split — at
     # long context this is the difference between streaming the cache once
     # per prompt TOKEN and once per prompt) ...
-    chunk = prompt_len if prefill_chunk is None else min(
-        prefill_chunk, prompt_len)
-    for lo in range(0, prompt_len, chunk):
-        piece = prompt[:, lo:lo + chunk]
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache}, piece,
-            positions=jnp.arange(lo, lo + piece.shape[1])[None, :],
-            mutable=["cache"],
-        )
-        cache = mutated["cache"]
+    cache, logits = _prefill(model, params, cache, prompt, prefill_chunk)
     first = select(logits[:, -1], keys[0]).astype(jnp.int32)
     done0 = (_is_stop(first, stop_arr) if stop_arr is not None
              else jnp.zeros((b,), bool))
@@ -443,6 +457,22 @@ def sample_generate(
                     pad_token=pad_token)
 
 
+def _filtered_logits(logits: jnp.ndarray, temperature: float,
+                     top_k: Optional[int],
+                     top_p: Optional[float]) -> jnp.ndarray:
+    """The scale-then-top_k-then-top_p pipeline, in ONE place: both the
+    rollout samplers (`_make_select`) and the speculative accept rule
+    (`speculative._filtered_probs`) consume it — speculative sampling is
+    distribution-exact only while the two see the SAME filtered
+    categorical.  Requires ``temperature > 0``."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        logits = top_k_filter(logits, top_k)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
+    return logits
+
+
 def _make_select(temperature: float, top_k: Optional[int],
                  top_p: Optional[float]) -> SelectFn:
     """Validated token-selection fn shared by the local and sharded
@@ -455,14 +485,10 @@ def _make_select(temperature: float, top_k: Optional[int],
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     def select(logits: jnp.ndarray, step_key: jax.Array) -> jnp.ndarray:
-        logits = logits.astype(jnp.float32)
         if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k is not None:
-            logits = top_k_filter(logits, top_k)
-        if top_p is not None:
-            logits = top_p_filter(logits, top_p)
-        return jax.random.categorical(step_key, logits, axis=-1)
+            return jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        return jax.random.categorical(
+            step_key, _filtered_logits(logits, temperature, top_k, top_p),
+            axis=-1)
 
     return select

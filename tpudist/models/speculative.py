@@ -1,0 +1,329 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+Decode at long context is HBM-bandwidth-bound — each generated token
+streams the whole KV cache once (measured 668-739 GB/s, 82-90% of the
+v5e's spec, in `tpudist/ops/flash_decode.py`).  Speculative decoding
+attacks exactly that bound: a cheap DRAFT model autoregressively
+proposes ``num_draft`` tokens, then the TARGET model scores all of them
+in ONE chunked forward (its cache is streamed once per *round*, not once
+per token).  Accepted prefixes keep the target's exact output
+distribution — greedy speculative decoding emits the target's own greedy
+tokens (bit-identical whenever the verify-chunk and per-token decode
+paths produce identical logits, as in f32; in bf16 a near-tie argmax can
+flip across the two attention kernels), and sampled speculative decoding
+emits tokens whose distribution is exactly the target's, by the standard
+accept/resample argument (accept draft token x with probability
+min(1, p(x)/q(x)); on rejection resample from norm(max(p-q, 0))).
+
+TPU-shaped design decisions:
+
+* The whole loop is ONE compiled ``lax.while_loop`` — fixed-shape draft
+  scans, fixed-shape verify chunks, a fixed-capacity output buffer
+  written with ``dynamic_update_slice``.  No per-token host round trips.
+* Cache rollback is O(1): the flax cache masks by its scalar
+  ``cache_index`` and every write lands at an explicit index, so
+  rejecting draft tokens = resetting the index (stale slots are masked
+  now and overwritten later).  No cache copies.
+* Batched rollouts stay in LOCKSTEP: every row advances by the same
+  ``m + 1`` tokens per round, where ``m`` is the BATCH-MIN accepted
+  prefix length.  Rows that accepted more simply re-draft from the
+  shorter prefix next round — per-row output distributions are
+  unchanged (a prefix of an accepted prefix is accepted), and uniform
+  advancement keeps the scalar cache index / static output offsets.
+  Acceptance-rate throughput therefore degrades with batch; batch 1-8
+  with a well-matched draft is the intended regime.
+
+Reference scope note: the reference suite is training-only
+(SURVEY.md §2 — no inference path anywhere); this module extends the
+serving story that `tpudist/models/generate.py` opens.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudist.models.generate import (
+    _blank_cache,
+    _filtered_logits,
+    _is_stop,
+    _make_select,
+    _prefill,
+    _stop_array,
+    sequence_lengths,
+)
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+
+def _filtered_probs(logits: jnp.ndarray, temperature: float,
+                    top_k: Optional[int], top_p: Optional[float]):
+    """The (possibly filtered) categorical the rollout samples from, as
+    PROBABILITIES — the quantity the accept/resample rule needs on both
+    the draft and target sides.  Exactness requires this to be the SAME
+    distribution ``_make_select`` samples, so the filtering pipeline is
+    the shared :func:`tpudist.models.generate._filtered_logits`.
+    ``temperature == 0`` returns the argmax one-hot (greedy is the
+    zero-temperature limit of the same rule)."""
+    if temperature == 0.0:
+        logits = logits.astype(jnp.float32)
+        return jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32)
+    return jax.nn.softmax(
+        _filtered_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _accept_and_next(p: jnp.ndarray, q: jnp.ndarray, draft: jnp.ndarray,
+                     key: jax.Array):
+    """The speculative accept/advance rule for one round, batched.
+
+    Args:
+      p: ``[B, K+1, V]`` target probabilities — ``p[:, j]`` is the
+        target's next-token distribution AFTER draft token j (``p[:, 0]``
+        conditions on the round's input token only; ``p[:, K]`` is the
+        bonus position after all K drafts).
+      q: ``[B, K, V]`` draft probabilities — ``q[:, j]`` is the
+        distribution draft token ``draft[:, j]`` was sampled from.
+      draft: ``[B, K]`` proposed tokens.
+      key: randomness for accept tests and residual resampling.
+
+    Returns ``(m, emit, accepted)``: the batch-min accepted prefix
+    length ``m`` (scalar int32, 0..K), the ``[B]`` token to emit at
+    position ``m + 1`` (accepted draft for rows whose acceptance reached
+    past ``m``, a residual/bonus resample otherwise), and the ``[B]``
+    per-row accepted counts (for telemetry).
+
+    Output-distribution exactness is the standard argument, applied at
+    position ``m + 1``: rows with ``accepted > m`` passed the accept
+    test for draft ``m+1`` (keep it); rows with ``accepted == m``
+    rejected there (resample from ``norm(max(p - q, 0))``); when
+    ``m == K`` every row accepted everything and the emit is a pure
+    sample of ``p[:, K]`` — which is the ``q = 0`` degenerate case of
+    the same residual formula, so one code path serves both.
+    """
+    b, k = draft.shape
+    u_key, r_key = jax.random.split(key)
+    p_at_draft = jnp.take_along_axis(
+        p[:, :k], draft[..., None], axis=-1)[..., 0]         # [B, K]
+    q_at_draft = jnp.take_along_axis(
+        q, draft[..., None], axis=-1)[..., 0]                # [B, K]
+    u = jax.random.uniform(u_key, (b, k))
+    # Greedy (one-hot p/q) reduces to: accept iff the draft token IS the
+    # target argmax — p_at_draft is 1 or 0 and u < 1 almost surely.
+    ok = u * jnp.maximum(q_at_draft, 1e-20) < p_at_draft     # [B, K]
+    cum_ok = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    accepted = jnp.sum(cum_ok, axis=1)                       # [B] in 0..K
+    m = jnp.min(accepted)
+
+    # q padded with a zero row at index K: the all-accepted bonus position
+    # resamples from norm(max(p - 0, 0)) = p itself.
+    q_pad = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+    p_m = lax.dynamic_index_in_dim(p, m, axis=1, keepdims=False)
+    q_m = lax.dynamic_index_in_dim(q_pad, m, axis=1, keepdims=False)
+    residual = jnp.maximum(p_m - q_m, 0.0)
+    # all-zero residual can only arise when p == q (any draft sample is
+    # accepted with probability 1, so rejection there has probability 0);
+    # guard anyway so the categorical never sees -inf everywhere
+    residual = jnp.where(
+        jnp.sum(residual, axis=-1, keepdims=True) > 0, residual, p_m)
+    resampled = jax.random.categorical(
+        r_key, jnp.log(jnp.maximum(residual, 1e-38)), axis=-1)
+
+    # rows whose acceptance reached PAST m keep draft token m+1 (only
+    # possible when m < K; at m == K the gather index clamps but the
+    # take-branch is all-False)
+    took_next = accepted > m
+    next_draft = lax.dynamic_index_in_dim(
+        draft, jnp.minimum(m, k - 1), axis=1, keepdims=False)
+    emit = jnp.where(took_next, next_draft, resampled).astype(jnp.int32)
+    return m, emit, accepted
+
+
+def _set_cache_index(cache: Any, idx: jnp.ndarray) -> Any:
+    """Roll the cache to ``idx`` tokens: every scalar ``cache_index``
+    leaf is reset (K/V buffers are left as-is — slots past the index are
+    masked by every cached-attention path and overwritten on the next
+    write at that position)."""
+    return jax.tree.map(
+        lambda leaf: (jnp.full_like(leaf, idx) if leaf.ndim == 0 else leaf),
+        cache)
+
+
+def speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    num_draft: int = 4,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    decode_attention: str = "dense",
+    draft_decode_attention: str = "dense",
+    prefill_chunk: int | None = None,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+    return_stats: bool = False,
+):
+    """Generate ``max_new_tokens`` past ``prompt`` with draft/verify
+    speculative decoding.
+
+    Args:
+      target_cfg / target_params: the model whose output distribution the
+        result follows exactly.
+      draft_cfg / draft_params: the proposal model.  Only
+        ``vocab_size`` must match the target; it may be arbitrarily
+        smaller/shallower and may use sliding-window attention
+        (``attention_window``) so its own cache streaming stays cheap at
+        long context.
+      num_draft: draft tokens proposed per verify round (the classic K).
+      temperature / top_k / top_p: sampling controls, applied to BOTH
+        models' distributions (temperature 0 = greedy: output matches
+        :func:`tpudist.models.generate.greedy_generate` of the target —
+        bit-identical when both paths' logits agree bitwise; bf16
+        near-tie argmaxes can flip between the chunked-verify and
+        single-token attention kernels).
+      decode_attention / draft_decode_attention: cached-attention
+        implementation per model ("dense" or "flash"); the target only
+        ever runs chunk forwards (prefill path), the draft runs
+        single-token steps.
+      prefill_chunk: bound prompt-prefill memory, as in ``_rollout``.
+      stop_tokens / pad_token: EOS semantics as elsewhere — positions
+        past a sequence's first stop token freeze to ``pad_token`` and
+        per-sequence lengths are returned.
+      return_stats: also return ``{"rounds", "draft_accepted"}``
+        (scalars; ``draft_accepted`` counts ACCEPTED draft tokens summed
+        over rounds — acceptance rate = draft_accepted / (rounds·K);
+        emitted tokens additionally include one verify token per round).
+
+    Returns ``[B, prompt_len + max_new_tokens]`` tokens, with
+    ``(tokens, lengths)`` when ``stop_tokens`` is given, and the stats
+    dict appended when ``return_stats`` is set.
+    """
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}")
+    if num_draft < 1:
+        raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+    b, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must hold at least one token")
+    k = num_draft
+    # the verify chunk writes up to K cache slots past the last emitted
+    # token, so both caches need headroom beyond prompt+max_new
+    need = prompt_len + max_new_tokens + k - 1
+    for name, cfg in (("target", target_cfg), ("draft", draft_cfg)):
+        if need > cfg.max_seq_len:
+            raise ValueError(
+                f"{name} max_seq_len {cfg.max_seq_len} < prompt_len + "
+                f"max_new_tokens + num_draft - 1 = {need}")
+    stop_arr = _stop_array(stop_tokens)
+    select = _make_select(temperature, top_k, top_p)
+    if key is None:
+        key = jax.random.key(0)
+
+    target = TransformerLM(target_cfg, decode=True,
+                           decode_attention=decode_attention)
+    draft = TransformerLM(draft_cfg, decode=True,
+                          decode_attention=draft_decode_attention)
+
+    # PREFILL both models on the prompt (the shared serving split)
+    t_cache, t_logits = _prefill(
+        target, target_params, _blank_cache(target, b), prompt,
+        prefill_chunk)
+    d_cache, _ = _prefill(
+        draft, draft_params, _blank_cache(draft, b), prompt, prefill_chunk)
+    key, k0 = jax.random.split(key)
+    first = select(t_logits[:, -1], k0).astype(jnp.int32)
+
+    cap = max_new_tokens + k + 1
+    out0 = jnp.zeros((b, cap), jnp.int32)
+    out0 = lax.dynamic_update_slice(out0, first[:, None], (0, 0))
+
+    def round_body(carry):
+        t_cache, d_cache, x, emitted, out, key, rounds, acc_total = carry
+        n_cache = prompt_len + emitted - 1  # tokens resident in caches
+        key, dk, vk = jax.random.split(key, 3)
+
+        # DRAFT: K single-token proposals with their distributions (then
+        # one extra write so the draft cache holds d_K for the
+        # all-accepted case)
+        def chain(carry, inp):
+            j, step_key = inp
+            cache, tok = carry
+            logits, mut = draft.apply(
+                {"params": draft_params, "cache": cache}, tok[:, None],
+                positions=jnp.full((b, 1), n_cache + j, jnp.int32),
+                mutable=["cache"])
+            q_probs = _filtered_probs(
+                logits[:, -1], temperature, top_k, top_p)
+            nxt = select(logits[:, -1], step_key).astype(jnp.int32)
+            return (mut["cache"], nxt), (nxt, q_probs)
+
+        d_keys = jax.random.split(dk, k)
+        (d_cache2, d_last), (drafts_t, q_t) = lax.scan(
+            chain, (d_cache, x), (jnp.arange(k), d_keys))
+        drafts = drafts_t.T                                   # [B, K]
+        q = jnp.moveaxis(q_t, 0, 1)                           # [B, K, V]
+        # write d_K into the draft cache (output token discarded)
+        _, mut = draft.apply(
+            {"params": draft_params, "cache": d_cache2}, d_last[:, None],
+            positions=jnp.full((b, 1), n_cache + k, jnp.int32),
+            mutable=["cache"])
+        d_cache2 = mut["cache"]
+
+        # VERIFY: one target forward over [x, d_1..d_K]
+        verify = jnp.concatenate([x[:, None], drafts], axis=1)  # [B, K+1]
+        positions = (n_cache + jnp.arange(k + 1))[None, :]
+        t_logits, mut = target.apply(
+            {"params": target_params, "cache": t_cache}, verify,
+            positions=positions, mutable=["cache"])
+        t_cache2 = mut["cache"]
+        p = _filtered_probs(t_logits, temperature, top_k, top_p)
+
+        m, emit, accepted = _accept_and_next(p, q, drafts, vk)
+
+        # emit e_1..e_{m+1}: the accepted drafts then the verify token —
+        # written as a full K+1 window (positions past m+1 are garbage,
+        # overwritten next round or trimmed at the end)
+        e_buf = jnp.concatenate([drafts, emit[:, None]], axis=1)
+        e_buf = lax.dynamic_update_slice(e_buf, emit[:, None], (0, m))
+        out = lax.dynamic_update_slice(out, e_buf, (0, emitted))
+
+        new_len = n_cache + m + 1
+        del accepted  # per-row counts; the lockstep advance is m
+        return (_set_cache_index(t_cache2, new_len),
+                _set_cache_index(d_cache2, new_len),
+                emit, emitted + m + 1, out, key,
+                rounds + 1, acc_total + m)
+
+    def cond(carry):
+        return carry[3] < max_new_tokens
+
+    carry = (t_cache, d_cache, first, jnp.int32(1), out0, key,
+             jnp.int32(0), jnp.int32(0))
+    if max_new_tokens > 1:
+        carry = lax.while_loop(cond, round_body, carry)
+    _, _, _, _, out, _, rounds, acc_total = carry
+    generated = out[:, :max_new_tokens]
+
+    if stop_arr is not None:
+        # EOS semantics as in _rollout: keep each row's first stop token,
+        # freeze everything after it to pad_token
+        hit = _is_stop(generated, stop_arr)
+        after_stop = (jnp.cumsum(hit, axis=1) - hit) > 0
+        generated = jnp.where(after_stop, jnp.int32(pad_token), generated)
+    tokens = jnp.concatenate([prompt, generated], axis=1)
+
+    result = (tokens,) if stop_arr is None else (
+        tokens, sequence_lengths(generated, stop_arr, prompt_len))
+    if return_stats:
+        result = result + ({"rounds": rounds, "draft_accepted": acc_total},)
+    return result[0] if len(result) == 1 else result

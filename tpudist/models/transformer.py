@@ -307,11 +307,19 @@ class CausalSelfAttention(nn.Module):
                 _auto_block, _flash_forward,
             )
 
-            # pad odd/short chunks to the 8-row sublane tile: an s of 3 or
-            # 10 would yield block_q < 8, which Mosaic can't lower on real
-            # TPU.  Padded query rows are causally garbage but independent
-            # of the real rows; they're sliced off below.
-            s_pad = -(-s // 8) * 8
+            # Pad the query-row count so _auto_block lands on a Mosaic-
+            # lowerable block: the LSE output's [1, 1, block_q] block
+            # needs block_q % 128 == 0 or block_q == s_pad, and q/out
+            # need the 8-row sublane tile.  Short chunks round up to a
+            # power of two (block = whole chunk); long ones to a multiple
+            # of 1024 so block_q is the measured-optimal 1024 (a prompt
+            # like 7928 = 8·991 would otherwise get block_q = 8, which
+            # real-TPU lowering rejects).  Padded rows are causally
+            # garbage but independent of the real rows; sliced off below.
+            if s <= 1024:
+                s_pad = max(8, 1 << (s - 1).bit_length())
+            else:
+                s_pad = -(-s // 1024) * 1024
             q_in = q if s_pad == s else jnp.pad(
                 q, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
             block_k = _auto_block(cfg.max_seq_len)
