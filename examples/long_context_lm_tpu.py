@@ -53,6 +53,12 @@ def main(argv=None) -> float:
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize block activations "
                              "(jax.checkpoint): HBM for FLOPs")
+    parser.add_argument("--scan-layers", action="store_true",
+                        help="compile the layer stack as one lax.scan "
+                             "over stacked params (HLO size and compile "
+                             "time stop scaling with --layers); plain "
+                             "dp only — the TP rules target the "
+                             "unrolled layout")
     parser.add_argument("--log-every", default=10, type=int)
     parser.add_argument("--generate", default=0, type=int,
                         help="after training, greedy-decode this many "
@@ -66,7 +72,13 @@ def main(argv=None) -> float:
     args = parser.parse_args(argv)
     if args.sp > 1 and args.tp > 1:
         parser.error("--sp and --tp are separate strategies; pick one")
+    if args.scan_layers and (args.tp > 1 or args.sp > 1):
+        parser.error("--scan-layers composes with plain dp only (the TP "
+                     "sharding rules and SP step target the unrolled "
+                     "param layout)")
     if args.speculative > 0:
+        if args.generate <= 0:
+            parser.error("--speculative requires --generate")
         if args.tp > 1 or args.sp > 1:
             parser.error("--speculative is a single-program rollout; it "
                          "does not compose with --tp/--sp serving")
@@ -114,6 +126,7 @@ def main(argv=None) -> float:
         vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
         embed_dim=args.embed_dim, max_seq_len=args.seq_len,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        scan_layers=args.scan_layers,
     )
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(
@@ -244,9 +257,13 @@ def main(argv=None) -> float:
             # distribution is preserved exactly
             from tpudist.models.speculative import speculative_generate
 
+            # halve width AND heads together so head_dim stays valid for
+            # any target config (embed_dim/2 with the target's head
+            # count would break divisibility, e.g. 24-dim 8-head)
             draft_cfg = TransformerConfig(
                 vocab_size=cfg.vocab_size, num_layers=1,
-                num_heads=cfg.num_heads, embed_dim=cfg.embed_dim // 2,
+                num_heads=max(1, cfg.num_heads // 2),
+                embed_dim=cfg.embed_dim // 2,
                 max_seq_len=cfg.max_seq_len,
                 compute_dtype=cfg.compute_dtype)
             draft_model = TransformerLM(draft_cfg)

@@ -73,6 +73,7 @@ def test_model_parallel_resnet50_twin():
     ["--sp", "4", "--attn", "ring"],                     # DP×SP ring
     ["--sp", "2", "--attn", "ulysses"],                  # DP×SP all-to-all
     ["--tp", "2", "--attn", "sdpa"],                     # DP×TP Megatron
+    ["--attn", "sdpa", "--scan-layers", "--remat"],      # scanned stack
 ])
 def test_long_context_lm_twin(extra):
     import long_context_lm_tpu

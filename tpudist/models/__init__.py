@@ -25,6 +25,8 @@ from tpudist.models.transformer import (
     TransformerLM,
     repeat_kv,
     sdpa,
+    stack_layer_params,
+    unstack_layer_params,
 )
 
 __all__ = [
@@ -45,4 +47,6 @@ __all__ = [
     "tp_sp_generate",
     "resnet50_stages",
     "sdpa",
+    "stack_layer_params",
+    "unstack_layer_params",
 ]

@@ -142,12 +142,14 @@ def _accept_and_next(p: jnp.ndarray, q: jnp.ndarray, draft: jnp.ndarray,
 
 
 def _set_cache_index(cache: Any, idx: jnp.ndarray) -> Any:
-    """Roll the cache to ``idx`` tokens: every scalar ``cache_index``
-    leaf is reset (K/V buffers are left as-is — slots past the index are
-    masked by every cached-attention path and overwritten on the next
-    write at that position)."""
+    """Roll the cache to ``idx`` tokens: every ``cache_index`` leaf is
+    reset (K/V buffers are left as-is — slots past the index are masked
+    by every cached-attention path and overwritten on the next write at
+    that position).  Index leaves are 0-D scalars in the unrolled layout
+    and [num_layers] vectors under ``cfg.scan_layers``; K/V buffers are
+    always >= 4-D, so dimensionality separates them."""
     return jax.tree.map(
-        lambda leaf: (jnp.full_like(leaf, idx) if leaf.ndim == 0 else leaf),
+        lambda leaf: (jnp.full_like(leaf, idx) if leaf.ndim <= 1 else leaf),
         cache)
 
 

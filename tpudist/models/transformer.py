@@ -155,6 +155,26 @@ class TransformerConfig:
     # The single source of truth: the training path passes it to the
     # attention_fn and the decode cache mask applies the same band.
     attention_window: int | None = None
+    # Compile the layer stack as ONE lax.scan over stacked parameters
+    # instead of a Python loop (the maxtext-style "scan over layers").
+    # The traced program holds one block body regardless of depth, so
+    # HLO size and compile time stop scaling with num_layers — which is
+    # what keeps deep-model rollouts under remote-compile size limits.
+    # Param layout changes from block{i}/... to blocks/block/... with a
+    # leading layer axis; convert with stack_layer_params /
+    # unstack_layer_params.  Lives on the config so every cache-decode
+    # rollout (generate / speculative) builds the matching model.
+    # NOTE: `transformer_tp_rules` targets the UNROLLED layout — its
+    # 2-D PartitionSpecs would land on the wrong axes of the stacked
+    # [L, in, out] kernels, so tp_generate/TP training take the
+    # unrolled layout (serving rollouts convert with
+    # unstack_layer_params if needed).  Single-token DECODE is ~4×
+    # slower scanned (measured): every scan step dynamic-slices its
+    # layer's cache from the stacked buffer and writes it back, ~3×
+    # extra HBM traffic per token — prefer the unrolled layout for
+    # plain decode latency; chunked verify forwards (speculative)
+    # amortize the cost and keep the compile-size win.
+    scan_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -387,6 +407,51 @@ class DecoderBlock(nn.Module):
         return x + MLPBlock(self.cfg, name="mlp")(h)
 
 
+class _ScanBody(nn.Module):
+    """One scanned step of the layer stack: wraps :class:`DecoderBlock`
+    with the ``(carry, x) -> (carry, y)`` signature ``nn.scan`` expects.
+    ``causal`` rides as a static attribute (it must not be traced)."""
+
+    cfg: TransformerConfig
+    attention_fn: AttentionFn
+    decode: bool
+    decode_attention: str
+    decode_shard: Any
+    causal: bool
+    remat: bool
+
+    @nn.compact
+    def __call__(self, x, _):
+        blk = (nn.remat(DecoderBlock, static_argnums=(2,)) if self.remat
+               else DecoderBlock)
+        x = blk(self.cfg, self.attention_fn, decode=self.decode,
+                decode_attention=self.decode_attention,
+                decode_shard=self.decode_shard,
+                name="block")(x, self.causal)
+        return x, None
+
+
+def stack_layer_params(params, num_layers: int):
+    """Convert unrolled-layout params (``block{i}/...``) to the
+    ``scan_layers`` layout (``blocks/block/...`` with a leading layer
+    axis) — e.g. to serve a model trained unrolled through a scanned
+    rollout.  Non-block leaves pass through unchanged."""
+    out = {k: v for k, v in params.items() if not k.startswith("block")}
+    blocks = [params[f"block{i}"] for i in range(num_layers)]
+    out["blocks"] = {
+        "block": jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)}
+    return out
+
+
+def unstack_layer_params(params, num_layers: int):
+    """Inverse of :func:`stack_layer_params`."""
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    stacked = params["blocks"]["block"]
+    for i in range(num_layers):
+        out[f"block{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return out
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM: tokens [B, S] int32 -> logits [B, S, vocab] f32.
 
@@ -422,13 +487,24 @@ class TransformerLM(nn.Module):
         # long-context training fit in HBM.  Default prevent_cse=True:
         # under plain jit XLA could otherwise CSE the recomputation back
         # into the stored forward and silently undo the memory savings.
-        block_cls = (nn.remat(DecoderBlock, static_argnums=(2,))
-                     if self.remat else DecoderBlock)
-        for i in range(cfg.num_layers):
-            x = block_cls(cfg, self.attention_fn, decode=self.decode,
-                          decode_attention=self.decode_attention,
-                          decode_shard=self.decode_shard,
-                          name=f"block{i}")(x, causal)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+            )
+            x, _ = scanned(cfg, self.attention_fn, self.decode,
+                           self.decode_attention, self.decode_shard,
+                           causal, self.remat, name="blocks")(x, None)
+        else:
+            block_cls = (nn.remat(DecoderBlock, static_argnums=(2,))
+                         if self.remat else DecoderBlock)
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, self.attention_fn, decode=self.decode,
+                              decode_attention=self.decode_attention,
+                              decode_shard=self.decode_shard,
+                              name=f"block{i}")(x, causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=cfg.compute_dtype, name="lm_head")(x)
