@@ -130,8 +130,10 @@ def _steady_rate(make_many, base_reps: int, n_win: int,
 def _chained_rate(step_fn, x0, base_reps: int, n_win: int):
     """Per-step time of ``step_fn`` via the LICM-proof chained scan
     (each iteration's input is perturbed by the previous output so XLA
-    cannot hoist the loop-invariant body), with RTT-adaptive reps.
-    The ONE copy of the timing idiom every per-op microbench shares.
+    cannot hoist the loop-invariant body), with RTT-adaptive reps — the
+    shared idiom for single-carry per-op microbenches (the flash
+    attention benches chain q against fixed k/v, so they build their
+    own scan bodies but still size reps through ``_steady_rate``).
     Returns (seconds/step, shadowed)."""
     import jax
     import jax.numpy as jnp
@@ -378,8 +380,7 @@ def bench_flash_attention(on_tpu: bool) -> None:
     seqs = (2048, 8192) if on_tpu else (256,)
     n_windows = 8 if on_tpu else 2
     for s in seqs:
-        # enough reps that kernel time dominates the (variable) tunnel RTT
-        reps = (400 if s <= 2048 else 100) if on_tpu else 2
+        base_reps = (400 if s <= 2048 else 100) if on_tpu else 2
         q, k, v = _flash_args(s, jnp.bfloat16 if on_tpu else jnp.float32)
         b, h, d = q.shape[0], q.shape[2], q.shape[3]
         # causal attention FLOPs: QK^T + PV, half the square
@@ -387,33 +388,35 @@ def bench_flash_attention(on_tpu: bool) -> None:
 
         # every scan iteration CHAINS its inputs from the previous one so
         # XLA's while-loop LICM cannot hoist the (otherwise invariant)
-        # kernel out and silently turn reps into 1
-        @jax.jit
-        def many_fwd(q, k, v):
-            def body(qc, _):
-                out = flash_attention(qc, k, v, causal=True)
-                return out.astype(qc.dtype), None
+        # kernel out and silently turn reps into 1; reps grow until the
+        # window clears the RTT (_steady_rate)
+        def make_many_fwd(r):
+            @jax.jit
+            def many(q, k, v):
+                def body(qc, _):
+                    out = flash_attention(qc, k, v, causal=True)
+                    return out.astype(qc.dtype), None
 
-            return jnp.sum(
-                lax.scan(body, q, None, length=reps)[0]
-                .astype(jnp.float32))
+                return jnp.sum(
+                    lax.scan(body, q, None, length=r)[0]
+                    .astype(jnp.float32))
 
-        float(many_fwd(q, k, v))
-        best, shadowed = _net(_best_window(
-            lambda: float(many_fwd(q, k, v)), n_windows, lambda: None))
-        tflops = fwd_flops * reps / best / 1e12
+            return lambda: float(many(q, k, v))
+
+        rate, _, shadowed = _steady_rate(make_many_fwd, base_reps, n_windows)
+        tflops = fwd_flops / rate / 1e12
         _emit("flash_attention_fwd", round(tflops, 1), "TFLOP/s", None,
               seq_len=s, mfu=_mfu(tflops), rtt_ms=round(_RTT * 1e3, 1),
               rtt_shadowed=shadowed)
 
-        train_reps = max(reps // 4, 2)
-        many_train = _flash_train_scan(train_reps, window=None)
-        float(many_train(q, k, v))
-        best, shadowed = _net(_best_window(
-            lambda: float(many_train(q, k, v)), n_windows, lambda: None))
+        def make_many_train(r):
+            many = _flash_train_scan(r, window=None)
+            return lambda: float(many(q, k, v))
+
+        rate, _, shadowed = _steady_rate(
+            make_many_train, max(base_reps // 4, 2), n_windows)
         # executed matmul FLOPs: fwd 2 half-squares + dQ pass 3 + dKV pass 4
-        train_flops = fwd_flops * 4.5
-        tflops = train_flops * train_reps / best / 1e12
+        tflops = fwd_flops * 4.5 / rate / 1e12
         _emit("flash_attention_train", round(tflops, 1), "TFLOP/s", None,
               seq_len=s, mfu=_mfu(tflops), rtt_ms=round(_RTT * 1e3, 1),
               rtt_shadowed=shadowed)
@@ -424,16 +427,16 @@ def bench_window_speedup(on_tpu: bool) -> None:
 
     s = 8192 if on_tpu else 256
     window = 1024 if on_tpu else 64
-    reps = 25 if on_tpu else 2
+    base_reps = 25 if on_tpu else 2
     n_windows = 6 if on_tpu else 2
     q, k, v = _flash_args(s, jnp.bfloat16 if on_tpu else jnp.float32)
 
     def timed(win):
-        many = _flash_train_scan(reps, window=win)
-        float(many(q, k, v))
-        best, _ = _net(_best_window(
-            lambda: float(many(q, k, v)), n_windows, lambda: None))
-        return best / reps
+        def make_many(r):
+            many = _flash_train_scan(r, window=win)
+            return lambda: float(many(q, k, v))
+
+        return _steady_rate(make_many, base_reps, n_windows)[0]
 
     full = timed(None)
     banded = timed(window)

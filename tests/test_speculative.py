@@ -177,6 +177,47 @@ class TestStopTokens:
             np.asarray(spec_len), np.asarray(plain_len))
 
 
+class TestTensorParallel:
+    def test_tp_speculative_matches_unsharded(self, devices8):
+        """TP target + replicated draft: same tokens as the unsharded
+        speculative rollout AND as plain greedy, dense and flash."""
+        from tpudist.models.speculative import tp_speculative_generate
+        from tpudist.runtime.mesh import make_mesh
+
+        tcfg = TransformerConfig(vocab_size=48, num_layers=2, num_heads=4,
+                                 num_kv_heads=2, embed_dim=32,
+                                 max_seq_len=48)
+        dcfg = TransformerConfig(vocab_size=48, num_layers=1, num_heads=2,
+                                 embed_dim=16, max_seq_len=48)
+        tp = TransformerLM(tcfg).init(
+            jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+        dp = TransformerLM(dcfg).init(
+            jax.random.key(1), jnp.zeros((1, 2), jnp.int32))["params"]
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(0, 48, (2, 6)), jnp.int32)
+        want = greedy_generate(tcfg, tp, prompt, 14)
+        mesh = make_mesh({"data": 4, "model": 2})
+        for attn in ("dense", "flash"):
+            got, stats = tp_speculative_generate(
+                tcfg, tp, dcfg, dp, prompt, 14, mesh, num_draft=3,
+                decode_attention=attn, return_stats=True)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=attn)
+            assert int(stats["rounds"]) >= 1
+
+    def test_tp_speculative_rejects_indivisible_heads(self, devices8):
+        from tpudist.models.speculative import tp_speculative_generate
+        from tpudist.runtime.mesh import make_mesh
+
+        tcfg = TransformerConfig(vocab_size=48, num_layers=1, num_heads=4,
+                                 num_kv_heads=2, embed_dim=32,
+                                 max_seq_len=48)
+        with pytest.raises(ValueError, match="kv_heads"):
+            tp_speculative_generate(
+                tcfg, None, DRAFT_CFG, None, jnp.ones((1, 4), jnp.int32),
+                4, make_mesh({"data": 2, "model": 4}))
+
+
 class TestValidation:
     def test_vocab_mismatch(self):
         bad = TransformerConfig(vocab_size=32, max_seq_len=96)

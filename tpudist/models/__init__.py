@@ -17,7 +17,10 @@ from tpudist.models.generate import (
     tp_sp_generate,
 )
 from tpudist.models.mlp import MLP
-from tpudist.models.speculative import speculative_generate
+from tpudist.models.speculative import (
+    speculative_generate,
+    tp_speculative_generate,
+)
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
 from tpudist.models.resnet import ResNet50, resnet50_stages
 from tpudist.models.transformer import (
@@ -45,6 +48,7 @@ __all__ = [
     "speculative_generate",
     "tp_generate",
     "tp_sp_generate",
+    "tp_speculative_generate",
     "resnet50_stages",
     "sdpa",
     "stack_layer_params",

@@ -214,6 +214,14 @@ def _sharded_generate(cfg, params, prompt, max_new_tokens, mesh, *,
     sampling selector can never drift between the three layouts."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if cfg.scan_layers:
+        raise ValueError(
+            "sharded serving needs the UNROLLED param layout "
+            "(scan_layers=False): the TP rules regex-match the stacked "
+            "[L, in, out] kernels on the wrong axis and the 5-D stacked "
+            "cache escapes the cache-sharding constraint — convert with "
+            "unstack_layer_params")
+
     def cache_constraint(leaf):
         if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
             return NamedSharding(mesh, cache_spec)

@@ -172,6 +172,9 @@ def speculative_generate(
     stop_tokens: Sequence[int] | None = None,
     pad_token: int = 0,
     return_stats: bool = False,
+    decode_shard: Any = None,
+    cache_constraint: Any = None,
+    draft_cache_constraint: Any = None,
 ):
     """Generate ``max_new_tokens`` past ``prompt`` with draft/verify
     speculative decoding.
@@ -203,6 +206,13 @@ def speculative_generate(
         (scalars; ``draft_accepted`` counts ACCEPTED draft tokens summed
         over rounds — acceptance rate = draft_accepted / (rounds·K);
         emitted tokens additionally include one verify token per round).
+      decode_shard / cache_constraint / draft_cache_constraint: the
+        sharded-serving hooks (same contracts as in
+        :mod:`tpudist.models.generate`): ``decode_shard`` routes the
+        TARGET's attention kernels through per-shard ``shard_map``
+        islands, and the constraints (leaf -> sharding or None) pin the
+        two cache layouts under GSPMD.  :func:`tp_speculative_generate`
+        wires them for the Megatron layout.
 
     Returns ``[B, prompt_len + max_new_tokens]`` tokens, with
     ``(tokens, lengths)`` when ``stop_tokens`` is given, and the stats
@@ -232,16 +242,28 @@ def speculative_generate(
         key = jax.random.key(0)
 
     target = TransformerLM(target_cfg, decode=True,
-                           decode_attention=decode_attention)
+                           decode_attention=decode_attention,
+                           decode_shard=decode_shard)
     draft = TransformerLM(draft_cfg, decode=True,
                           decode_attention=draft_decode_attention)
 
+    def constrain(cache, constraint):
+        if constraint is None:
+            return cache
+        return jax.tree.map(
+            lambda x: (x if constraint(x) is None
+                       else jax.lax.with_sharding_constraint(
+                           x, constraint(x))), cache)
+
     # PREFILL both models on the prompt (the shared serving split)
     t_cache, t_logits = _prefill(
-        target, target_params, _blank_cache(target, b), prompt,
+        target, target_params,
+        constrain(_blank_cache(target, b), cache_constraint), prompt,
         prefill_chunk)
     d_cache, _ = _prefill(
-        draft, draft_params, _blank_cache(draft, b), prompt, prefill_chunk)
+        draft, draft_params,
+        constrain(_blank_cache(draft, b), draft_cache_constraint), prompt,
+        prefill_chunk)
     key, k0 = jax.random.split(key)
     first = select(t_logits[:, -1], k0).astype(jnp.int32)
 
@@ -329,3 +351,88 @@ def speculative_generate(
     if return_stats:
         result = result + ({"rounds": rounds, "draft_accepted": acc_total},)
     return result[0] if len(result) == 1 else result
+
+
+def tp_speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "model",
+    rules=None,
+    *,
+    num_draft: int = 4,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    decode_attention: str = "dense",
+    prefill_chunk: int | None = 512,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+    return_stats: bool = False,
+):
+    """Tensor-parallel speculative decoding: the TARGET runs in the
+    Megatron layout (weights + KV cache sharded over ``axis``, memory
+    1/tp per chip — the :func:`tpudist.models.generate.tp_generate`
+    layout) while the tiny DRAFT stays replicated, so every chip drafts
+    locally and the verify rounds are the only sharded compute.  One
+    GSPMD program; ``decode_attention="flash"`` routes the target's
+    prefill/verify kernels through per-shard ``shard_map`` islands.
+
+    Requires ``target_cfg.kv_heads % tp == 0``.  Same output contract
+    as :func:`speculative_generate`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudist.parallel.tensor_parallel import (
+        shard_tree,
+        spec_tree_from_rules,
+        transformer_tp_rules,
+    )
+
+    tp = mesh.shape[axis]
+    if target_cfg.kv_heads % tp:
+        raise ValueError(
+            f"target kv_heads {target_cfg.kv_heads} not divisible by "
+            f"{axis!r} size {tp}")
+    if target_cfg.scan_layers:
+        raise ValueError(
+            "tp_speculative_generate needs the UNROLLED target layout: "
+            "the TP rules regex-match the stacked [L, in, out] kernels "
+            "on the wrong axis and the 5-D stacked cache escapes the "
+            "head-sharding constraint — convert with "
+            "unstack_layer_params and scan_layers=False")
+
+    def cache_constraint(leaf):
+        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers: head-sharded
+            return NamedSharding(mesh, P(None, None, axis, None))
+        return NamedSharding(mesh, P())
+
+    def draft_cache_constraint(leaf):
+        return NamedSharding(mesh, P())
+
+    specs = spec_tree_from_rules(
+        target_params, rules or transformer_tp_rules(axis))
+    t_sharded = shard_tree(target_params, mesh, specs)
+
+    def run(tp_params, dp_params, t):
+        return speculative_generate(
+            target_cfg, tp_params, draft_cfg, dp_params, t,
+            max_new_tokens, num_draft=num_draft,
+            key=key if key is not None else jax.random.key(0),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            decode_attention=decode_attention,
+            draft_decode_attention="dense",
+            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+            pad_token=pad_token, return_stats=return_stats,
+            decode_shard=((mesh, axis) if decode_attention == "flash"
+                          else None),
+            cache_constraint=cache_constraint,
+            draft_cache_constraint=draft_cache_constraint)
+
+    with mesh:
+        return jax.jit(run)(t_sharded, draft_params, prompt)
