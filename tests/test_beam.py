@@ -1,0 +1,122 @@
+"""Beam search: reduction to greedy, score optimality, EOS, layouts.
+
+The load-bearing property is in `test_beats_or_matches_greedy`: for any
+model, the best beam's sequence log-probability (computed independently
+by teacher forcing) must be >= the greedy sequence's — beam search can
+only improve on greedy in model score.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models import TransformerConfig, TransformerLM, greedy_generate
+from tpudist.models.beam import beam_search_generate
+
+CFG = TransformerConfig(vocab_size=48, num_layers=2, num_heads=4,
+                        embed_dim=64, max_seq_len=64)
+
+
+def _params(seed=0, cfg=CFG):
+    return TransformerLM(cfg).init(
+        jax.random.key(seed), jnp.zeros((1, 2), jnp.int32))["params"]
+
+
+def _seq_logprob(cfg, params, tokens, prompt_len):
+    """Teacher-forced log-probability of tokens[prompt_len:]."""
+    logits = TransformerLM(cfg).apply({"params": params}, tokens)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.arange(tokens.shape[1] - 1)
+    tok_lp = jnp.take_along_axis(
+        logp[:, :-1], tokens[:, 1:, None], axis=-1)[..., 0]
+    return jnp.sum(jnp.where(idx[None, :] >= prompt_len - 1, tok_lp, 0.0),
+                   axis=-1)
+
+
+class TestBeamSearch:
+    def test_beam1_equals_greedy(self):
+        params = _params()
+        prompt = jax.random.randint(jax.random.key(1), (3, 5), 0, 48)
+        want = greedy_generate(CFG, params, prompt, 16)
+        got = beam_search_generate(CFG, params, prompt, 16, beam_size=1)
+        np.testing.assert_array_equal(
+            np.asarray(got[:, 0]), np.asarray(want))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_beats_or_matches_greedy(self, seed):
+        params = _params(seed)
+        prompt = jax.random.randint(jax.random.key(seed + 10), (2, 4), 0, 48)
+        greedy = greedy_generate(CFG, params, prompt, 12)
+        beams, scores = beam_search_generate(
+            CFG, params, prompt, 12, beam_size=4, return_scores=True)
+        lp_greedy = _seq_logprob(CFG, params, greedy, 4)
+        lp_beam = _seq_logprob(CFG, params, beams[:, 0], 4)
+        assert np.all(np.asarray(lp_beam) >= np.asarray(lp_greedy) - 1e-3)
+        # reported scores match the independent teacher-forced ones
+        np.testing.assert_allclose(np.asarray(scores[:, 0]),
+                                   np.asarray(lp_beam), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_beams_sorted_and_distinct(self):
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        beams, scores = beam_search_generate(
+            CFG, params, prompt, 10, beam_size=4, return_scores=True)
+        s = np.asarray(scores)
+        assert np.all(s[:, :-1] >= s[:, 1:] - 1e-6)  # best-first
+        b0 = np.asarray(beams)[0]
+        assert len({tuple(r) for r in b0}) > 1  # beams explored
+
+    def test_eos_freezes_and_lengths(self):
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        beams, lengths, scores = beam_search_generate(
+            CFG, params, prompt, 14, beam_size=3, stop_tokens=(5,),
+            pad_token=0, return_scores=True)
+        bn, ln = np.asarray(beams), np.asarray(lengths)
+        assert bn.shape == (2, 3, 18) and ln.shape == (2, 3)
+        for bi in range(2):
+            for wi in range(3):
+                row = bn[bi, wi, 4:]
+                stops = np.where(row == 5)[0]
+                if stops.size:
+                    first = stops[0]
+                    assert ln[bi, wi] == 4 + first + 1
+                    assert np.all(row[first + 1:] == 0)
+
+    def test_flash_decode_attention(self):
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        want = beam_search_generate(CFG, params, prompt, 8, beam_size=3)
+        got = beam_search_generate(CFG, params, prompt, 8, beam_size=3,
+                                   decode_attention="flash")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_scan_layers_layout(self):
+        from tpudist.models import stack_layer_params
+
+        import dataclasses
+        params = _params()
+        scfg = dataclasses.replace(CFG, scan_layers=True)
+        stacked = stack_layer_params(params, CFG.num_layers)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        want = beam_search_generate(CFG, params, prompt, 10, beam_size=3)
+        got = beam_search_generate(scfg, stacked, prompt, 10, beam_size=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="beam_size"):
+            beam_search_generate(CFG, None, jnp.ones((1, 2), jnp.int32),
+                                 4, beam_size=0)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            beam_search_generate(CFG, None, jnp.ones((1, 60), jnp.int32), 8)
+
+    def test_jittable(self):
+        params = _params()
+        prompt = jnp.ones((2, 4), jnp.int32)
+        fn = jax.jit(lambda p, t: beam_search_generate(
+            CFG, p, t, 8, beam_size=2))
+        want = beam_search_generate(CFG, params, prompt, 8, beam_size=2)
+        np.testing.assert_array_equal(
+            np.asarray(fn(params, prompt)), np.asarray(want))

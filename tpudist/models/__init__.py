@@ -7,6 +7,7 @@ LeNet-style ConvNet (`mnist_horovod.py:9-25` ≡ `horovod_mnist_elastic.py:
 EmbeddingBag+Linear hybrid (`server_model_data_parallel.py:34-46`).
 """
 
+from tpudist.models.beam import beam_search_generate
 from tpudist.models.convnet import ConvNet
 from tpudist.models.embedding import EmbeddingBagClassifier
 from tpudist.models.generate import (
@@ -34,6 +35,7 @@ from tpudist.models.transformer import (
 
 __all__ = [
     "ConvNet",
+    "beam_search_generate",
     "EmbeddingBagClassifier",
     "MLP",
     "MoEConfig",

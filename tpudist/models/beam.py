@@ -1,0 +1,178 @@
+"""Beam-search decoding — the deterministic search rollout.
+
+Completes the decode-strategy family (`generate.py`: greedy, sampled;
+`speculative.py`: draft/verify): width-W beam search as ONE compiled
+``lax.scan``, TPU-shaped like everything else in the serving story:
+
+* beams fold into the batch dimension — the model runs on ``[B·W]``
+  rows, so the whole search is the same cached decode program greedy
+  uses, W× wider;
+* each step expands every live beam over the vocab, takes the top-W of
+  ``[B, W·V]`` joint scores, and GATHERS the KV cache rows to the
+  winning parents (``take_along_axis`` over the folded batch dim — the
+  standard TPU/t5x-style cache reindex; traffic = one cache copy per
+  step, the price of exact search with static shapes);
+* EOS beams freeze: a finished beam contributes exactly one child (its
+  own continuation via ``pad_token`` at unchanged score) so it competes
+  with live beams but stops growing — no dynamic shapes anywhere.
+
+Reference scope note: the reference suite is training-only (SURVEY.md
+§2); this module extends the serving surface tpudist adds beyond parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudist.models.generate import (
+    _blank_cache,
+    _is_stop,
+    _prefill,
+    _stop_array,
+    sequence_lengths,
+)
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+_NEG_INF = -1.0e9
+
+
+def beam_search_generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+    decode_attention: str = "dense",
+    prefill_chunk: int | None = None,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+    return_scores: bool = False,
+):
+    """Beam-search ``max_new_tokens`` past ``prompt``.
+
+    Args:
+      beam_size: beams kept per batch row (W).
+      length_penalty: GNMT-style ``((5 + len) / 6) ** alpha`` score
+        normalization applied at the FINAL ranking (0 = rank by raw
+        log-probability).  Only meaningful with ``stop_tokens`` (without
+        EOS every hypothesis has the same length).
+      stop_tokens: EOS set; a beam that emits one freezes (its later
+        positions are ``pad_token`` and its score stops accumulating).
+      return_scores: also return the per-beam log-probabilities.
+
+    Returns ``[B, W, prompt_len + max_new_tokens]`` int32 hypotheses
+    sorted best-first per batch row (beam 0 is the argmax of the
+    length-normalized score), plus ``[B, W]`` raw log-prob scores when
+    ``return_scores`` is set.  With ``stop_tokens`` the return becomes
+    ``(tokens, lengths[, scores])`` as elsewhere.
+    """
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, prompt_len = prompt.shape
+    w = beam_size
+    stop_arr = _stop_array(stop_tokens)
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    v = cfg.vocab_size
+
+    model = TransformerLM(cfg, decode=True, decode_attention=decode_attention)
+    # Prefill ONCE on the [B] batch (every beam shares the prompt), then
+    # tile each K/V leaf W× along its folded-batch axis — byte-identical
+    # to prefilling [B·W] rows at 1/W the compute and peak memory
+    cache, logits = _prefill(
+        model, params, _blank_cache(model, b), prompt, prefill_chunk)
+    cache = jax.tree.map(
+        lambda leaf: (jnp.repeat(leaf, w, axis=leaf.ndim - 4)
+                      if leaf.ndim >= 4 else leaf), cache)
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+
+    # first expansion: top-W tokens of the prompt's next-token dist seed
+    # the beams (all beams were identical until here)
+    scores, first = lax.top_k(logp0, w)                    # [B, W] each
+    done = (_is_stop(first, stop_arr) if stop_arr is not None
+            else jnp.zeros((b, w), bool))
+
+    out0 = jnp.full((b, w, max_new_tokens), pad_token, jnp.int32)
+    out0 = out0.at[:, :, 0].set(first)
+
+    def step(carry, t):
+        cache, prev, scores, done, out = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prev.reshape(b * w)[:, None],
+            positions=jnp.full((b * w, 1), prompt_len + t - 1, jnp.int32),
+            mutable=["cache"])
+        cache = mut["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32)).reshape(b, w, v)
+        # live beams expand over the vocab; finished beams contribute ONE
+        # child: themselves continued by pad_token at unchanged score
+        cand = scores[:, :, None] + logp                   # [B, W, V]
+        frozen = jnp.full((b, w, v), _NEG_INF
+                          ).at[:, :, pad_token].set(0.0) + scores[:, :, None]
+        cand = jnp.where(done[:, :, None], frozen, cand)
+        scores, flat_idx = lax.top_k(cand.reshape(b, w * v), w)  # [B, W]
+        parent = flat_idx // v                              # [B, W]
+        token = (flat_idx % v).astype(jnp.int32)            # [B, W]
+
+        # reindex every per-beam buffer to the winning parents.  K/V
+        # leaves carry the folded batch on axis 0 unrolled ([B·W, S,
+        # H_kv, D]) and axis 1 under scan_layers ([L, B·W, S, H_kv, D]);
+        # cache_index scalars are beam-uniform and skip the gather.
+        gather = lambda x: jnp.take_along_axis(x, parent, axis=1)
+        row = (jnp.arange(b)[:, None] * w + parent).reshape(-1)  # [B·W]
+        cache = jax.tree.map(
+            lambda leaf: (jnp.take(leaf, row, axis=leaf.ndim - 4)
+                          if leaf.ndim >= 4 else leaf), cache)
+        out = jnp.take_along_axis(
+            out, parent[:, :, None], axis=1).at[:, :, t].set(
+                jnp.where(gather(done), jnp.int32(pad_token), token))
+        done = gather(done)
+        if stop_arr is not None:
+            done = done | _is_stop(token, stop_arr)
+        return (cache, token, scores, done, out), None
+
+    carry = (cache, first, scores, done, out0)
+    if max_new_tokens > 1:
+        carry, _ = lax.scan(step, carry,
+                            jnp.arange(1, max_new_tokens))
+    _, _, scores, done, out = carry
+
+    generated = out                                        # [B, W, N]
+    if stop_arr is not None:
+        hit = _is_stop(generated, stop_arr)
+        after = (jnp.cumsum(hit, axis=-1) - hit) > 0
+        generated = jnp.where(after, jnp.int32(pad_token), generated)
+        lengths = sequence_lengths(generated, stop_arr, prompt_len)
+    else:
+        lengths = jnp.full((b, w), total, jnp.int32)
+
+    # final ranking: GNMT length normalization (on generated length)
+    norm = ((5.0 + (lengths - prompt_len)) / 6.0) ** length_penalty
+    order = jnp.argsort(-scores / norm, axis=1)            # [B, W]
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    generated = jnp.take_along_axis(generated, order[:, :, None], axis=1)
+
+    tokens = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, w, prompt_len)), generated],
+        axis=-1)
+    result = (tokens,)
+    if stop_arr is not None:
+        result += (lengths,)
+    if return_scores:
+        result += (scores,)
+    return result[0] if len(result) == 1 else result
+

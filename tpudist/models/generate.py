@@ -40,10 +40,24 @@ def _is_stop(tokens: jnp.ndarray, stop_arr: jnp.ndarray) -> jnp.ndarray:
 def sequence_lengths(generated: jnp.ndarray, stop_arr: jnp.ndarray,
                      prompt_len: int) -> jnp.ndarray:
     """Per-sequence total lengths: prompt + generated up to and INCLUDING
-    the first stop token (or all of ``generated`` if none fired)."""
+    the first stop token (or all of ``generated`` if none fired).  The
+    position axis is the LAST one (works for [B, N] rollouts and
+    [B, W, N] beam hypotheses alike)."""
     hit = _is_stop(generated, stop_arr)
-    strictly_after = jnp.cumsum(hit, axis=1) - hit  # stops before position
-    return prompt_len + jnp.sum(strictly_after == 0, axis=1)
+    strictly_after = jnp.cumsum(hit, axis=-1) - hit  # stops before position
+    return prompt_len + jnp.sum(strictly_after == 0, axis=-1)
+
+
+def apply_cache_constraint(cache, constraint):
+    """Pin a blank cache's layout for sharded decoding: ``constraint``
+    maps leaf -> sharding (or None to leave the leaf alone).  The ONE
+    copy of the idiom every sharded rollout (plain, speculative) uses."""
+    if constraint is None:
+        return cache
+    return jax.tree.map(
+        lambda x: (x if constraint(x) is None
+                   else lax.with_sharding_constraint(x, constraint(x))),
+        cache)
 
 
 def _blank_cache(model, batch: int):
@@ -111,6 +125,9 @@ def _rollout(
     stop_arr = _stop_array(stop_tokens)  # validate before any device work
     if prompt_len < 1:
         raise ValueError("prompt must hold at least one token")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(
@@ -118,13 +135,7 @@ def _rollout(
             f"max_seq_len {cfg.max_seq_len}")
     model = TransformerLM(cfg, decode=True, decode_attention=decode_attention,
                           decode_shard=decode_shard)
-    cache = _blank_cache(model, b)
-    if cache_constraint is not None:
-        cache = jax.tree.map(
-            lambda x: (x if cache_constraint(x) is None
-                       else lax.with_sharding_constraint(
-                           x, cache_constraint(x))),
-            cache)
+    cache = apply_cache_constraint(_blank_cache(model, b), cache_constraint)
     keys = jax.random.split(key, max_new_tokens)
 
     # PREFILL: the prompt through batched forwards (the serving split — at

@@ -53,6 +53,7 @@ from tpudist.models.generate import (
     _make_select,
     _prefill,
     _stop_array,
+    apply_cache_constraint,
     sequence_lengths,
 )
 from tpudist.models.transformer import TransformerConfig, TransformerLM
@@ -224,6 +225,9 @@ def speculative_generate(
             f"{target_cfg.vocab_size}")
     if num_draft < 1:
         raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     b, prompt_len = prompt.shape
     if prompt_len < 1:
         raise ValueError("prompt must hold at least one token")
@@ -247,23 +251,16 @@ def speculative_generate(
     draft = TransformerLM(draft_cfg, decode=True,
                           decode_attention=draft_decode_attention)
 
-    def constrain(cache, constraint):
-        if constraint is None:
-            return cache
-        return jax.tree.map(
-            lambda x: (x if constraint(x) is None
-                       else jax.lax.with_sharding_constraint(
-                           x, constraint(x))), cache)
-
     # PREFILL both models on the prompt (the shared serving split)
     t_cache, t_logits = _prefill(
         target, target_params,
-        constrain(_blank_cache(target, b), cache_constraint), prompt,
-        prefill_chunk)
+        apply_cache_constraint(_blank_cache(target, b), cache_constraint),
+        prompt, prefill_chunk)
     d_cache, _ = _prefill(
         draft, draft_params,
-        constrain(_blank_cache(draft, b), draft_cache_constraint), prompt,
-        prefill_chunk)
+        apply_cache_constraint(_blank_cache(draft, b),
+                               draft_cache_constraint),
+        prompt, prefill_chunk)
     key, k0 = jax.random.split(key)
     first = select(t_logits[:, -1], k0).astype(jnp.int32)
 
