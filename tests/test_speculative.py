@@ -135,6 +135,34 @@ class TestAcceptRule:
 
 
 class TestSampling:
+    def test_rollout_marginal_matches_plain_sampling(self):
+        """End-to-end distribution check: over many independent batch
+        rows, the SECOND generated token's marginal (which goes through
+        a full draft/verify round, including lockstep-min coupling and
+        rejection resampling) must match plain sampled decoding's."""
+        v = 12
+        cfg = TransformerConfig(vocab_size=v, num_layers=1, num_heads=2,
+                                embed_dim=16, max_seq_len=16)
+        dcfg = TransformerConfig(vocab_size=v, num_layers=1, num_heads=1,
+                                 embed_dim=8, max_seq_len=16)
+        tp = _make(cfg, 0)
+        dp = _make(dcfg, 1)
+        n = 8192
+        prompt = jnp.ones((n, 3), jnp.int32)
+        spec = speculative_generate(
+            cfg, tp, dcfg, dp, prompt, 2, num_draft=2, temperature=1.0,
+            key=jax.random.key(11))
+        plain = sample_generate(
+            cfg, tp, prompt, 2, jax.random.key(22), temperature=1.0)
+        h_spec = np.bincount(np.asarray(spec[:, 4]), minlength=v) / n
+        h_plain = np.bincount(np.asarray(plain[:, 4]), minlength=v) / n
+        # total-variation distance between two 8192-sample empirical
+        # distributions over 12 tokens; null-hypothesis TV measured
+        # ~0.018 at this n, so 0.06 flags a systematic distribution
+        # error with a wide margin over sampling noise
+        tv = 0.5 * np.abs(h_spec - h_plain).sum()
+        assert tv < 0.06, (tv, h_spec, h_plain)
+
     def test_sampled_rollout_plausible(self):
         """Sampled speculative rollout: tokens are valid, vary with the
         key, and with draft == target the acceptance is total (sampling
