@@ -40,6 +40,13 @@ def main(argv=None) -> float:
     parser.add_argument("--heads", default=8, type=int)
     parser.add_argument("--embed-dim", default=512, type=int)
     parser.add_argument("--vocab", default=256, type=int)
+    parser.add_argument("--data", default="random",
+                        choices=["random", "markov"],
+                        help="training stream: 'random' (throughput "
+                             "demo; nothing learnable) or 'markov' (a "
+                             "fixed token-permutation language — the "
+                             "model actually learns, so the "
+                             "--speculative demo shows real acceptance)")
     parser.add_argument("--lr", default=3e-4, type=float)
     parser.add_argument("--attn", default="auto",
                         choices=["auto", "flash", "sdpa", "ring",
@@ -129,8 +136,21 @@ def main(argv=None) -> float:
         scan_layers=args.scan_layers,
     )
     rng = np.random.default_rng(0)
-    tokens = jnp.asarray(
-        rng.integers(0, args.vocab, (args.batch_size, args.seq_len)), jnp.int32)
+    if args.data == "markov":
+        # next token = perm[current]: position-independent and learnable
+        # by even a 1-layer draft, so speculative acceptance is earned
+        pattern = min(1024, args.vocab)
+        perm = rng.permutation(pattern)
+        stream = np.empty((args.batch_size, args.seq_len), np.int64)
+        tok = rng.integers(0, pattern, args.batch_size)
+        for i in range(args.seq_len):
+            stream[:, i] = tok
+            tok = perm[tok]
+        tokens = jnp.asarray(stream, jnp.int32)
+    else:
+        tokens = jnp.asarray(
+            rng.integers(0, args.vocab, (args.batch_size, args.seq_len)),
+            jnp.int32)
     init_params = TransformerLM(cfg).init(
         jax.random.key(0), tokens[:1, : min(args.seq_len, 128)])["params"]
     n_tokens = args.batch_size * (args.seq_len - 1)

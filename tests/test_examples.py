@@ -91,6 +91,8 @@ def test_long_context_lm_twin(extra):
     ["--tp", "2"],                    # head-sharded serving (tp_generate)
     ["--sp", "2", "--attn", "ulysses"],  # seq-sharded serving (sp_generate)
     ["--speculative", "3"],           # draft/verify speculative decoding
+    # learnable stream: the speculative demo earns real acceptance
+    ["--speculative", "3", "--data", "markov", "--steps", "30"],
 ])
 def test_long_context_lm_generation_demo(extra):
     """The serving demo end-to-end: flash prefill + decode with EOS
