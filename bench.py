@@ -478,6 +478,25 @@ def bench_decode(on_tpu: bool) -> None:
           None, batch=batch, context=int(prompt.shape[1]) + new_tokens,
           rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=shadowed)
 
+    # beam search on the same model: the cost of exact width-W search is
+    # a W-wide batch plus one cache gather per step — measured as the
+    # slowdown vs greedy for the SAME number of emitted sequences
+    from tpudist.models.beam import beam_search_generate
+
+    beam_w = 4
+    bfn = jax.jit(lambda p, t: beam_search_generate(
+        cfg, p, t, new_tokens, beam_size=beam_w))
+    int(bfn(params, prompt)[0, 0, -1])
+    t_beam, sh_b = _net(_best_window(
+        lambda: int(bfn(params, prompt)[0, 0, -1]), n_win, lambda: None))
+    _emit("beam_search_overhead", round(t_beam / best, 2), "x", None,
+          beam_size=beam_w, batch=batch,
+          context=int(prompt.shape[1]) + new_tokens,
+          greedy_s=round(best, 3), beam_s=round(t_beam, 3),
+          hypothesis_tokens_per_sec=round(
+              batch * beam_w * new_tokens / t_beam, 1),
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=shadowed or sh_b)
+
     # long-context serving through the flash kernels: one-shot PREFILL of
     # the prompt (flash forward at a query offset), then per-token decode
     # steps (flash-decode kernel) against the near-full cache
