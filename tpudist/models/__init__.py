@@ -19,6 +19,7 @@ from tpudist.models.generate import (
 )
 from tpudist.models.mlp import MLP
 from tpudist.models.speculative import (
+    sp_speculative_generate,
     speculative_generate,
     tp_speculative_generate,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "greedy_generate",
     "sample_generate",
     "sp_generate",
+    "sp_speculative_generate",
     "speculative_generate",
     "tp_generate",
     "tp_sp_generate",

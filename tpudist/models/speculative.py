@@ -350,6 +350,49 @@ def speculative_generate(
     return result[0] if len(result) == 1 else result
 
 
+def _sharded_speculative(
+    target_cfg, target_params, draft_cfg, draft_params, prompt,
+    max_new_tokens, mesh, *, cache_spec, decode_shard, decode_attention,
+    num_draft, key, temperature, top_k, top_p, prefill_chunk,
+    stop_tokens, pad_token, return_stats, layout_reason):
+    """Common tail of the sharded speculative entry points (tp / sp) —
+    one copy of the scan_layers guard, cache-constraint closures, key
+    default, and kwarg plumbing, mirroring ``generate._sharded_generate``
+    so the layouts can never drift."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if target_cfg.scan_layers:
+        raise ValueError(
+            "sharded speculative decoding needs the UNROLLED target "
+            f"layout: {layout_reason} — convert with "
+            "unstack_layer_params and scan_layers=False")
+
+    def cache_constraint(leaf):
+        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
+            return NamedSharding(mesh, cache_spec)
+        return NamedSharding(mesh, P())
+
+    def draft_cache_constraint(leaf):
+        return NamedSharding(mesh, P())
+
+    def run(tp_params, dp_params, t):
+        return speculative_generate(
+            target_cfg, tp_params, draft_cfg, dp_params, t,
+            max_new_tokens, num_draft=num_draft,
+            key=key if key is not None else jax.random.key(0),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            decode_attention=decode_attention,
+            draft_decode_attention="dense",
+            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+            pad_token=pad_token, return_stats=return_stats,
+            decode_shard=decode_shard,
+            cache_constraint=cache_constraint,
+            draft_cache_constraint=draft_cache_constraint)
+
+    with mesh:
+        return jax.jit(run)(target_params, draft_params, prompt)
+
+
 def tp_speculative_generate(
     target_cfg: TransformerConfig,
     target_params: Any,
@@ -383,7 +426,7 @@ def tp_speculative_generate(
     Requires ``target_cfg.kv_heads % tp == 0``.  Same output contract
     as :func:`speculative_generate`.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpudist.parallel.tensor_parallel import (
         shard_tree,
@@ -396,40 +439,71 @@ def tp_speculative_generate(
         raise ValueError(
             f"target kv_heads {target_cfg.kv_heads} not divisible by "
             f"{axis!r} size {tp}")
-    if target_cfg.scan_layers:
-        raise ValueError(
-            "tp_speculative_generate needs the UNROLLED target layout: "
-            "the TP rules regex-match the stacked [L, in, out] kernels "
-            "on the wrong axis and the 5-D stacked cache escapes the "
-            "head-sharding constraint — convert with "
-            "unstack_layer_params and scan_layers=False")
-
-    def cache_constraint(leaf):
-        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers: head-sharded
-            return NamedSharding(mesh, P(None, None, axis, None))
-        return NamedSharding(mesh, P())
-
-    def draft_cache_constraint(leaf):
-        return NamedSharding(mesh, P())
 
     specs = spec_tree_from_rules(
         target_params, rules or transformer_tp_rules(axis))
-    t_sharded = shard_tree(target_params, mesh, specs)
+    return _sharded_speculative(
+        target_cfg, shard_tree(target_params, mesh, specs), draft_cfg,
+        draft_params, prompt, max_new_tokens, mesh,
+        cache_spec=P(None, None, axis, None),
+        decode_shard=((mesh, axis) if decode_attention == "flash"
+                      else None),
+        decode_attention=decode_attention, num_draft=num_draft, key=key,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
+        pad_token=pad_token, return_stats=return_stats,
+        layout_reason=(
+            "the TP rules regex-match the stacked [L, in, out] kernels "
+            "on the wrong axis and the 5-D stacked cache escapes the "
+            "head-sharding constraint"))
 
-    def run(tp_params, dp_params, t):
-        return speculative_generate(
-            target_cfg, tp_params, draft_cfg, dp_params, t,
-            max_new_tokens, num_draft=num_draft,
-            key=key if key is not None else jax.random.key(0),
-            temperature=temperature, top_k=top_k, top_p=top_p,
-            decode_attention=decode_attention,
-            draft_decode_attention="dense",
-            prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
-            pad_token=pad_token, return_stats=return_stats,
-            decode_shard=((mesh, axis) if decode_attention == "flash"
-                          else None),
-            cache_constraint=cache_constraint,
-            draft_cache_constraint=draft_cache_constraint)
 
-    with mesh:
-        return jax.jit(run)(t_sharded, draft_params, prompt)
+def sp_speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "seq",
+    *,
+    num_draft: int = 4,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    prefill_chunk: int | None = 512,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+    return_stats: bool = False,
+):
+    """Sequence-sharded speculative decoding: the TARGET's KV cache is
+    sharded over ``axis`` on its SEQUENCE dimension (per-chip target
+    cache memory 1/n — the :func:`tpudist.models.generate.sp_generate`
+    layout for contexts beyond one chip's HBM) with params replicated;
+    the tiny DRAFT stays fully replicated.  The target's verify chunks
+    run on the dense partitioned attention path (GSPMD turns them into
+    per-shard partial attention + reductions; the sequence-sharded
+    prefill never gathers the cache), so no ``decode_shard`` islands are
+    needed.  Same output contract as :func:`speculative_generate`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    sp = mesh.shape[axis]
+    if target_cfg.max_seq_len % sp:
+        raise ValueError(
+            f"target max_seq_len {target_cfg.max_seq_len} not divisible "
+            f"by {axis!r} size {sp}")
+
+    return _sharded_speculative(
+        target_cfg, target_params, draft_cfg, draft_params, prompt,
+        max_new_tokens, mesh,
+        cache_spec=P(None, axis, None, None),
+        decode_shard=None, decode_attention="dense",
+        num_draft=num_draft, key=key, temperature=temperature,
+        top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
+        stop_tokens=stop_tokens, pad_token=pad_token,
+        return_stats=return_stats,
+        layout_reason=("the 5-D stacked cache escapes the "
+                       "sequence-sharding constraint"))
