@@ -927,7 +927,7 @@ def bench_speculative_decode(on_tpu: bool) -> None:
     match = bool(jnp.all(spec_n(prompt)[:, prompt_len:]
                          == plain_n(prompt)[:, prompt_len:]))
     rounds = max(stats_box.get("rounds", 0), 1)
-    accept_rate = stats_box.get("accepted", 0) / (rounds * k_spec)
+    accept_rate = stats_box.get("accepted", 0) / (rounds * k_spec * batch)
     _emit("speculative_decode_speedup", round(spec_tps / plain_tps, 2),
           "x", None, context=target_cfg.max_seq_len, batch=batch,
           num_draft=k_spec, accept_rate=round(accept_rate, 3),

@@ -313,12 +313,22 @@ def main(argv=None) -> float:
             rounds = max(int(stats["rounds"]), 1)
             serve = (f"speculative K={args.speculative} (draft loss "
                      f"{float(d_loss):.3f}, accept rate "
-                     f"{int(stats['draft_accepted']) / (rounds * args.speculative):.2f})")
+                     f"{int(stats['draft_accepted']) / (rounds * args.speculative * prompt.shape[0]):.2f})")
         else:
             out, lengths = greedy_generate(
                 cfg, state.params, prompt, args.generate,
                 decode_attention="flash", stop_tokens=[0])
             serve = "single-program flash"
+            if args.tp > 1:
+                # be LOUD about the layout change: the user asked for tp
+                # serving but the config can't shard whole KV heads
+                serve += (f" (tp{args.tp} serving unavailable: kv_heads "
+                          f"{cfg.kv_heads} % tp != 0 — decoding "
+                          "unsharded instead)")
+            elif args.sp > 1:
+                serve += (f" (sp{args.sp} serving unavailable: "
+                          f"max_seq_len {cfg.max_seq_len} % sp != 0 — "
+                          "decoding unsharded instead)")
         jax.block_until_ready(out)
         dt = time.time() - t0
         print(f"generated {args.generate} tokens/seq via {serve} "

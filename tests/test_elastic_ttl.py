@@ -240,6 +240,74 @@ def test_late_joiner_grows_world(tmp_path):
         assert resets[-1]["new_world"] == 3
 
 
+def test_fresh_joiner_sorting_first_does_not_wipe_progress(tmp_path):
+    """A from-scratch late joiner whose worker id sorts FIRST becomes
+    rank 0 of the new round — but the state-broadcast root is elected by
+    PROGRESS, so the joiner must adopt the incumbents' state instead of
+    wiping it with its fresh initialization (the partial-restart hazard:
+    a relaunched worker reclaiming rank 0)."""
+    import os
+    import subprocess
+    import time
+
+    from tpudist.runtime.coord import CoordServer
+
+    server = CoordServer(0)
+    repo = str(Path(__file__).parent.parent)
+    base = dict(
+        os.environ,
+        WORKER_OUT_DIR=str(tmp_path),
+        WORKER_STEP_DELAY="0.4",
+        TPUDIST_COORD_ADDR=f"127.0.0.1:{server.port}",
+        PYTHONPATH=os.pathsep.join(
+            [repo] + ([os.environ["PYTHONPATH"]]
+                      if os.environ.get("PYTHONPATH") else [])),
+    )
+    procs = []
+    try:
+        # incumbents take spawn ids 1 and 2 -> worker ids w1, w2
+        for i in (1, 2):
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER],
+                env={**base, "TPUDIST_PROCESS_ID": str(i),
+                     "TPUDIST_NUM_PROCESSES": "2"}))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if any(e["event"] == "round" for e in _events(tmp_path, 1)):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("round 0 never formed")
+        # let the incumbents make progress past the first commit
+        time.sleep(2.5)
+        # the fresh joiner's id w0 sorts BEFORE w1/w2 -> it gets rank 0
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER],
+            env={**base, "TPUDIST_PROCESS_ID": "0",
+                 "TPUDIST_NUM_PROCESSES": "1"}))
+        for p in procs:
+            assert p.wait(timeout=300) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    checksums = set()
+    for sid in (0, 1, 2):
+        ev = _events(tmp_path, sid)
+        done = [e for e in ev if e["event"] == "done"]
+        assert done and done[-1]["steps"] == 30 and done[-1]["world"] == 3
+        checksums.add(done[-1]["checksum"])
+    assert len(checksums) == 1
+    # the joiner adopted incumbent progress: its first round resumes at
+    # the incumbents' commit boundary, not at batch 0
+    joiner_rounds = [e for e in _events(tmp_path, 0)
+                     if e["event"] == "round"]
+    assert joiner_rounds and joiner_rounds[-1]["resume_batch"] > 0
+    assert joiner_rounds[-1]["resume_batch"] % 5 == 0
+
+
 def test_steady_gang_completes_without_resize(tmp_path):
     """No failures: one round at world 2, no resets, identical results."""
     rc = launch(

@@ -66,7 +66,7 @@ class TestGreedyExactness:
             return_stats=True)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         rounds = int(stats["rounds"])
-        assert int(stats["draft_accepted"]) == 4 * rounds
+        assert int(stats["draft_accepted"]) == 4 * rounds * 2  # K * rounds * batch
         # full acceptance advances 5 tokens/round: ceil(23 / 5) rounds
         # after the prefill token
         assert rounds == -(-23 // 5)
@@ -173,7 +173,7 @@ class TestSampling:
             TARGET_CFG, tp, TARGET_CFG, tp, prompt, 16, num_draft=4,
             temperature=1.0, key=jax.random.key(7), return_stats=True)
         assert toks.shape == (2, 20)
-        assert int(stats["draft_accepted"]) == 4 * int(stats["rounds"])
+        assert int(stats["draft_accepted"]) == 4 * int(stats["rounds"]) * 2
         toks2 = speculative_generate(
             TARGET_CFG, tp, TARGET_CFG, tp, prompt, 16, num_draft=4,
             temperature=1.0, key=jax.random.key(8))

@@ -146,29 +146,44 @@ def run_elastic_worker(
                 # bitwise state agreement across the new world (the
                 # hvd.broadcast_parameters / TorchState re-broadcast role) —
                 # INCLUDING the host position: a freshly-joined worker starts
-                # from scratch and must adopt rank 0's (epoch, batch), or its
-                # step stream would misalign with the incumbents'.  This
+                # from scratch and must adopt the root's (epoch, batch), or
+                # its step stream would misalign with the incumbents'.  This
                 # runs INSIDE the WorldChanged/PeerLost handler: the full
                 # model state is transferred here, so a peer dying mid-
                 # broadcast must trigger re-rendezvous, not a crash.
                 fresh = first_round and state.restored_step is None
+                # ROOT ELECTION: broadcast from the member with the MOST
+                # committed progress (non-fresh beats fresh, then epoch,
+                # then batch; ties -> lowest rank) — NOT blindly rank 0.
+                # After a partial restart, the relaunched from-scratch
+                # worker can sort back to rank 0; rooting there would
+                # broadcast its initial state over the incumbents' and
+                # silently wipe the run's progress.
+                score = np.zeros(world, np.int64)
+                score[rank] = ((0 if fresh else 1) << 52
+                               | min(int(state.host.epoch),
+                                     (1 << 20) - 1) << 32
+                               | min(int(state.host.batch), (1 << 32) - 1))
+                root = int(np.argmax(coll.allreduce_sum(score)))
                 synced = coll.broadcast(
                     {"state": tree_to_numpy(state.state),
                      "host": np.asarray([state.host.epoch, state.host.batch,
                                          state.world_size, int(fresh)])},
-                    root=0)
+                    root=root)
                 state.state = jax.tree.map(
                     host_to_leaf, state.state, synced["state"])
                 state.host.epoch = int(synced["host"][0])
                 state.host.batch = int(synced["host"][1])
-                # The rescale decision is keyed on RANK 0's flags, not the
-                # local ones: everyone just adopted rank 0's state (incl.
-                # the lr inside opt_state), so a rank-local decision would
-                # let ranks with asymmetric checkpoint availability apply
-                # different rescales to the identical synced state.
+                # The rescale decision is keyed on the ROOT's flags, not
+                # the local ones: everyone just adopted the root's state
+                # (incl. the lr inside opt_state), so a rank-local decision
+                # would let ranks with asymmetric checkpoint availability
+                # apply different rescales to the identical synced state.
                 if int(synced["host"][3]):
-                    # root broadcast a fresh state's initial formation:
-                    # its base hyperparameters are DEFINED for this world
+                    # the elected root is fresh only when EVERY member is
+                    # (election prefers any non-fresh state): initial
+                    # formation — base hyperparameters are DEFINED for
+                    # this world
                     state.world_size = world
                 else:
                     # root's recorded world is the uniform "old" for the

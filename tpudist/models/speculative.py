@@ -205,7 +205,10 @@ def speculative_generate(
         per-sequence lengths are returned.
       return_stats: also return ``{"rounds", "draft_accepted"}``
         (scalars; ``draft_accepted`` counts ACCEPTED draft tokens summed
-        over rounds — acceptance rate = draft_accepted / (rounds·K);
+        over rounds AND batch rows — acceptance rate =
+        draft_accepted / (rounds · K · batch).  Note the lockstep
+        rollout only ADVANCES by the batch-min accepted prefix each
+        round, so emitted tokens can trail acceptance for batch > 1;
         emitted tokens additionally include one verify token per round).
       decode_shard / cache_constraint / draft_cache_constraint: the
         sharded-serving hooks (same contracts as in
@@ -273,9 +276,10 @@ def speculative_generate(
         n_cache = prompt_len + emitted - 1  # tokens resident in caches
         key, dk, vk = jax.random.split(key, 3)
 
-        # DRAFT: K single-token proposals with their distributions (then
-        # one extra write so the draft cache holds d_K for the
-        # all-accepted case)
+        # DRAFT: K single-token proposals with their distributions.  The
+        # scan runs K+1 steps so the LAST iteration writes d_K into the
+        # draft cache (needed for the all-accepted case); its sampled
+        # output is discarded — one copy of the draft-step body.
         def chain(carry, inp):
             j, step_key = inp
             cache, tok = carry
@@ -288,17 +292,11 @@ def speculative_generate(
             nxt = select(logits[:, -1], step_key).astype(jnp.int32)
             return (mut["cache"], nxt), (nxt, q_probs)
 
-        d_keys = jax.random.split(dk, k)
-        (d_cache2, d_last), (drafts_t, q_t) = lax.scan(
-            chain, (d_cache, x), (jnp.arange(k), d_keys))
-        drafts = drafts_t.T                                   # [B, K]
-        q = jnp.moveaxis(q_t, 0, 1)                           # [B, K, V]
-        # write d_K into the draft cache (output token discarded)
-        _, mut = draft.apply(
-            {"params": draft_params, "cache": d_cache2}, d_last[:, None],
-            positions=jnp.full((b, 1), n_cache + k, jnp.int32),
-            mutable=["cache"])
-        d_cache2 = mut["cache"]
+        d_keys = jax.random.split(dk, k + 1)
+        (d_cache2, _), (drafts_t, q_t) = lax.scan(
+            chain, (d_cache, x), (jnp.arange(k + 1), d_keys))
+        drafts = drafts_t[:k].T                               # [B, K]
+        q = jnp.moveaxis(q_t[:k], 0, 1)                       # [B, K, V]
 
         # VERIFY: one target forward over [x, d_1..d_K]
         verify = jnp.concatenate([x[:, None], drafts], axis=1)  # [B, K+1]
@@ -319,11 +317,10 @@ def speculative_generate(
         out = lax.dynamic_update_slice(out, e_buf, (0, emitted))
 
         new_len = n_cache + m + 1
-        del accepted  # per-row counts; the lockstep advance is m
         return (_set_cache_index(t_cache2, new_len),
                 _set_cache_index(d_cache2, new_len),
                 emit, emitted + m + 1, out, key,
-                rounds + 1, acc_total + m)
+                rounds + 1, acc_total + jnp.sum(accepted))
 
     def cond(carry):
         return carry[3] < max_new_tokens

@@ -117,12 +117,12 @@ class BatchNorm(nn.Module):
             rstd = jax.lax.rsqrt(var + self.epsilon)
             y = ((x32 - mean) * rstd * scale.astype(jnp.float32)
                  + bias.astype(jnp.float32)).astype(x.dtype)
-            if not self.is_initializing():
-                m = self.momentum
-                ra_mean.value = (m * ra_mean.value
-                                 + (1 - m) * jax.lax.stop_gradient(mean))
-                ra_var.value = (m * ra_var.value
-                                + (1 - m) * jax.lax.stop_gradient(var))
+            # this branch already requires not is_initializing()
+            m = self.momentum
+            ra_mean.value = (m * ra_mean.value
+                             + (1 - m) * jax.lax.stop_gradient(mean))
+            ra_var.value = (m * ra_var.value
+                            + (1 - m) * jax.lax.stop_gradient(var))
         else:
             y, mean, var = batch_norm_train(x, scale, bias, self.epsilon)
             if not self.is_initializing():

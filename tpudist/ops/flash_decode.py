@@ -128,6 +128,11 @@ def _pick_block_k(s: int, block_k: int) -> int:
     multiple-of-8 divisor (VMEM-safe for arbitrary S), with a one-block
     fast path for small caches whose best divisor is tiny."""
     block_k = min(block_k, s)
+    if block_k < s:
+        # a PARTIAL block must sit on the 8-row sublane tile (a whole-
+        # array block is exempt): a caller-chosen block_k like 12 would
+        # otherwise reach Mosaic as an unlowerable block spec
+        block_k = max(8, block_k - block_k % 8)
     if s % block_k == 0:
         return block_k
     bk = block_k - block_k % 8
