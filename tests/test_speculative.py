@@ -263,6 +263,40 @@ class TestTensorParallel:
                 bad, tp, dcfg, dp, prompt, 4,
                 make_mesh({"data": 2, "seq": 4}))
 
+    def test_tp_sp_speculative_matches_unsharded(self, devices8):
+        """The 2-D layout: target weights over 'model', cache over heads
+        AND sequence, replicated draft — same tokens as plain greedy."""
+        from tpudist.models.speculative import tp_sp_speculative_generate
+        from tpudist.runtime.mesh import make_mesh
+
+        tcfg = TransformerConfig(vocab_size=48, num_layers=2, num_heads=4,
+                                 num_kv_heads=2, embed_dim=32,
+                                 max_seq_len=48)
+        dcfg = TransformerConfig(vocab_size=48, num_layers=1, num_heads=2,
+                                 embed_dim=16, max_seq_len=48)
+        tp = TransformerLM(tcfg).init(
+            jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+        dp = TransformerLM(dcfg).init(
+            jax.random.key(1), jnp.zeros((1, 2), jnp.int32))["params"]
+        prompt = jnp.asarray(
+            np.random.default_rng(7).integers(0, 48, (2, 6)), jnp.int32)
+        want = greedy_generate(tcfg, tp, prompt, 12)
+        mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+        got = tp_sp_speculative_generate(
+            tcfg, tp, dcfg, dp, prompt, 12, mesh, num_draft=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # both divisibility guards reject with clean errors
+        with pytest.raises(ValueError, match="kv_heads"):
+            tp_sp_speculative_generate(
+                tcfg, tp, dcfg, dp, prompt, 4,
+                make_mesh({"data": 1, "model": 4, "seq": 2}))
+        import dataclasses
+        bad = dataclasses.replace(tcfg, max_seq_len=50)  # 50 % 4 != 0
+        with pytest.raises(ValueError, match="max_seq_len"):
+            tp_sp_speculative_generate(
+                bad, tp, dcfg, dp, prompt, 4,
+                make_mesh({"data": 1, "model": 2, "seq": 4}))
+
     def test_tp_speculative_rejects_indivisible_heads(self, devices8):
         from tpudist.models.speculative import tp_speculative_generate
         from tpudist.runtime.mesh import make_mesh

@@ -21,6 +21,7 @@ from tpudist.models.mlp import MLP
 from tpudist.models.speculative import (
     sp_speculative_generate,
     speculative_generate,
+    tp_sp_speculative_generate,
     tp_speculative_generate,
 )
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
@@ -52,6 +53,7 @@ __all__ = [
     "speculative_generate",
     "tp_generate",
     "tp_sp_generate",
+    "tp_sp_speculative_generate",
     "tp_speculative_generate",
     "resnet50_stages",
     "sdpa",

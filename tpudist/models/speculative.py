@@ -455,6 +455,70 @@ def tp_speculative_generate(
             "head-sharding constraint"))
 
 
+def tp_sp_speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "model",
+    seq_axis: str = "seq",
+    rules=None,
+    *,
+    num_draft: int = 4,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    prefill_chunk: int | None = 512,
+    stop_tokens: Sequence[int] | None = None,
+    pad_token: int = 0,
+    return_stats: bool = False,
+):
+    """2-D sharded speculative decoding — the full distributed-serving
+    layout with a draft: the TARGET's weights are Megatron-sharded over
+    ``axis`` and its KV cache sharded over heads (``axis``) AND sequence
+    (``seq_axis``), so per-chip target cache memory is 1/(tp·sp) (the
+    :func:`tpudist.models.generate.tp_sp_generate` layout); the tiny
+    DRAFT stays replicated.  Verify chunks run on the GSPMD-partitioned
+    dense path.  Same output contract as :func:`speculative_generate`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpudist.parallel.tensor_parallel import (
+        shard_tree,
+        spec_tree_from_rules,
+        transformer_tp_rules,
+    )
+
+    tp, sp = mesh.shape[axis], mesh.shape[seq_axis]
+    if target_cfg.kv_heads % tp:
+        raise ValueError(
+            f"target kv_heads {target_cfg.kv_heads} not divisible by "
+            f"{axis!r} size {tp}")
+    if target_cfg.max_seq_len % sp:
+        raise ValueError(
+            f"target max_seq_len {target_cfg.max_seq_len} not divisible "
+            f"by {seq_axis!r} size {sp}")
+
+    specs = spec_tree_from_rules(
+        target_params, rules or transformer_tp_rules(axis))
+    return _sharded_speculative(
+        target_cfg, shard_tree(target_params, mesh, specs), draft_cfg,
+        draft_params, prompt, max_new_tokens, mesh,
+        cache_spec=P(None, seq_axis, axis, None),
+        decode_shard=None, decode_attention="dense",
+        num_draft=num_draft, key=key, temperature=temperature,
+        top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
+        stop_tokens=stop_tokens, pad_token=pad_token,
+        return_stats=return_stats,
+        layout_reason=("the TP rules regex-match the stacked kernels on "
+                       "the wrong axis and the 5-D stacked cache escapes "
+                       "the 2-D cache constraint"))
+
+
 def sp_speculative_generate(
     target_cfg: TransformerConfig,
     target_params: Any,
