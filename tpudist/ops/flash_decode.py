@@ -21,6 +21,11 @@ whole cache — at the bandwidth-bound decode op that is a ~S/window
 speedup.  Positions beyond the cache index, or older than the window,
 mask to -inf as before.
 
+Measured guideline (BASELINE.md round 3): ``head_dim < 128`` underfills
+the 128-lane tile width of the K/V blocks — a d=64 model decodes ~1.86×
+slower than a d=128 model with IDENTICAL cache bytes.  Prefer
+head_dim-128 configurations for decode-heavy workloads.
+
 Reference scope note: the reference suite is training-only (SURVEY.md §2 —
 no inference path anywhere); this kernel + the TP rollout in
 :mod:`tpudist.models.generate` are the framework's serving story.
