@@ -535,6 +535,36 @@ def bench_decode(on_tpu: bool) -> None:
           prefill_ms=round(_net(t_prefill)[0] * 1e3, 1),
           rtt_ms=round(_RTT * 1e3, 1))
 
+    # the head_dim-128 serving guideline, as a captured line: 4q/1kv at
+    # d=128 has IDENTICAL cache bytes and embed width to the 8q/2kv/64d
+    # config above, but its K/V tiles fill the whole 128-lane width —
+    # measured ~1.86x (BASELINE.md round-3 decode decomposition)
+    cfg128 = TransformerConfig(
+        vocab_size=cfg8k.vocab_size, num_layers=cfg8k.num_layers,
+        num_heads=4, num_kv_heads=1, embed_dim=cfg8k.embed_dim,
+        max_seq_len=cfg8k.max_seq_len, compute_dtype=cfg8k.compute_dtype)
+    params128 = TransformerLM(cfg128).init(
+        jax.random.key(0), prompt8k[:, :8])["params"]
+
+    def make_fn128(n):
+        fn = jax.jit(lambda p, t: greedy_generate(
+            cfg128, p, t, n, decode_attention="flash"))
+        int(fn(params128, prompt8k)[0, -1])
+        return fn
+
+    fn128_n, fn128_1 = make_fn128(new_tokens), make_fn128(1)
+    t_full = _best_window(
+        lambda: int(fn128_n(params128, prompt8k)[0, -1]),
+        n_win, lambda: None)
+    t_prefill = _best_window(
+        lambda: int(fn128_1(params128, prompt8k)[0, -1]),
+        n_win, lambda: None)
+    tps128 = batch * (new_tokens - 1) / max(t_full - t_prefill, 1e-9)
+    _emit("kv_decode_8k_flash_d128", round(tps128, 1), "tokens/sec", None,
+          batch=batch, context=cfg8k.max_seq_len, generated=new_tokens,
+          vs_d64=round(tps128 / decode_tps, 2),
+          rtt_ms=round(_RTT * 1e3, 1))
+
 
 def bench_moe(on_tpu: bool) -> None:
     """MoE layer throughput vs an equal-FLOP dense MLP: the top-k
