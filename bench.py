@@ -513,53 +513,46 @@ def bench_decode(on_tpu: bool) -> None:
     params8k = TransformerLM(cfg8k).init(
         jax.random.key(0), prompt8k[:, :8])["params"]
 
-    def make_fn(n):
-        fn = jax.jit(lambda p, t: greedy_generate(
-            cfg8k, p, t, n, decode_attention="flash"))
-        int(fn(params8k, prompt8k)[0, -1])  # compile + warmup
-        return fn
-
-    fn_full = make_fn(new_tokens)
-    fn_prefill = make_fn(1)  # ≈ prefill cost (one decode step after)
     n_win = 3 if on_tpu else 2
-    t_full = _best_window(
-        lambda: int(fn_full(params8k, prompt8k)[0, -1]), n_win,
-        lambda: None)
-    t_prefill = _best_window(
-        lambda: int(fn_prefill(params8k, prompt8k)[0, -1]), n_win,
-        lambda: None)
-    # the full/prefill DIFFERENCE cancels the RTT; prefill alone subtracts it
-    decode_tps = batch * (new_tokens - 1) / max(t_full - t_prefill, 1e-9)
+
+    def serve_8k(cfgx):
+        """ONE copy of the full-minus-prefill timing recipe (the
+        difference cancels the RTT AND the shared prefill cost): returns
+        (decode tokens/sec, prefill seconds)."""
+        paramsx = TransformerLM(cfgx).init(
+            jax.random.key(0), prompt8k[:, :8])["params"]
+
+        def make_fn(n):
+            fn = jax.jit(lambda p, t: greedy_generate(
+                cfgx, p, t, n, decode_attention="flash"))
+            int(fn(paramsx, prompt8k)[0, -1])  # compile + warmup
+            return fn
+
+        fn_full, fn_prefill = make_fn(new_tokens), make_fn(1)
+        t_full = _best_window(
+            lambda: int(fn_full(paramsx, prompt8k)[0, -1]), n_win,
+            lambda: None)
+        t_prefill = _best_window(
+            lambda: int(fn_prefill(paramsx, prompt8k)[0, -1]), n_win,
+            lambda: None)
+        return (batch * (new_tokens - 1) / max(t_full - t_prefill, 1e-9),
+                t_prefill)
+
+    decode_tps, t_prefill = serve_8k(cfg8k)
     _emit("kv_decode_8k_flash", round(decode_tps, 1), "tokens/sec", None,
           batch=batch, context=cfg8k.max_seq_len, generated=new_tokens,
           prefill_ms=round(_net(t_prefill)[0] * 1e3, 1),
           rtt_ms=round(_RTT * 1e3, 1))
 
-    # the head_dim-128 serving guideline, as a captured line: 4q/1kv at
-    # d=128 has IDENTICAL cache bytes and embed width to the 8q/2kv/64d
-    # config above, but its K/V tiles fill the whole 128-lane width —
-    # measured ~1.86x (BASELINE.md round-3 decode decomposition)
-    cfg128 = TransformerConfig(
+    # the head_dim-128 comparison line: 4q/1kv at d=128 has IDENTICAL
+    # cache bytes and embed width to the 8q/2kv/64d config above; with
+    # the paired-head kernel the d=64 config recovers kernel-level
+    # bandwidth parity, so vs_d64 measures the remaining model-level
+    # packing overhead (~1.37x; was 1.86-2x pre-pairing)
+    tps128, _ = serve_8k(TransformerConfig(
         vocab_size=cfg8k.vocab_size, num_layers=cfg8k.num_layers,
         num_heads=4, num_kv_heads=1, embed_dim=cfg8k.embed_dim,
-        max_seq_len=cfg8k.max_seq_len, compute_dtype=cfg8k.compute_dtype)
-    params128 = TransformerLM(cfg128).init(
-        jax.random.key(0), prompt8k[:, :8])["params"]
-
-    def make_fn128(n):
-        fn = jax.jit(lambda p, t: greedy_generate(
-            cfg128, p, t, n, decode_attention="flash"))
-        int(fn(params128, prompt8k)[0, -1])
-        return fn
-
-    fn128_n, fn128_1 = make_fn128(new_tokens), make_fn128(1)
-    t_full = _best_window(
-        lambda: int(fn128_n(params128, prompt8k)[0, -1]),
-        n_win, lambda: None)
-    t_prefill = _best_window(
-        lambda: int(fn128_1(params128, prompt8k)[0, -1]),
-        n_win, lambda: None)
-    tps128 = batch * (new_tokens - 1) / max(t_full - t_prefill, 1e-9)
+        max_seq_len=cfg8k.max_seq_len, compute_dtype=cfg8k.compute_dtype))
     _emit("kv_decode_8k_flash_d128", round(tps128, 1), "tokens/sec", None,
           batch=batch, context=cfg8k.max_seq_len, generated=new_tokens,
           vs_d64=round(tps128 / decode_tps, 2),

@@ -22,9 +22,14 @@ speedup.  Positions beyond the cache index, or older than the window,
 mask to -inf as before.
 
 Measured guideline (BASELINE.md round 3): ``head_dim < 128`` underfills
-the 128-lane tile width of the K/V blocks — a d=64 model decodes ~1.86×
-slower than a d=128 model with IDENTICAL cache bytes.  Prefer
-head_dim-128 configurations for decode-heavy workloads.
+the 128-lane tile width of the K/V blocks (measured: half DMA
+bandwidth).  With EVEN ``h_kv`` the bf16 path recovers full width by
+HEAD PAIRING (see ``_flash_decode_impl``): kernel-level parity with
+d=128 (636 vs 639 GB/s measured), model-level within ~1.37× (residual
+per-step packing overhead).  Odd-``h_kv`` narrow-head models and the
+int8 cache path (whose per-(token, head) scales would need
+per-pair-member handling) stay unpaired at ~half DMA width — prefer
+head_dim-128 configurations where the model design allows.
 
 Reference scope note: the reference suite is training-only (SURVEY.md §2 —
 no inference path anywhere); this kernel + the TP rollout in
@@ -226,12 +231,40 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
             (cache_len - window - offset) // block_k, 0, num_kb_full - nb)
     meta = jnp.stack([cache_len, offset, start_block])
 
-    # [B, 1, H, D] -> [B·Hkv, gp, D]
-    q3 = q.reshape(b, h_kv, g, d)
-    q3 = jnp.pad(q3, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
-    q3 = q3.reshape(b * h_kv, gp, d)
-    k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
-    v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
+    # HEAD PAIRING for narrow head_dim: a [block_k, d] K/V tile with
+    # d < 128 underfills the 128-lane width and streams at ~half
+    # bandwidth (measured: 305 vs 636 GB/s).  When d*2 <= 128 and h_kv
+    # is even, ADJACENT KV-head pairs merge into one [*, 2d] tile (a
+    # pure reshape of the [B, S, H_kv, D] cache), and the queries go in
+    # BLOCK-DIAGONAL: pair rows [q_h0 | 0] and [0 | q_h1] make the
+    # single 2d-lane contraction compute each real head's scores
+    # exactly (the zero half annihilates the other head), while PV
+    # produces each head's output in its own lane half, sliced apart
+    # below.  Costs 2x matmul FLOPs on zeros; buys full-width DMA rows
+    # at the bandwidth-bound op — measured kernel parity with a d=128
+    # layout.  The int8 path stays UNPAIRED: its per-(token, head)
+    # scales are one row per real head and would need per-pair-member
+    # handling in the kernel.
+    scale = d ** -0.5
+    paired = not quant and h_kv % 2 == 0 and d * 2 <= 128
+    q4 = q.reshape(b, h_kv, g, d)                    # [B, Hkv, g, d]
+    q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    if paired:
+        n_rows, kv_rows, d_eff = 2 * gp, h_kv // 2, 2 * d
+        q4 = q4.reshape(b, kv_rows, 2, gp, d)
+        qbd = jnp.zeros((b, kv_rows, 2, gp, 2, d), q.dtype)
+        qbd = qbd.at[:, :, 0, :, 0].set(q4[:, :, 0])
+        qbd = qbd.at[:, :, 1, :, 1].set(q4[:, :, 1])
+        q3 = qbd.reshape(b * kv_rows, n_rows, d_eff)
+        k3 = k_cache.reshape(b, s, kv_rows, d_eff).swapaxes(1, 2).reshape(
+            b * kv_rows, s, d_eff)
+        v3 = v_cache.reshape(b, s, kv_rows, d_eff).swapaxes(1, 2).reshape(
+            b * kv_rows, s, d_eff)
+        gp, h_kv, d = n_rows, kv_rows, d_eff
+    else:
+        q3 = q4.reshape(b * h_kv, gp, d)
+        k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
+        v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
 
     # index maps see the prefetched meta first: grid step j streams cache
     # block meta[2] + j
@@ -263,7 +296,7 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
             jax.ShapeDtypeStruct((b * h_kv, 1, gp), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale=d ** -0.5, block_k=block_k,
+            _decode_kernel, scale=scale, block_k=block_k,
             num_kb=nb, window=window, with_lse=return_lse,
             quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -282,13 +315,26 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
-    if not return_lse:
-        out = outs
+    def unpack_out(out):
+        if paired:
+            # [B·Hkv/2, 2gp, 2d'] -> per pair member, its own lane half
+            d0 = d // 2
+            o = out.reshape(b, h_kv, 2, gp // 2, 2, d0)
+            o = jnp.stack([o[:, :, 0, :, 0], o[:, :, 1, :, 1]], axis=2)
+            return o.reshape(b, h_kv * 2, gp // 2, d0)[:, :, :g].reshape(
+                b, 1, h, d0)
         return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
+
+    def unpack_lse(lse):
+        if paired:
+            return lse.reshape(b, h_kv, 2, gp // 2)[
+                :, :, :, :g].reshape(b, h)
+        return lse.reshape(b, h_kv, gp)[:, :, :g].reshape(b, h)
+
+    if not return_lse:
+        return unpack_out(outs)
     out, lse = outs
-    out = out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
-    lse = lse.reshape(b, h_kv, gp)[:, :, :g].reshape(b, h)
-    return out, lse
+    return unpack_out(out), unpack_lse(lse)
 
 
 def quantize_kv(k: jnp.ndarray, v: jnp.ndarray):
