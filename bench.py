@@ -42,11 +42,30 @@ _PEAK_TFLOPS = {
 }
 
 
+_EMITTED: list[dict] = []  # every metric line, re-printed in the recap
+
+
 def _emit(metric, value, unit, vs_baseline=None, **extra) -> None:
-    print(json.dumps({
-        "metric": metric, "value": value, "unit": unit,
-        "vs_baseline": vs_baseline, **extra,
-    }), flush=True)
+    line = {"metric": metric, "value": value, "unit": unit,
+            "vs_baseline": vs_baseline, **extra}
+    _EMITTED.append(line)
+    print(json.dumps(line), flush=True)
+
+
+def _recap() -> None:
+    """Re-emit every metric line compactly at the very end of the run.
+
+    The driver captures a BOUNDED TAIL of stdout; round 3's audited
+    artifact began mid-line and held only the last few metrics.  Printing
+    the complete set last guarantees the tail always parses to the full
+    metric list (each recap line is a normal metric JSON line, just
+    compactly encoded)."""
+    print(json.dumps({"metric": "bench_recap_begin", "value": len(_EMITTED),
+                      "unit": "lines", "vs_baseline": None}), flush=True)
+    for line in _EMITTED:
+        print(json.dumps(line, separators=(",", ":")), flush=True)
+    print(json.dumps({"metric": "bench_recap_end", "value": len(_EMITTED),
+                      "unit": "lines", "vs_baseline": None}), flush=True)
 
 
 def _peak_tflops() -> float | None:
@@ -979,6 +998,7 @@ def main() -> None:
             bench(on_tpu)
         except Exception as e:  # noqa: BLE001 - one failure must not mute the rest
             _emit(f"ERROR_{bench.__name__}", 0, "error", None, error=str(e)[:200])
+    _recap()
 
 
 if __name__ == "__main__":

@@ -111,6 +111,12 @@ class TestBeamSearch:
                                  4, beam_size=0)
         with pytest.raises(ValueError, match="max_seq_len"):
             beam_search_generate(CFG, None, jnp.ones((1, 60), jnp.int32), 8)
+        with pytest.raises(ValueError, match="vocab_size"):
+            beam_search_generate(CFG, None, jnp.ones((1, 2), jnp.int32),
+                                 4, beam_size=CFG.vocab_size + 1)
+        with pytest.raises(ValueError, match="at least one token"):
+            beam_search_generate(CFG, None,
+                                 jnp.zeros((1, 0), jnp.int32), 4)
 
     def test_jittable(self):
         params = _params()

@@ -74,10 +74,17 @@ def beam_search_generate(
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if beam_size > cfg.vocab_size:
+        raise ValueError(
+            f"beam_size {beam_size} exceeds vocab_size {cfg.vocab_size} "
+            "(top-k over the next-token distribution cannot seed more "
+            "beams than there are tokens)")
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
     b, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must hold at least one token")
     w = beam_size
     stop_arr = _stop_array(stop_tokens)
     total = prompt_len + max_new_tokens

@@ -206,10 +206,15 @@ def speculative_generate(
       return_stats: also return ``{"rounds", "draft_accepted"}``
         (scalars; ``draft_accepted`` counts ACCEPTED draft tokens summed
         over rounds AND batch rows — acceptance rate =
-        draft_accepted / (rounds · K · batch).  Note the lockstep
-        rollout only ADVANCES by the batch-min accepted prefix each
-        round, so emitted tokens can trail acceptance for batch > 1;
-        emitted tokens additionally include one verify token per round).
+        draft_accepted / (rounds · K · batch) — guard the division:
+        ``rounds`` is 0 when ``max_new_tokens == 1`` (the prefill's
+        own next token satisfies the request before any draft/verify
+        round runs), so compute it as
+        ``draft_accepted / max(rounds, 1) / (K · batch)``.  Note the
+        lockstep rollout only ADVANCES by the batch-min accepted prefix
+        each round, so emitted tokens can trail acceptance for
+        batch > 1; emitted tokens additionally include one verify token
+        per round).
       decode_shard / cache_constraint / draft_cache_constraint: the
         sharded-serving hooks (same contracts as in
         :mod:`tpudist.models.generate`): ``decode_shard`` routes the
