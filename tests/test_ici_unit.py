@@ -1,0 +1,131 @@
+"""Unit coverage for the ICI data-plane helpers (single process, no
+distributed world — the multi-process lifecycle is `test_elastic_ici.py`)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpudist.runtime.ici import (
+    IciCollectives,
+    host_snapshot,
+    is_collective_failure,
+)
+
+
+class TestCollectiveFailureClassifier:
+    def test_gloo_failure_matches(self):
+        e = ValueError(
+            "UNKNOWN: Buffer Definition Event: Gloo all-reduce failed: "
+            "[external/gloo/gloo/transport/tcp/pair.cc:538] Read error "
+            "[127.0.0.1]:12684: Connection reset by peer")
+        assert is_collective_failure(e)
+
+    def test_coordination_failure_matches(self):
+        assert is_collective_failure(RuntimeError(
+            "UNAVAILABLE: Failed to send RPC to coordination service"))
+
+    def test_ordinary_bug_does_not_match(self):
+        assert not is_collective_failure(TypeError(
+            "unsupported operand type(s) for +: 'int' and 'str'"))
+        assert not is_collective_failure(ValueError("shapes do not match"))
+
+    def test_control_plane_outage_does_not_match(self):
+        # the coord-store client raises ConnectionError; a dead store must
+        # propagate, not trigger re-rendezvous against itself
+        assert not is_collective_failure(ConnectionError(
+            "Connection refused"))
+
+
+class TestHostSnapshot:
+    def test_roundtrip_arrays_and_keys(self):
+        tree = {
+            "w": jax.numpy.arange(6, dtype=jax.numpy.float32).reshape(2, 3),
+            "rng": jax.random.key(7),
+            "n": np.int64(3),
+        }
+        host, restore = host_snapshot(tree)
+        # host side is pure numpy (survives a backend swap)
+        assert isinstance(host["w"], np.ndarray)
+        assert isinstance(host["rng"], np.ndarray)  # raw key bits
+        back = restore()
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(tree["w"]))
+        # the key round-trips as a TYPED key producing identical streams
+        want = jax.random.normal(tree["rng"], (4,))
+        got = jax.random.normal(back["rng"], (4,))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_snapshot_is_a_copy(self):
+        tree = {"x": np.ones(3, np.float32)}
+        host, restore = host_snapshot(tree)
+        tree["x"][0] = 99.0
+        assert restore()["x"][0] == 1.0
+
+
+class TestIciCollectivesSingleProcess:
+    """On one process the mesh spans the local simulated devices; the
+    compiled path (stack, pmean, local-row extraction, HLO capture) is
+    identical to the multi-process case minus the network."""
+
+    def _mesh(self):
+        return jax.sharding.Mesh(np.asarray(jax.devices()), ("data",))
+
+    def test_allreduce_mean_identity_and_hlo(self):
+        coll = IciCollectives(self._mesh())
+        grads = {"w": np.full((4, 8), 3.0, np.float32),
+                 "b": np.asarray(2.0, np.float32)}
+        out = coll.allreduce_mean(grads)
+        np.testing.assert_allclose(out["w"], grads["w"])
+        np.testing.assert_allclose(out["b"], grads["b"])
+        assert coll.last_hlo is not None
+        assert "all-reduce" in coll.last_hlo
+
+    def test_allreduce_sum_scales_by_process_count(self):
+        coll = IciCollectives(self._mesh())
+        out = coll.allreduce_sum({"x": np.ones(4, np.float32)})
+        np.testing.assert_allclose(out["x"],
+                                   np.ones(4) * jax.process_count())
+
+    def test_executable_cache_reuse(self):
+        coll = IciCollectives(self._mesh())
+        coll.allreduce_mean({"x": np.ones(4, np.float32)})
+        assert len(coll._execs) == 1
+        coll.allreduce_mean({"x": np.full(4, 2.0, np.float32)})
+        assert len(coll._execs) == 1  # same structure -> same executable
+        coll.allreduce_mean({"x": np.ones((2, 2), np.float32)})
+        assert len(coll._execs) == 2
+
+    def test_on_check_runs_before_dispatch(self):
+        calls = []
+        coll = IciCollectives(self._mesh(), on_check=lambda: calls.append(1))
+        coll.allreduce_mean({"x": np.ones(2, np.float32)})
+        assert calls  # probe fired at least once (pre-dispatch + polls)
+
+    def test_release_drops_backend_refs(self):
+        coll = IciCollectives(self._mesh())
+        coll.allreduce_mean({"x": np.ones(2, np.float32)})
+        coll.release()
+        assert coll._execs == {} and coll.mesh is None
+
+    def test_world_accounting(self):
+        coll = IciCollectives(self._mesh())
+        assert coll.world == jax.device_count()
+        assert coll.local_rows == jax.local_device_count()
+        assert coll.num_processes == jax.process_count()
+
+
+class TestElasticContextDefaults:
+    def test_host_plane_defaults(self):
+        from tpudist.elastic.worker import ElasticContext
+
+        ctx = ElasticContext(0, 1, 0, None, None)
+        assert ctx.mesh is None
+        assert ctx.data_plane == "host"
+
+    def test_unknown_data_plane_rejected(self):
+        from tpudist.elastic.worker import run_elastic_worker
+
+        with pytest.raises(ValueError, match="data_plane"):
+            run_elastic_worker(lambda s, c: None, None,
+                               data_plane="nccl")

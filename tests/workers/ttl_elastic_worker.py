@@ -54,6 +54,10 @@ if int(os.environ.get("TPUDIST_RESTART_ATTEMPT", "0")) > 0:
 CKPT_DIR = os.environ.get("WORKER_CKPT_DIR")
 STEP_DELAY = float(os.environ.get("WORKER_STEP_DELAY", "0"))
 OUT = os.environ["WORKER_OUT_DIR"]
+# "host" (store-backed allreduce) or "ici" (compiled XLA pmean over a
+# per-round jax.distributed world) — the train_fn below is IDENTICAL for
+# both: ctx.collectives carries the same allreduce_mean API either way
+DATA_PLANE = os.environ.get("WORKER_DATA_PLANE", "host")
 
 
 def emit(event: str, **fields) -> None:
@@ -111,6 +115,7 @@ def main() -> int:
              resume_batch=state.host.batch)
         shard = GLOBAL_BATCH // ctx.world_size
         last_loss = float("nan")
+        hlo_emitted = False
         for step in range(state.host.batch, TOTAL_STEPS):
             if STEP_DELAY:
                 time.sleep(STEP_DELAY)  # stretch the run for join tests
@@ -121,7 +126,14 @@ def main() -> int:
             # one fused allreduce syncs grads AND the scalar loss (the
             # XLA-fusion analog on the control plane: one payload)
             grads, gloss = ctx.collectives.allreduce_mean(
-                (grads, np.asarray(float(loss))))
+                (grads, np.asarray(float(loss), np.float32)))
+            if ctx.data_plane == "ici" and not hlo_emitted:
+                # the proof the verdict asked for: this round's gradient
+                # sync is a compiled XLA all-reduce, not store traffic
+                emit("hlo", round=ctx.round, world=ctx.world_size,
+                     all_reduce="all-reduce" in
+                     (ctx.collectives.last_hlo or ""))
+                hlo_emitted = True
             state.state = state.state.apply_gradients(grads)
             state.host.batch = step + 1
             last_loss = float(gloss)
@@ -141,7 +153,8 @@ def main() -> int:
              world=ctx.world_size)
 
     run_elastic_worker(train_fn, state, worker_id=f"w{SPAWN_ID}",
-                       ttl_s=1.5, heartbeat_interval_s=0.3)
+                       ttl_s=1.5, heartbeat_interval_s=0.3,
+                       data_plane=DATA_PLANE)
     return 0
 
 

@@ -33,7 +33,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Callable
+import time
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -42,6 +43,7 @@ from tpudist.elastic.loop import WorldChanged
 from tpudist.elastic.state import ElasticState
 from tpudist.runtime.collectives import HostCollectives, PeerLost
 from tpudist.runtime.coord import CoordClient, ElasticMonitor, Rendezvous
+from tpudist.runtime.ici import host_snapshot
 from tpudist.utils.logging import get_logger
 from tpudist.utils.trees import host_to_leaf, tree_to_numpy
 
@@ -50,13 +52,22 @@ log = get_logger(__name__)
 
 @dataclasses.dataclass
 class ElasticContext:
-    """Per-round handles passed to the train function."""
+    """Per-round handles passed to the train function.
+
+    ``collectives`` is the round's DATA plane: :class:`HostCollectives`
+    (store-backed, ``data_plane="host"``) or
+    :class:`~tpudist.runtime.ici.IciCollectives` (compiled XLA
+    ``pmean`` over ``mesh``, ``data_plane="ici"``) — same
+    ``allreduce_mean`` API either way, so train functions are
+    plane-agnostic."""
 
     rank: int
     world_size: int
     round: int
-    collectives: HostCollectives
+    collectives: Any
     monitor: ElasticMonitor
+    mesh: Any = None
+    data_plane: str = "host"
 
     def check(self) -> None:
         """Membership probe — call at commit points (the Horovod per-commit
@@ -80,6 +91,34 @@ def _next_round(client: CoordClient, round_id: int) -> int:
     return max(round_id + 1, published + 1)
 
 
+def _drop_ici_world(ici: Any, data_coll: Any, state: ElasticState,
+                    exc: BaseException) -> None:
+    """Free a dead round's distributed world INSIDE the failure handler —
+    before re-rendezvous, not at the next round's formation.
+
+    This ordering is load-bearing for detection symmetry: when a member
+    dies mid-collective, the peer adjacent to it in the gloo ring gets an
+    instant connection-reset, but a non-adjacent survivor stays BLOCKED
+    waiting on data that must transit the detector — it only unblocks
+    when the detector's old sockets actually close.  Tearing down here
+    (executables released, traceback frames dropped so nothing pins the
+    dead client, then ``clear_backends`` + collect) closes them within
+    milliseconds; deferring to the next ``form()`` would leave the
+    blocked peer out of the new rendezvous for the whole live-grace
+    window and splinter the gang into world-of-1 rounds (observed before
+    this ordering was fixed)."""
+    if ici is None:
+        return
+    _, restore = host_snapshot(state.state)
+    exc.__traceback__ = None  # tb frames pin the dead world's arrays
+    from tpudist.runtime.ici import IciCollectives
+
+    if isinstance(data_coll, IciCollectives):
+        data_coll.release()
+    ici.teardown()
+    state.state = restore()
+
+
 def _coord_client(coord_addr: str | None) -> CoordClient:
     addr = coord_addr or os.environ.get("TPUDIST_COORD_ADDR")
     if not addr:
@@ -99,24 +138,61 @@ def run_elastic_worker(
     heartbeat_interval_s: float = 0.5,
     max_rounds: int = 10,
     rendezvous_timeout_s: float = 60.0,
+    data_plane: str = "host",
 ) -> ElasticState:
     """Run ``train_fn`` under TTL-heartbeat elastic supervision.
 
     Returns the final state after ``train_fn`` completes at some world
     size.  Raises after ``max_rounds`` re-rendezvous attempts (torchrun's
-    ``--max-restarts``)."""
+    ``--max-restarts``).
+
+    ``data_plane`` selects where gradient bytes travel:
+
+    * ``"host"`` — store-backed :class:`HostCollectives` (the reference's
+      gloo-on-CPU parity path, dynamic membership with zero backend
+      state);
+    * ``"ici"`` — each round bootstraps a ``jax.distributed`` world sized
+      to the rendezvous and ``ctx.collectives`` runs compiled
+      ``jax.lax.pmean`` over ``ctx.mesh`` (XLA collectives: ICI/DCN on
+      TPU, gloo TCP on the CPU backend).  The store then carries ONLY
+      control traffic (rendezvous, address agreement, state broadcast at
+      round formation) — the role split of ``native/coord.cpp:11-13``.
+      A peer dying mid-collective surfaces as a catchable runtime error
+      (see :mod:`tpudist.runtime.ici`) and is handled exactly like
+      :class:`PeerLost` on the host plane.
+    """
+    if data_plane not in ("host", "ici"):
+        raise ValueError(f"unknown data_plane {data_plane!r}")
     client = _coord_client(coord_addr)
     wid = worker_id or f"w{os.getpid()}"
     monitor = ElasticMonitor(client, wid, ttl_s=ttl_s,
                              interval_s=heartbeat_interval_s)
     monitor.start(None)  # beat first: liveness is membership
     rdzv = Rendezvous(client)
+    ici = None
+    if data_plane == "ici":
+        from tpudist.runtime.ici import IciDataPlane
+
+        ici = IciDataPlane(client)
     raw = client.get("elastic/round")
     round_id = 0 if raw is None else int(raw) + 1
     # soft assembly target for round 0: the launcher-declared gang size
     min_world = int(os.environ.get("TPUDIST_NUM_PROCESSES", "1"))
     rounds = 0
     first_round = True
+
+    def recover(exc: BaseException, new_size: int) -> tuple[int, int]:
+        """The shared WorldChanged/peer-loss recovery tail: drop the dead
+        ICI world FIRST (ordering is load-bearing — see
+        :func:`_drop_ici_world`), roll back + fire reset callbacks, close
+        the round's store keys, advance the round.  Returns the next
+        ``(round_id, min_world)``.  Reads ``data_coll``/``coll``/
+        ``round_id`` late-bound so it always acts on the current round."""
+        _drop_ici_world(ici, data_coll, state, exc)
+        state.on_world_change(new_size)
+        coll.close_round()
+        return _next_round(client, round_id), new_size
+
     try:
         while True:
             try:
@@ -143,6 +219,24 @@ def run_elastic_worker(
             coll = HostCollectives(client, rank, world, round_id,
                                    on_wait=monitor.check)
             try:
+                mesh = None
+                data_coll: Any = coll
+                if ici is not None:
+                    # the backend swap: everything device-side goes to
+                    # host, the distributed world re-forms at this
+                    # round's size, the tree comes back typed on the new
+                    # backend.  restore() runs even when form() fails so
+                    # the rollback path never maps over dead arrays.
+                    _, restore = host_snapshot(state.state)
+                    try:
+                        mesh = ici.form(round_id, rank, world,
+                                        on_wait=monitor.check)
+                    finally:
+                        state.state = restore()
+                    from tpudist.runtime.ici import IciCollectives
+
+                    data_coll = IciCollectives(mesh,
+                                               on_check=monitor.check)
                 # bitwise state agreement across the new world (the
                 # hvd.broadcast_parameters / TorchState re-broadcast role) —
                 # INCLUDING the host position: a freshly-joined worker starts
@@ -196,9 +290,25 @@ def run_elastic_worker(
                 state.commit()  # the agreed state is the rollback point
                 log.info("round %d: rank %d of %d (%s)", round_id, rank,
                          world, ",".join(members))
-                train_fn(state, ElasticContext(rank, world, round_id, coll,
-                                               monitor))
+                train_fn(state, ElasticContext(
+                    rank, world, round_id, data_coll, monitor,
+                    mesh=mesh, data_plane=data_plane))
                 coll.barrier()  # all ranks finish before anyone leaves
+                if ici is not None:
+                    # the distributed world dies with the run; hand the
+                    # caller host-resident state (documented contract;
+                    # typed PRNG keys survive via host_snapshot, exactly
+                    # as on the failure path).  finalize = disconnect,
+                    # barrier, reap service procs.
+                    _, restore = host_snapshot(state.state)
+                    try:
+                        state.state = None
+                        ici.finalize(rank, coll.barrier)
+                    finally:
+                        # restore even when finalize raises (a peer dying
+                        # at the final barrier) — the recovery handlers
+                        # must never see a None state tree
+                        state.state = restore()
                 return state
             except WorldChanged as e:
                 rounds += 1
@@ -209,22 +319,52 @@ def run_elastic_worker(
                     "(epoch %d, batch %d)", round_id, world,
                     e.new_world_size, state.commits,
                     state._committed_host.epoch, state._committed_host.batch)
-                state.on_world_change(e.new_world_size)
-                coll.close_round()
-                round_id = _next_round(client, round_id)
-                min_world = e.new_world_size
-            except PeerLost as e:
-                # a wait deadline fired before the TTL did — treat as a
-                # membership change at the currently-live size
+                mesh = None  # the Mesh itself pins the dead world's client
+                round_id, min_world = recover(e, e.new_world_size)
+                data_coll = coll
+            except Exception as e:
+                # PeerLost: a host-plane wait deadline fired before the
+                # TTL did.  On the ICI plane the same event surfaces as a
+                # failed compiled collective ("Gloo all-reduce failed:
+                # Connection reset by peer") or a FormationTimeout —
+                # every one of them is a membership change at the
+                # currently-live size; anything else is a real bug and
+                # propagates.
+                peerish = isinstance(e, PeerLost)
+                if not peerish and ici is not None:
+                    from tpudist.runtime.ici import (
+                        FormationTimeout, is_collective_failure,
+                    )
+
+                    peerish = (isinstance(e, FormationTimeout)
+                               or is_collective_failure(e))
+                if not peerish:
+                    raise
                 rounds += 1
                 if rounds > max_rounds:
                     raise
+                # A failed collective says SOMETHING changed, not what: a
+                # connection-reset arrives within milliseconds of a peer's
+                # death, before its TTL lease expires, so an immediate
+                # live() sample would still count the corpse and this
+                # member's reset callbacks would fire with a stale world.
+                # Poll until the lease drops or one TTL passes (transient
+                # failures with nobody dead exit at the deadline with the
+                # unchanged size and simply re-form).
+                deadline = time.monotonic() + ttl_s + heartbeat_interval_s
                 live = len(client.live())
+                while live >= world and time.monotonic() < deadline:
+                    time.sleep(heartbeat_interval_s / 2)
+                    live = len(client.live())
                 log.warning("round %d: %s; re-rendezvous at %d", round_id,
                             e, live)
-                state.on_world_change(live)
-                coll.close_round()
-                round_id = _next_round(client, round_id)
-                min_world = live
+                mesh = None  # the Mesh itself pins the dead world's client
+                round_id, min_world = recover(e, live)
+                data_coll = coll
     finally:
+        if ici is not None:
+            try:
+                ici.teardown()  # idempotent; frees the distributed world
+            except Exception:  # noqa: BLE001 - teardown must not mask the cause
+                pass
         monitor.stop(graceful=True)

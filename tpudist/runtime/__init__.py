@@ -14,6 +14,12 @@ from tpudist.runtime.distributed import (
     process_index,
     world_info,
 )
+from tpudist.runtime.ici import (
+    IciCollectives,
+    IciDataPlane,
+    host_snapshot,
+    is_collective_failure,
+)
 from tpudist.runtime.mesh import (
     MeshSpec,
     data_mesh,
@@ -25,7 +31,11 @@ from tpudist.runtime.mesh import (
 
 __all__ = [
     "DistributedContext",
+    "IciCollectives",
+    "IciDataPlane",
     "MeshSpec",
+    "host_snapshot",
+    "is_collective_failure",
     "data_mesh",
     "data_model_mesh",
     "get_devices",

@@ -1,0 +1,61 @@
+"""Standalone host for the per-round ``jax.distributed`` coordination
+service (run as ``python -m tpudist.runtime.ici_service``).
+
+The coordination service bootstraps each elastic round's PJRT world
+(:mod:`tpudist.runtime.ici`).  Hosting it INSIDE a worker — the
+``jax.distributed.initialize`` default, where rank 0 is the leader — is
+fatal to elasticity: a coordination client whose leader becomes
+unreachable terminates its own process (the agent's error-poll /
+missed-heartbeat handlers end in ``LOG(FATAL)``, and the Python
+``missed_heartbeat_callback`` binding aborts on invocation), so the
+round's leader dying would take every survivor down with it.  Running the
+service in this tiny dedicated process — the same role torchrun gives a
+standalone c10d/etcd rendezvous host — means no worker is ever the
+leader: members always have a live service to disconnect from cleanly,
+whatever happened to their peers.
+
+Lifecycle: spawned (detached) by the round's rank 0, killed by a later
+round's rank 0 once the round is two generations stale, or at end of run
+(`IciDataPlane.finalize`).  Exits on SIGTERM; also self-expires after
+``--max-lifetime-s`` as a leak backstop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--heartbeat-timeout-s", type=int, default=86400)
+    ap.add_argument("--max-lifetime-s", type=float, default=3600.0)
+    args = ap.parse_args(argv)
+
+    from jax._src.lib import _jax as _jaxlib
+
+    service = _jaxlib.get_distributed_runtime_service(
+        f"[::]:{args.port}", args.world,
+        heartbeat_timeout=args.heartbeat_timeout_s,
+        shutdown_timeout=5)
+    print("ready", flush=True)
+
+    stop = {"flag": False}
+
+    def on_term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    deadline = time.monotonic() + args.max_lifetime_s
+    while not stop["flag"] and time.monotonic() < deadline:
+        time.sleep(0.2)
+    del service  # all clients have disconnected by the time we're killed
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
