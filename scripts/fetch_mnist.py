@@ -1,0 +1,105 @@
+"""Turnkey real-MNIST: fetch (or explain how to mount) the IDX files.
+
+The reference trains on torchvision MNIST to >=97% test accuracy
+(`/root/reference/pytorch_elastic/mnist_ddp_elastic.py:166-171`); this
+image has no bundled dataset, so real-MNIST parity is a gate that arms
+itself the moment data exists (``tests/test_real_mnist.py``,
+``bench.py: real_mnist``).  Run this script to make that happen:
+
+    python scripts/fetch_mnist.py [--dest data/MNIST/raw]
+
+It tries the public mirrors in order and verifies the download by
+actually parsing the IDX files.  In a zero-egress environment it exits
+with the mount instructions instead (copy the four
+``train-images-idx3-ubyte[.gz]``-family files into the dest directory, or
+point ``TPUDIST_MNIST_DIR`` at an existing copy).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+MIRRORS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "https://yann.lecun.com/exdb/mnist/",
+)
+FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
+
+
+def fetch(dest: Path, timeout_s: float = 30.0, quiet: bool = False) -> bool:
+    """Download the four IDX archives into ``dest``; returns success.
+    Files already present (and parseable) are kept."""
+    dest.mkdir(parents=True, exist_ok=True)
+    from tpudist.data.mnist import load_mnist_idx
+
+    try:
+        load_mnist_idx(dest, "train")
+        load_mnist_idx(dest, "test")
+        if not quiet:
+            print(f"already complete: {dest}")
+        return True
+    except FileNotFoundError:
+        pass
+    for name in FILES:
+        out = dest / name
+        if out.exists():
+            continue
+        for mirror in MIRRORS:
+            url = mirror + name
+            try:
+                if not quiet:
+                    print(f"fetching {url} ...", flush=True)
+                with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                    data = r.read()
+                out.write_bytes(data)
+                break
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if not quiet:
+                    print(f"  {type(e).__name__}: {e}", file=sys.stderr)
+        else:
+            return False
+    try:  # verify by parsing — a captive-portal HTML page is not a dataset
+        load_mnist_idx(dest, "train")
+        load_mnist_idx(dest, "test")
+    except Exception as e:  # noqa: BLE001 - any parse failure = bad download
+        if not quiet:
+            print(f"downloaded files failed to parse: {e}", file=sys.stderr)
+        return False
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dest", default="data/MNIST/raw",
+                    help="directory for the IDX files (the default is on "
+                         "load_mnist's search path)")
+    args = ap.parse_args()
+    dest = Path(args.dest)
+    if fetch(dest):
+        print(f"real MNIST ready in {dest} — the parity gate "
+              "(tests/test_real_mnist.py) and the bench.py real_mnist "
+              "line are now armed")
+        return 0
+    print(
+        "\nNo egress (or all mirrors unreachable).  To arm the real-MNIST\n"
+        "parity gate, mount the four IDX files (gz or raw) into\n"
+        f"  {dest}\n"
+        "or set TPUDIST_MNIST_DIR to an existing MNIST/raw directory.",
+        file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Worker for the true multi-process DCN bootstrap test: calls the
+PUBLIC ``tpudist.runtime.initialize()`` with NO arguments — the world
+description comes from the launcher env contract (TPUDIST_COORDINATOR /
+TPUDIST_NUM_PROCESSES / TPUDIST_PROCESS_ID, the RANK/WORLD_SIZE analog of
+`mnist_ddp_elastic.py:44-45`) — then proves the joined world with a
+compiled cross-process psum.
+"""
+
+import json
+import os
+import sys
+
+from tpudist.runtime.simulate import force_cpu_devices
+
+force_cpu_devices(1, check=False)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import tpudist  # noqa: E402
+
+
+def main() -> int:
+    ctx = tpudist.runtime.initialize()  # env-driven: the DCN bootstrap path
+    out = {
+        "process_index": ctx.process_index,
+        "process_count": ctx.process_count,
+        "global_devices": ctx.global_device_count,
+        "local_devices": ctx.local_device_count,
+        "is_coordinator": ctx.is_coordinator,
+    }
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+    local = np.full((1, 4), ctx.process_index + 1, np.float32)
+    x = jax.make_array_from_process_local_data(
+        sh, local, (ctx.global_device_count, 4))
+
+    @jax.jit
+    def allsum(x):
+        def f(x):
+            return jax.lax.psum(x, "data")
+        return jax.shard_map(f, mesh=mesh,
+                             in_specs=jax.sharding.PartitionSpec("data"),
+                             out_specs=jax.sharding.PartitionSpec("data"))(x)
+
+    summed = allsum(x)
+    out["psum"] = float(np.asarray(summed.addressable_shards[0].data)[0, 0])
+    out["hlo_all_reduce"] = "all-reduce" in jax.jit(
+        lambda x: allsum(x)).lower(x).compile().as_text()
+
+    with open(os.path.join(os.environ["WORKER_OUT_DIR"],
+                           f"dcn_{ctx.process_index}.json"), "w") as fh:
+        json.dump(out, fh)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,12 +125,7 @@ def host_snapshot(tree: Any) -> tuple[Any, Callable[[], Any]]:
 # dead/retired leader
 _GRAVEYARD: list = []
 
-
-def _free_port() -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("", 0))
-        return s.getsockname()[1]
+from tpudist.runtime.launch import _free_port  # noqa: E402 - one probe, shared
 
 
 class IciDataPlane:
@@ -324,13 +319,16 @@ class IciDataPlane:
             [sys.executable, "-m", "tpudist.runtime.ici_service",
              "--port", str(port), "--world", str(world),
              "--heartbeat-timeout-s", str(self.heartbeat_timeout_s)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            stdout=subprocess.PIPE,  # stderr inherited: diagnostics surface
             start_new_session=True)  # detach: must outlive this worker
         ready, _, _ = select.select([proc.stdout], [], [],
                                     self.init_timeout_s)
         if not ready or proc.stdout.readline().strip() != b"ready":
             proc.kill()
-            raise RuntimeError(
+            # FormationTimeout: the worker loop treats this like any other
+            # membership change and re-rendezvouses (a port-bind race or a
+            # slow host must not crash the gang member)
+            raise FormationTimeout(
                 f"ici round {round_id}: service process never came up")
         proc.stdout.close()
         self.client.set(f"{self.ns}/{round_id}/svc",
