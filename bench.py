@@ -598,7 +598,10 @@ def bench_real_mnist(on_tpu: bool) -> None:
                 train_ds = load_mnist_idx(cand, "train")  # probe = the load
                 directory = Path(cand)
                 break
-            except FileNotFoundError:
+            except Exception:  # noqa: BLE001 - missing OR corrupt -> skip
+                # a truncated/captive-portal file raises ValueError /
+                # struct.error / BadGzipFile, not FileNotFoundError; none
+                # may kill the whole bench sweep
                 continue
     if directory is None:
         _emit("real_mnist_skipped", 0, "n/a", None,
@@ -1012,10 +1015,15 @@ def bench_speculative_decode(on_tpu: bool) -> None:
 
     def spec(n):
         def run(tp, dp, t):
+            # auto_unstack=False: the SCANNED target is deliberate here —
+            # verify chunks amortize the stacked-cache slicing and the
+            # depth-independent HLO is what fits the tunnel's remote-
+            # compile request limit (serving_layout would unroll it)
             toks, stats = speculative_generate(
                 target_cfg, tp, draft_cfg, dp, t, n,
                 num_draft=k_spec, decode_attention=attn,
-                draft_decode_attention=attn, return_stats=True)
+                draft_decode_attention=attn, return_stats=True,
+                auto_unstack=False)
             return toks, stats["rounds"], stats["draft_accepted"]
         fn = jax.jit(run)
 

@@ -76,6 +76,10 @@ def fetch(dest: Path, timeout_s: float = 30.0, quiet: bool = False) -> bool:
     except Exception as e:  # noqa: BLE001 - any parse failure = bad download
         if not quiet:
             print(f"downloaded files failed to parse: {e}", file=sys.stderr)
+        # remove the bad bytes: leaving them would make every retry skip
+        # the download (the exists() check) and fail the parse forever
+        for name in FILES:
+            (dest / name).unlink(missing_ok=True)
         return False
     return True
 

@@ -53,6 +53,7 @@ def beam_search_generate(
     stop_tokens: Sequence[int] | None = None,
     pad_token: int = 0,
     return_scores: bool = False,
+    auto_unstack: bool = True,
 ):
     """Beam-search ``max_new_tokens`` past ``prompt``.
 
@@ -72,6 +73,10 @@ def beam_search_generate(
     ``return_scores`` is set.  With ``stop_tokens`` the return becomes
     ``(tokens, lengths[, scores])`` as elsewhere.
     """
+    if auto_unstack:
+        from tpudist.models.generate import serving_layout
+
+        cfg, params = serving_layout(cfg, params)
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     if beam_size > cfg.vocab_size:

@@ -16,10 +16,41 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpudist.models.transformer import TransformerConfig, TransformerLM
+from tpudist.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    unstack_layer_params,
+)
 
 # (logits [B, V], key) -> next token [B] int32
 SelectFn = Callable[[jnp.ndarray, jax.Array], jnp.ndarray]
+
+
+def serving_layout(cfg: TransformerConfig, params: Any,
+                   ) -> tuple[TransformerConfig, Any]:
+    """Normalize ``(cfg, params)`` to the UNROLLED layout for serving.
+
+    ``scan_layers=True`` is the right layout for TRAINING (depth-
+    independent compile size) but the wrong one for token-at-a-time
+    decode: every step pays a per-layer dynamic-slice of the stacked
+    cache (~4× slower at 8k context, BASELINE.md), and the sharded entry
+    points' Megatron rules match per-layer kernel names.  Every serving
+    entry point calls this, so a checkpoint trained scanned serves at
+    unrolled speed with no manual conversion step: stacked ``blocks``
+    params are unstacked (a few slices, free next to any rollout) and the
+    config is flipped.  Already-unrolled inputs pass through untouched.
+    """
+    import dataclasses
+
+    try:
+        stacked = "blocks" in params
+    except TypeError:  # non-mapping param containers pass through
+        stacked = False
+    if stacked:
+        params = unstack_layer_params(params, cfg.num_layers)
+    if cfg.scan_layers:
+        cfg = dataclasses.replace(cfg, scan_layers=False)
+    return cfg, params
 
 
 def _stop_array(stop_tokens: Sequence[int] | None) -> jnp.ndarray | None:
@@ -186,6 +217,7 @@ def greedy_generate(
     prefill_chunk: int | None = None,
     stop_tokens: Sequence[int] | None = None,
     pad_token: int = 0,
+    auto_unstack: bool = True,
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Greedy-decode ``max_new_tokens`` past ``prompt``.
 
@@ -198,6 +230,10 @@ def greedy_generate(
       stop_tokens: optional EOS set; positions past a sequence's first
         stop token freeze to ``pad_token`` and per-sequence lengths are
         returned alongside the tokens.
+      auto_unstack: serve scanned-trained checkpoints through the
+        unrolled layout (:func:`serving_layout` — ~4× faster decode).
+        Pass False to decode through the stacked layout itself (the
+        depth-independent-compile-size trade).
 
     Returns:
       ``[batch, prompt_len + max_new_tokens]`` int32: prompt + greedy
@@ -205,6 +241,8 @@ def greedy_generate(
       given).  ``prompt_len + max_new_tokens`` must fit in
       ``cfg.max_seq_len``.
     """
+    if auto_unstack:
+        cfg, params = serving_layout(cfg, params)
     return _rollout(
         cfg, params, prompt, max_new_tokens,
         lambda logits, _key: jnp.argmax(logits, axis=-1),
@@ -225,13 +263,11 @@ def _sharded_generate(cfg, params, prompt, max_new_tokens, mesh, *,
     sampling selector can never drift between the three layouts."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if cfg.scan_layers:
-        raise ValueError(
-            "sharded serving needs the UNROLLED param layout "
-            "(scan_layers=False): the TP rules regex-match the stacked "
-            "[L, in, out] kernels on the wrong axis and the 5-D stacked "
-            "cache escapes the cache-sharding constraint — convert with "
-            "unstack_layer_params")
+    # (cfg, params) arrive NORMALIZED: every public sharded entry point
+    # runs serving_layout before computing its shardings — sharded
+    # serving requires the unrolled layout (the TP rules regex-match
+    # per-layer kernel names and the 5-D stacked cache would escape the
+    # cache-sharding constraint)
 
     def cache_constraint(leaf):
         if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
@@ -291,6 +327,9 @@ def tp_generate(
         transformer_tp_rules,
     )
 
+    # normalize BEFORE the spec computation: the TP rules regex-match
+    # per-layer kernel names, which a stacked checkpoint doesn't have
+    cfg, params = serving_layout(cfg, params)
     tp = mesh.shape[axis]
     if cfg.kv_heads % tp:
         raise ValueError(
@@ -345,6 +384,7 @@ def sp_generate(
     the same tokens as :func:`greedy_generate`."""
     from jax.sharding import PartitionSpec as P
 
+    cfg, params = serving_layout(cfg, params)
     if cfg.max_seq_len % mesh.shape[axis]:
         raise ValueError(
             f"max_seq_len {cfg.max_seq_len} not divisible by {axis!r} "
@@ -398,6 +438,7 @@ def tp_sp_generate(
         transformer_tp_rules,
     )
 
+    cfg, params = serving_layout(cfg, params)  # TP rules need per-layer names
     tp, sp = mesh.shape[axis], mesh.shape[seq_axis]
     if cfg.kv_heads % tp:
         raise ValueError(
@@ -458,6 +499,7 @@ def sample_generate(
     prefill_chunk: int | None = None,
     stop_tokens: Sequence[int] | None = None,
     pad_token: int = 0,
+    auto_unstack: bool = True,
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Sample ``max_new_tokens`` past ``prompt`` with the standard
     controls, all static-shape (one compiled rollout, like greedy):
@@ -468,7 +510,12 @@ def sample_generate(
       reaches p (applied after top_k when both are set);
     * ``stop_tokens`` freezes a sequence at its first stop token (see
       :func:`greedy_generate`); returns ``(tokens, lengths)`` when set.
+
+    ``auto_unstack``: as in :func:`greedy_generate` — scanned-trained
+    checkpoints serve through the unrolled layout by default.
     """
+    if auto_unstack:
+        cfg, params = serving_layout(cfg, params)
     select = _make_select(temperature, top_k, top_p)
     return _rollout(cfg, params, prompt, max_new_tokens, select, key,
                     decode_attention=decode_attention,

@@ -176,6 +176,7 @@ def speculative_generate(
     decode_shard: Any = None,
     cache_constraint: Any = None,
     draft_cache_constraint: Any = None,
+    auto_unstack: bool = True,
 ):
     """Generate ``max_new_tokens`` past ``prompt`` with draft/verify
     speculative decoding.
@@ -227,6 +228,19 @@ def speculative_generate(
     ``(tokens, lengths)`` when ``stop_tokens`` is given, and the stats
     dict appended when ``return_stats`` is set.
     """
+    if auto_unstack:
+        # Serve scanned-trained checkpoints through the unrolled layout
+        # by default (generate.serving_layout).  Opting out is legitimate
+        # for the TARGET: it only ever runs chunk verifies, which
+        # amortize the stacked-cache slicing, so a scanned target keeps
+        # its depth-independent compile size at ~no step-time cost — the
+        # configuration bench.py uses.  The DRAFT runs single-token
+        # steps, where the stacked layout costs ~4×.
+        from tpudist.models.generate import serving_layout
+
+        target_cfg, target_params = serving_layout(target_cfg,
+                                                   target_params)
+        draft_cfg, draft_params = serving_layout(draft_cfg, draft_params)
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab_size} != target vocab "
@@ -356,18 +370,15 @@ def _sharded_speculative(
     target_cfg, target_params, draft_cfg, draft_params, prompt,
     max_new_tokens, mesh, *, cache_spec, decode_shard, decode_attention,
     num_draft, key, temperature, top_k, top_p, prefill_chunk,
-    stop_tokens, pad_token, return_stats, layout_reason):
+    stop_tokens, pad_token, return_stats):
     """Common tail of the sharded speculative entry points (tp / sp) —
-    one copy of the scan_layers guard, cache-constraint closures, key
-    default, and kwarg plumbing, mirroring ``generate._sharded_generate``
-    so the layouts can never drift."""
+    one copy of the serving-layout normalization, cache-constraint
+    closures, key default, and kwarg plumbing, mirroring
+    ``generate._sharded_generate`` so the layouts can never drift."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if target_cfg.scan_layers:
-        raise ValueError(
-            "sharded speculative decoding needs the UNROLLED target "
-            f"layout: {layout_reason} — convert with "
-            "unstack_layer_params and scan_layers=False")
+    # (cfgs, params) arrive NORMALIZED: every public sharded entry point
+    # runs serving_layout on target AND draft before its shardings
 
     def cache_constraint(leaf):
         if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
@@ -389,7 +400,8 @@ def _sharded_speculative(
             pad_token=pad_token, return_stats=return_stats,
             decode_shard=decode_shard,
             cache_constraint=cache_constraint,
-            draft_cache_constraint=draft_cache_constraint)
+            draft_cache_constraint=draft_cache_constraint,
+            auto_unstack=False)
 
     with mesh:
         return jax.jit(run)(target_params, draft_params, prompt)
@@ -442,6 +454,12 @@ def tp_speculative_generate(
             f"target kv_heads {target_cfg.kv_heads} not divisible by "
             f"{axis!r} size {tp}")
 
+    from tpudist.models.generate import serving_layout
+
+    # normalize BEFORE the spec computation: the TP rules regex-match
+    # per-layer kernel names, which a stacked checkpoint doesn't have
+    target_cfg, target_params = serving_layout(target_cfg, target_params)
+    draft_cfg, draft_params = serving_layout(draft_cfg, draft_params)
     specs = spec_tree_from_rules(
         target_params, rules or transformer_tp_rules(axis))
     return _sharded_speculative(
@@ -453,11 +471,7 @@ def tp_speculative_generate(
         decode_attention=decode_attention, num_draft=num_draft, key=key,
         temperature=temperature, top_k=top_k, top_p=top_p,
         prefill_chunk=prefill_chunk, stop_tokens=stop_tokens,
-        pad_token=pad_token, return_stats=return_stats,
-        layout_reason=(
-            "the TP rules regex-match the stacked [L, in, out] kernels "
-            "on the wrong axis and the 5-D stacked cache escapes the "
-            "head-sharding constraint"))
+        pad_token=pad_token, return_stats=return_stats)
 
 
 def tp_sp_speculative_generate(
@@ -508,6 +522,12 @@ def tp_sp_speculative_generate(
             f"target max_seq_len {target_cfg.max_seq_len} not divisible "
             f"by {seq_axis!r} size {sp}")
 
+    from tpudist.models.generate import serving_layout
+
+    # normalize BEFORE the spec computation: the TP rules regex-match
+    # per-layer kernel names, which a stacked checkpoint doesn't have
+    target_cfg, target_params = serving_layout(target_cfg, target_params)
+    draft_cfg, draft_params = serving_layout(draft_cfg, draft_params)
     specs = spec_tree_from_rules(
         target_params, rules or transformer_tp_rules(axis))
     return _sharded_speculative(
@@ -518,10 +538,7 @@ def tp_sp_speculative_generate(
         num_draft=num_draft, key=key, temperature=temperature,
         top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
         stop_tokens=stop_tokens, pad_token=pad_token,
-        return_stats=return_stats,
-        layout_reason=("the TP rules regex-match the stacked kernels on "
-                       "the wrong axis and the 5-D stacked cache escapes "
-                       "the 2-D cache constraint"))
+        return_stats=return_stats)
 
 
 def sp_speculative_generate(
@@ -556,6 +573,10 @@ def sp_speculative_generate(
     """
     from jax.sharding import PartitionSpec as P
 
+    from tpudist.models.generate import serving_layout
+
+    target_cfg, target_params = serving_layout(target_cfg, target_params)
+    draft_cfg, draft_params = serving_layout(draft_cfg, draft_params)
     sp = mesh.shape[axis]
     if target_cfg.max_seq_len % sp:
         raise ValueError(
@@ -570,6 +591,4 @@ def sp_speculative_generate(
         num_draft=num_draft, key=key, temperature=temperature,
         top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
         stop_tokens=stop_tokens, pad_token=pad_token,
-        return_stats=return_stats,
-        layout_reason=("the 5-D stacked cache escapes the "
-                       "sequence-sharding constraint"))
+        return_stats=return_stats)

@@ -314,13 +314,21 @@ class IciDataPlane:
         import subprocess
         import sys
 
+        import tempfile
+
         port = _free_port()
+        # stderr to its own log file: the daemon outlives this worker, so
+        # inheriting a harness's stderr PIPE would hold its write end open
+        # (the harness's read-to-EOF then blocks on the daemon's lifetime)
+        errlog = open(os.path.join(
+            tempfile.gettempdir(), f"tpudist_ici_service_{port}.log"), "wb")
         proc = subprocess.Popen(
             [sys.executable, "-m", "tpudist.runtime.ici_service",
              "--port", str(port), "--world", str(world),
              "--heartbeat-timeout-s", str(self.heartbeat_timeout_s)],
-            stdout=subprocess.PIPE,  # stderr inherited: diagnostics surface
+            stdout=subprocess.PIPE, stderr=errlog,
             start_new_session=True)  # detach: must outlive this worker
+        errlog.close()
         ready, _, _ = select.select([proc.stdout], [], [],
                                     self.init_timeout_s)
         if not ready or proc.stdout.readline().strip() != b"ready":
