@@ -216,6 +216,11 @@ def speculative_generate(
         each round, so emitted tokens can trail acceptance for
         batch > 1; emitted tokens additionally include one verify token
         per round).
+      auto_unstack: normalize a scanned-trained DRAFT to the unrolled
+        layout (its single-token steps pay ~4× through the stacked
+        cache); the target's layout is preserved either way — scanned
+        targets keep their depth-independent compile size and verify
+        chunks amortize the slicing.
       decode_shard / cache_constraint / draft_cache_constraint: the
         sharded-serving hooks (same contracts as in
         :mod:`tpudist.models.generate`): ``decode_shard`` routes the
@@ -229,17 +234,18 @@ def speculative_generate(
     dict appended when ``return_stats`` is set.
     """
     if auto_unstack:
-        # Serve scanned-trained checkpoints through the unrolled layout
-        # by default (generate.serving_layout).  Opting out is legitimate
-        # for the TARGET: it only ever runs chunk verifies, which
-        # amortize the stacked-cache slicing, so a scanned target keeps
-        # its depth-independent compile size at ~no step-time cost — the
-        # configuration bench.py uses.  The DRAFT runs single-token
-        # steps, where the stacked layout costs ~4×.
+        # Serve a scanned-trained DRAFT through the unrolled layout by
+        # default (generate.serving_layout): the draft runs single-token
+        # steps, where the stacked layout costs ~4×.  The TARGET's layout
+        # is PRESERVED: it only ever runs chunk verifies, which amortize
+        # the stacked-cache slicing, so a scanned target keeps its
+        # depth-independent compile size at ~no step-time cost — the
+        # configuration bench.py relies on (the unrolled 8-layer rollout
+        # exceeds the remote-compile request limit).  The sharded entry
+        # points normalize BOTH unconditionally (their sharding rules
+        # need per-layer names).
         from tpudist.models.generate import serving_layout
 
-        target_cfg, target_params = serving_layout(target_cfg,
-                                                   target_params)
         draft_cfg, draft_params = serving_layout(draft_cfg, draft_params)
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(

@@ -320,15 +320,18 @@ class IciDataPlane:
         # stderr to its own log file: the daemon outlives this worker, so
         # inheriting a harness's stderr PIPE would hold its write end open
         # (the harness's read-to-EOF then blocks on the daemon's lifetime)
-        errlog = open(os.path.join(
-            tempfile.gettempdir(), f"tpudist_ici_service_{port}.log"), "wb")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "tpudist.runtime.ici_service",
-             "--port", str(port), "--world", str(world),
-             "--heartbeat-timeout-s", str(self.heartbeat_timeout_s)],
-            stdout=subprocess.PIPE, stderr=errlog,
-            start_new_session=True)  # detach: must outlive this worker
-        errlog.close()
+        errlog_path = os.path.join(
+            tempfile.gettempdir(), f"tpudist_ici_service_{port}.log")
+        errlog = open(errlog_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpudist.runtime.ici_service",
+                 "--port", str(port), "--world", str(world),
+                 "--heartbeat-timeout-s", str(self.heartbeat_timeout_s)],
+                stdout=subprocess.PIPE, stderr=errlog,
+                start_new_session=True)  # detach: must outlive this worker
+        finally:
+            errlog.close()
         ready, _, _ = select.select([proc.stdout], [], [],
                                     self.init_timeout_s)
         if not ready or proc.stdout.readline().strip() != b"ready":
@@ -337,7 +340,8 @@ class IciDataPlane:
             # membership change and re-rendezvouses (a port-bind race or a
             # slow host must not crash the gang member)
             raise FormationTimeout(
-                f"ici round {round_id}: service process never came up")
+                f"ici round {round_id}: service process never came up "
+                f"(its stderr is in {errlog_path})")
         proc.stdout.close()
         self.client.set(f"{self.ns}/{round_id}/svc",
                         f"{proc.pid}:{socket.gethostname()}")
