@@ -1025,46 +1025,149 @@ def bench_speculative_decode(on_tpu: bool) -> None:
 
     stats_box = {}
 
-    def spec(n):
+    def spec_fn(n, k):
+        """ONE jitted rollout per (n, K) — drafts are ARGUMENTS, so every
+        acceptance tier below reuses the same executable.
+        auto_unstack=False for explicitness: the SCANNED target is
+        deliberate — verify chunks amortize the stacked-cache slicing and
+        the depth-independent HLO is what fits the tunnel's remote-
+        compile request limit.  (The default now preserves target layout
+        anyway and would only touch the draft, which is already
+        unrolled.)"""
         def run(tp, dp, t):
-            # auto_unstack=False for explicitness: the SCANNED target is
-            # deliberate — verify chunks amortize the stacked-cache
-            # slicing and the depth-independent HLO is what fits the
-            # tunnel's remote-compile request limit.  (The default now
-            # preserves target layout anyway and would only touch the
-            # draft, which is already unrolled.)
             toks, stats = speculative_generate(
                 target_cfg, tp, draft_cfg, dp, t, n,
-                num_draft=k_spec, decode_attention=attn,
+                num_draft=k, decode_attention=attn,
                 draft_decode_attention=attn, return_stats=True,
                 auto_unstack=False)
             return toks, stats["rounds"], stats["draft_accepted"]
-        fn = jax.jit(run)
+        return jax.jit(run)
 
+    def spec_call(fn, dp):
         def call(t):
-            toks, rounds, acc = fn(t_params, d_params, t)
+            toks, rounds, acc = fn(t_params, dp, t)
             stats_box["rounds"] = int(rounds)
             stats_box["accepted"] = int(acc)
             return toks
         return call
 
-    spec_n, spec_1 = spec(new_tokens), spec(1)
+    fn_full, fn_one = spec_fn(new_tokens, k_spec), spec_fn(1, k_spec)
+    spec_n, spec_1 = spec_call(fn_full, d_params), spec_call(fn_one, d_params)
     t_spec = timed(spec_n) - timed(spec_1)
     spec_tps = batch * (new_tokens - 1) / max(t_spec, 1e-9)
     # correctness cross-check rides along: greedy speculative must emit
     # the target's own greedy tokens bit-exactly (this call also leaves
     # the FULL run's stats in stats_box)
-    match = bool(jnp.all(spec_n(prompt)[:, prompt_len:]
-                         == plain_n(prompt)[:, prompt_len:]))
+    plain_tokens = plain_n(prompt)[:, prompt_len:]
+    match = bool(jnp.all(spec_n(prompt)[:, prompt_len:] == plain_tokens))
     rounds = max(stats_box.get("rounds", 0), 1)
     accept_rate = stats_box.get("accepted", 0) / (rounds * k_spec * batch)
     _emit("speculative_decode_speedup", round(spec_tps / plain_tps, 2),
           "x", None, context=target_cfg.max_seq_len, batch=batch,
-          num_draft=k_spec, accept_rate=round(accept_rate, 3),
+          num_draft=k_spec, tier="ceiling",
+          accept_rate=round(accept_rate, 3),
           spec_tokens_per_sec=round(spec_tps, 1),
           plain_tokens_per_sec=round(plain_tps, 1),
           exact_match=match, target_loss=round(t_loss, 4),
           draft_loss=round(d_loss, 4), rtt_ms=round(_RTT * 1e3, 1))
+
+    # ---- REALISTIC-ACCEPTANCE tiers (round-3 verdict item 2) ----------
+    # The ceiling above measures a near-perfect draft.  Real drafts miss;
+    # the batch-min lockstep then cuts advancement fastest.  Draft
+    # quality knob: zero-mean noise of scale sigma on the draft's LM-head
+    # kernel (the undertrained-draft effect in one scalar), CALIBRATED by
+    # bisection against a forward-only argmax-match proxy so each tier
+    # lands near its target acceptance.  The noised tree has identical
+    # shapes, so every tier reuses the compiled rollout (no extra tunnel
+    # compiles); greedy speculative stays EXACT for any draft.
+    from tpudist.models.speculative import AdaptiveDraftPolicy
+
+    noise_key = jax.random.key(42)
+    d_kernel = d_params["lm_head"]["kernel"]
+
+    def noised(sigma):
+        noisy = jax.tree.map(lambda x: x, d_params)  # shallow copy
+        noisy["lm_head"] = dict(
+            d_params["lm_head"],
+            kernel=d_kernel + sigma * jax.random.normal(
+                noise_key, d_kernel.shape, d_kernel.dtype))
+        return noisy
+
+    proxy_xs = data[:, :-1]
+
+    @jax.jit
+    def proxy_match(dp_noisy):
+        tl = TransformerLM(target_cfg).apply({"params": t_params}, proxy_xs)
+        dl = TransformerLM(draft_cfg).apply({"params": dp_noisy}, proxy_xs)
+        return jnp.mean((jnp.argmax(tl, -1) == jnp.argmax(dl, -1))
+                        .astype(jnp.float32))
+
+    def calibrate(target_a):
+        lo, hi = 0.0, 4.0
+        for _ in range(9):
+            mid = (lo + hi) / 2
+            if float(proxy_match(noised(mid))) > target_a:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2
+
+    tier_results = {}
+    for tier in (0.95, 0.8, 0.6):
+        sigma = calibrate(tier)
+        dp_tier = noised(sigma)
+        # same (n, K) executables as the ceiling — only the draft ARG
+        # changes, so the tiers pay zero extra compiles
+        tier_n = spec_call(fn_full, dp_tier)
+        tier_1 = spec_call(fn_one, dp_tier)
+        t_tier = timed(tier_n) - timed(tier_1)
+        tier_tps = batch * (new_tokens - 1) / max(t_tier, 1e-9)
+        match_t = bool(jnp.all(tier_n(prompt)[:, prompt_len:]
+                               == plain_tokens))
+        rounds = max(stats_box.get("rounds", 0), 1)
+        acc = stats_box.get("accepted", 0) / (rounds * k_spec * batch)
+        tier_results[tier] = (tier_tps, acc, sigma)
+        _emit("speculative_decode_speedup",
+              round(tier_tps / plain_tps, 2), "x", None,
+              context=target_cfg.max_seq_len, batch=batch,
+              num_draft=k_spec, tier=tier, accept_rate=round(acc, 3),
+              draft_noise_sigma=round(sigma, 3),
+              spec_tokens_per_sec=round(tier_tps, 1),
+              plain_tokens_per_sec=round(plain_tps, 1),
+              exact_match=match_t, rtt_ms=round(_RTT * 1e3, 1))
+
+    # ---- adaptive num_draft at the worst tier -------------------------
+    # the policy turns the measured acceptance into the throughput-
+    # optimal K; run that K on the same degraded draft and compare with
+    # the fixed ceiling-tuned K=16
+    low_tps, low_acc, low_sigma = tier_results[0.6]
+    pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16), draft_cost_ratio=0.1)
+    a_hat = pol.infer_acceptance(low_acc, k_spec)
+    k_low = pol.best_k(a_hat, batch=batch)
+    if k_low != k_spec:
+        dp_low = noised(low_sigma)  # the 0.6 tier's calibration, reused
+        tk_n = spec_call(spec_fn(new_tokens, k_low), dp_low)
+        # the n=1 rollout never runs a draft/verify round (rounds == 0 at
+        # max_new_tokens == 1), so its wall time is K-independent — reuse
+        # the already-compiled K=16 executable for the subtraction
+        tk_1 = spec_call(fn_one, dp_low)
+        t_k = timed(tk_n) - timed(tk_1)
+        k_tps = batch * (new_tokens - 1) / max(t_k, 1e-9)
+        match_k = bool(jnp.all(
+            tk_n(prompt)[:, prompt_len:] == plain_tokens))
+    else:
+        # the policy independently confirmed the fixed K — the tier's own
+        # measurement IS the policy's measurement
+        k_tps, match_k = low_tps, True
+    _emit("speculative_adaptive_num_draft",
+          round(k_tps / low_tps, 2), "x", None,
+          context=target_cfg.max_seq_len, batch=batch,
+          policy_k=k_low, fixed_k=k_spec,
+          inferred_acceptance=round(a_hat, 3),
+          policy_tokens_per_sec=round(k_tps, 1),
+          fixed_tokens_per_sec=round(low_tps, 1),
+          vs_plain=round(k_tps / plain_tps, 2),
+          exact_match=match_k, rtt_ms=round(_RTT * 1e3, 1))
 
 
 def main() -> None:

@@ -598,3 +598,180 @@ def sp_speculative_generate(
         top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
         stop_tokens=stop_tokens, pad_token=pad_token,
         return_stats=return_stats)
+
+
+class AdaptiveDraftPolicy:
+    """Acceptance-driven choice of ``num_draft`` (the round-3 verdict's
+    adaptive-K ask): low acceptance makes long draft chunks WORSE than
+    plain decode — the target still streams its cache/weights once per
+    round, but the round only advances by the batch-min accepted prefix
+    plus one — so K must shrink with measured acceptance, not be tuned
+    for the perfect-draft ceiling.
+
+    Model (the standard speculative-throughput algebra, batch-aware):
+    with per-token acceptance probability ``a`` (i.i.d. across rows and
+    positions), a batch-B round advances by::
+
+        E[tokens/round] = 1 + sum_{j=1..K} a^(B*j)
+
+    (each term is P(every row's accepted prefix reaches j) — the
+    batch-min lockstep documented in this module's header), while the
+    round costs ``K * c_draft + c_verify``.  :meth:`best_k` maximizes
+    tokens/cost over the candidate ladder; ``a`` itself is recovered from
+    observed per-row acceptance (``draft_accepted / (rounds * B)`` =
+    ``sum_{j=1..K} a^j``) by bisection, because the reported accept rate
+    is a K-truncated mean, not ``a``.
+
+    The policy is HOST-side state adapting ACROSS compiled rollouts —
+    inside one rollout K is a static shape (a lax.while_loop cannot
+    reshape its draft scan), so adaptation happens at segment boundaries
+    (:func:`adaptive_speculative_generate`), each ladder K reusing its
+    own jit-cached executable.
+
+    Args:
+      ladder: candidate K values (each gets its own compiled rollout).
+      draft_cost_ratio: c_draft / c_verify — the relative cost of one
+        draft step vs one verify chunk.  Measurable (time one of each) or
+        estimable as draft_params_bytes / target_params_bytes at long
+        context where both are bandwidth-bound.
+      ema: smoothing for the acceptance estimate across updates.
+    """
+
+    def __init__(self, ladder: Sequence[int] = (4, 8, 16),
+                 draft_cost_ratio: float = 0.1, ema: float = 0.5,
+                 initial_acceptance: float = 0.8) -> None:
+        if not ladder or any(k < 1 for k in ladder):
+            raise ValueError(f"ladder must hold K >= 1, got {ladder}")
+        if not 0 < draft_cost_ratio:
+            raise ValueError("draft_cost_ratio must be > 0")
+        self.ladder = tuple(sorted(ladder))
+        self.r = float(draft_cost_ratio)
+        self.ema = float(ema)
+        self.acceptance = float(initial_acceptance)
+        self.rounds_seen = 0
+
+    # -- the algebra -------------------------------------------------------
+
+    @staticmethod
+    def _per_row_mean(a: float, k: int) -> float:
+        """E[accepted prefix] / 1 for one row at per-token prob a."""
+        return sum(a ** j for j in range(1, k + 1))
+
+    @classmethod
+    def infer_acceptance(cls, accept_rate: float, k: int) -> float:
+        """Per-token acceptance probability ``a`` from the K-truncated
+        mean accept fraction (``draft_accepted / (rounds*K*B)``)."""
+        accept_rate = min(max(accept_rate, 0.0), 1.0)
+        target = accept_rate * k
+        lo, hi = 0.0, 1.0
+        for _ in range(50):
+            mid = (lo + hi) / 2
+            if cls._per_row_mean(mid, k) < target:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2
+
+    def expected_tokens_per_round(self, a: float, k: int,
+                                  batch: int) -> float:
+        return 1.0 + sum(a ** (batch * j) for j in range(1, k + 1))
+
+    def best_k(self, a: float | None = None, batch: int = 1) -> int:
+        """The ladder K maximizing expected tokens per unit cost at
+        acceptance ``a`` (default: the policy's running estimate)."""
+        a = self.acceptance if a is None else a
+        return max(self.ladder, key=lambda k:
+                   self.expected_tokens_per_round(a, k, batch)
+                   / (k * self.r + 1.0))
+
+    # -- the feedback loop -------------------------------------------------
+
+    @property
+    def num_draft(self) -> int:
+        return self.best_k()
+
+    def update(self, stats: dict, batch: int, num_draft: int) -> None:
+        """Fold one rollout's ``return_stats`` dict into the acceptance
+        estimate (guarding the documented ``rounds == 0`` case)."""
+        rounds = int(stats["rounds"])
+        if rounds == 0:
+            return
+        rate = float(stats["draft_accepted"]) / (rounds * num_draft * batch)
+        a = self.infer_acceptance(rate, num_draft)
+        w = self.ema if self.rounds_seen else 1.0
+        self.acceptance = w * a + (1.0 - w) * self.acceptance
+        self.rounds_seen += rounds
+
+
+def adaptive_speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    policy: AdaptiveDraftPolicy,
+    *,
+    segment_tokens: int = 128,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    decode_attention: str = "dense",
+    draft_decode_attention: str = "dense",
+    prefill_chunk: int | None = None,
+    return_stats: bool = False,
+    auto_unstack: bool = True,
+):
+    """Speculative decoding with ``num_draft`` ADAPTED to measured
+    acceptance, in segments.
+
+    Each segment is one compiled :func:`speculative_generate` rollout at
+    the policy's current K; its stats update the policy before the next
+    segment.  Output distribution stays EXACT: a greedy (or sampled, with
+    fresh per-segment keys) continuation of an exact prefix is an exact
+    sample of the whole — K only changes the schedule, never the accept
+    rule.  The cost is one compile per (segment boundary, ladder K) pair;
+    a serving deployment amortizes the grid across requests (segment
+    lengths and the ladder are static), and the common case converges to
+    ONE K after the first segment.
+
+    ``stop_tokens`` is deliberately unsupported here: per-row early stop
+    interacts with segment boundaries (a stopped row would keep paying
+    rollout segments); serve bounded-length requests through the
+    continuous-batching loop instead.
+
+    Returns tokens ``[B, prompt_len + max_new_tokens]`` (and, with
+    ``return_stats``, a dict with per-segment ``ks``, acceptance
+    estimates, and summed rounds/accepted)."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if segment_tokens < 1:
+        raise ValueError(
+            f"segment_tokens must be >= 1, got {segment_tokens}")
+    if key is None:
+        key = jax.random.key(0)
+    batch = prompt.shape[0]
+    toks = prompt
+    remaining = max_new_tokens
+    seg_stats: dict = {"ks": [], "acceptance": [], "rounds": 0,
+                       "draft_accepted": 0}
+    while remaining > 0:
+        n = min(segment_tokens, remaining)
+        k_seg = policy.best_k(batch=batch)
+        key, seg_key = jax.random.split(key)
+        toks, stats = speculative_generate(
+            target_cfg, target_params, draft_cfg, draft_params, toks, n,
+            num_draft=k_seg, key=seg_key, temperature=temperature,
+            top_k=top_k, top_p=top_p, decode_attention=decode_attention,
+            draft_decode_attention=draft_decode_attention,
+            prefill_chunk=prefill_chunk, return_stats=True,
+            auto_unstack=auto_unstack)
+        policy.update(stats, batch, k_seg)
+        seg_stats["ks"].append(k_seg)
+        seg_stats["acceptance"].append(policy.acceptance)
+        seg_stats["rounds"] += int(stats["rounds"])
+        seg_stats["draft_accepted"] += int(stats["draft_accepted"])
+        remaining -= n
+    return (toks, seg_stats) if return_stats else toks
