@@ -783,3 +783,42 @@ def test_windowed_model_decode_matches_windowed_forward():
         np.testing.assert_array_equal(
             np.asarray(jnp.argmax(logits[:, t - 1], -1)),
             np.asarray(out[:, t]), err_msg=f"position {t}")
+
+
+class TestPerRowFlashDecode:
+    """Per-row cache lengths (the continuous-batching serve path): the
+    vectorized kernel must match per-row scalar calls exactly."""
+
+    @pytest.mark.parametrize("h_kv,d", [(2, 16), (3, 16), (2, 128)])
+    def test_matches_scalar_per_row(self, h_kv, d):
+        from tpudist.ops.flash_decode import flash_decode
+
+        b, s, g = 3, 64, 2
+        h = h_kv * g
+        q = jax.random.normal(jax.random.key(0), (b, 1, h, d))
+        k = jax.random.normal(jax.random.key(1), (b, s, h_kv, d))
+        v = jax.random.normal(jax.random.key(2), (b, s, h_kv, d))
+        lens = jnp.asarray([5, 33, 64], jnp.int32)
+        got = flash_decode(q, k, v, lens)
+        for i in range(b):
+            want = flash_decode(q[i:i + 1], k[i:i + 1], v[i:i + 1],
+                                int(lens[i]))
+            np.testing.assert_allclose(
+                np.asarray(got[i:i + 1]), np.asarray(want),
+                rtol=2e-5, atol=2e-5)
+
+    def test_window_rejected(self):
+        from tpudist.ops.flash_decode import flash_decode
+
+        q = jnp.zeros((2, 1, 4, 16))
+        k = v = jnp.zeros((2, 64, 2, 16))
+        with pytest.raises(ValueError, match="window"):
+            flash_decode(q, k, v, jnp.asarray([3, 4]), window=16)
+
+    def test_wrong_length_count_rejected(self):
+        from tpudist.ops.flash_decode import flash_decode
+
+        q = jnp.zeros((2, 1, 4, 16))
+        k = v = jnp.zeros((2, 64, 2, 16))
+        with pytest.raises(ValueError, match="entries"):
+            flash_decode(q, k, v, jnp.asarray([3, 4, 5]))

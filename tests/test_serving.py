@@ -1,0 +1,155 @@
+"""Continuous-batching serve loop: token parity with the fixed rollouts,
+slot reuse, mixed lengths, stop semantics (round-3 verdict item 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.generate import greedy_generate
+from tpudist.models.serving import Completion, Request, ServeLoop
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, embed_dim=64, max_seq_len=96)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM(CFG).init(
+        jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+
+
+def _prompt(seed, n):
+    return np.asarray(jax.random.randint(jax.random.key(seed), (n,), 0, 64))
+
+
+def _want(params, prompt, n, **kw):
+    out = greedy_generate(CFG, params, jnp.asarray(prompt)[None, :], n, **kw)
+    return np.asarray(out)[0, len(prompt):]
+
+
+class TestParity:
+    @pytest.mark.parametrize("attn", ["dense", "flash"])
+    def test_single_request_matches_greedy(self, params, attn):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=5,
+                         decode_attention=attn, prefill_chunk=8)
+        [c] = loop.run([Request(_prompt(1, 7), 17, rid="a")])
+        assert c.rid == "a" and c.reason == "length"
+        np.testing.assert_array_equal(c.tokens, _want(params, c.prompt, 17))
+
+    def test_mixed_lengths_and_slot_reuse(self, params):
+        """5 requests with different prompt lengths/budgets through 2
+        slots: queueing, mid-flight admission into freed slots, and every
+        request's tokens still bit-match its own dedicated greedy
+        rollout."""
+        reqs = [Request(_prompt(10 + i, 3 + 5 * i), 6 + 3 * i, rid=i)
+                for i in range(5)]
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         decode_attention="flash", prefill_chunk=8)
+        comps = loop.run(reqs)
+        assert sorted(c.rid for c in comps) == [0, 1, 2, 3, 4]
+        for c in comps:
+            assert c.reason == "length"
+            np.testing.assert_array_equal(
+                c.tokens, _want(params, c.prompt, 6 + 3 * c.rid),
+                err_msg=f"request {c.rid}")
+
+    def test_independent_of_batch_company(self, params):
+        """A request's tokens must not depend on WHICH requests share the
+        slots (per-row cache isolation): same request served alone and
+        in company yields identical tokens."""
+        req = Request(_prompt(33, 9), 12, rid="x")
+        alone = ServeLoop(CFG, params, num_slots=1, steps_per_sync=6,
+                          prefill_chunk=8, decode_attention="flash")
+        [ca] = alone.run([Request(_prompt(33, 9), 12, rid="x")])
+        crowd = ServeLoop(CFG, params, num_slots=3, steps_per_sync=6,
+                          prefill_chunk=8, decode_attention="flash")
+        comps = crowd.run([Request(_prompt(40, 5), 20, rid="other1"),
+                           req,
+                           Request(_prompt(41, 14), 7, rid="other2")])
+        cx = next(c for c in comps if c.rid == "x")
+        np.testing.assert_array_equal(cx.tokens, ca.tokens)
+
+
+class TestStopAndBudget:
+    def test_stop_token_completion(self, params):
+        prompt = _prompt(5, 6)
+        ref = greedy_generate(CFG, params, jnp.asarray(prompt)[None, :],
+                              30, stop_tokens=(7,))
+        ref_tokens, ref_len = np.asarray(ref[0])[0], int(ref[1][0])
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, stop_tokens=(7,))
+        [c] = loop.run([Request(prompt, 30, rid=0)])
+        gen = ref_tokens[len(prompt):len(prompt) + ref_len]
+        if ref_len < 30:  # the reference hit the stop token
+            assert c.reason == "stop"
+            np.testing.assert_array_equal(c.tokens, gen)
+        else:
+            assert c.reason == "length"
+
+    def test_budget_one_completes_at_prefill(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        [c] = loop.run([Request(_prompt(9, 4), 1, rid=0)])
+        assert c.reason == "length" and c.tokens.shape == (1,)
+        np.testing.assert_array_equal(c.tokens, _want(params, c.prompt, 1))
+
+    def test_request_validation(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            loop.run([Request(_prompt(1, 90), 20)])
+        with pytest.raises(ValueError, match="non-empty"):
+            loop.run([Request(np.zeros((0,), np.int32), 5)])
+        with pytest.raises(ValueError, match="num_slots"):
+            ServeLoop(CFG, params, num_slots=0)
+
+
+class TestSampling:
+    def test_sampled_runs_and_respects_budget(self, params):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, temperature=0.9,
+                         key=jax.random.key(3))
+        comps = loop.run([Request(_prompt(20, 4), 9, rid=0),
+                          Request(_prompt(21, 11), 5, rid=1)])
+        by = {c.rid: c for c in comps}
+        assert by[0].tokens.shape == (9,) and by[1].tokens.shape == (5,)
+        assert all(int(t) < 64 for c in comps for t in c.tokens)
+
+
+class TestScannedCheckpoint:
+    def test_auto_unstack(self, params):
+        import dataclasses
+
+        from tpudist.models import stack_layer_params
+
+        scfg = dataclasses.replace(CFG, scan_layers=True)
+        stacked = stack_layer_params(params, CFG.num_layers)
+        loop = ServeLoop(scfg, stacked, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        [c] = loop.run([Request(_prompt(2, 5), 8, rid=0)])
+        np.testing.assert_array_equal(c.tokens, _want(params, c.prompt, 8))
+
+
+class TestPadCapRegression:
+    def test_prompt_near_cache_end_with_nondividing_chunk(self, params):
+        """Review repro: prefill_chunk not dividing max_seq_len and a
+        prompt near the cache end — the uncapped pad used to clamp the
+        final chunk's write backwards and corrupt real prompt KV."""
+        prompt = _prompt(50, 92)  # Lp would be 100 > max_seq_len 96
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=10)
+        [c] = loop.run([Request(prompt, 3, rid=0)])
+        np.testing.assert_array_equal(c.tokens, _want(params, prompt, 3))
+
+    def test_bad_request_rejected_before_any_decode(self, params):
+        """One malformed request fails run() up front — completed work is
+        never silently discarded mid-run."""
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            loop.run([Request(_prompt(1, 4), 8, rid="good"),
+                      Request(_prompt(2, 90), 20, rid="bad")])
+        # the loop is still usable and state is clean
+        [c] = loop.run([Request(_prompt(1, 4), 8, rid="good")])
+        np.testing.assert_array_equal(c.tokens, _want(params, c.prompt, 8))

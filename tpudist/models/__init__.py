@@ -19,6 +19,8 @@ from tpudist.models.generate import (
 )
 from tpudist.models.mlp import MLP
 from tpudist.models.speculative import (
+    AdaptiveDraftPolicy,
+    adaptive_speculative_generate,
     sp_speculative_generate,
     speculative_generate,
     tp_sp_speculative_generate,
@@ -26,6 +28,7 @@ from tpudist.models.speculative import (
 )
 from tpudist.models.moe import MoEConfig, MoEMLP, MoETransformerLM
 from tpudist.models.resnet import ResNet50, resnet50_stages
+from tpudist.models.serving import Completion, Request, ServeLoop
 from tpudist.models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -36,7 +39,12 @@ from tpudist.models.transformer import (
 )
 
 __all__ = [
+    "AdaptiveDraftPolicy",
+    "Completion",
     "ConvNet",
+    "Request",
+    "ServeLoop",
+    "adaptive_speculative_generate",
     "beam_search_generate",
     "EmbeddingBagClassifier",
     "MLP",

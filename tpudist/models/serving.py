@@ -1,0 +1,306 @@
+"""Continuous-batching serving loop — request-level scheduling over the
+compiled decode step.
+
+The round-3 verdict: the kernels and sharded rollouts existed, the
+REQUEST layer didn't — fixed-batch rollouts make every sequence in the
+batch start and stop together, so a mixed workload pays the longest
+request's schedule.  This module adds the vLLM-style iteration-level
+scheduler, shaped for TPU/XLA rather than for a GPU runtime:
+
+* ``num_slots`` fixed decode lanes, each owning one row of the KV cache;
+  the cache's ``cache_index`` leaves are VECTORS ``[B]`` — every slot
+  decodes at its own length through the per-row cache path
+  (``CausalSelfAttention._serve_attend``; the flash kernel takes per-row
+  lengths) — one compiled step, no padding to a common position;
+* ONE compiled SEGMENT (``lax.scan`` of ``steps_per_sync`` single-token
+  steps) between host syncs: per-token host round trips would be
+  RTT-bound, so admission/completion happen at segment granularity (a
+  slot finishing mid-segment idles ≤ ``steps_per_sync`` ticks — the
+  standard iteration-level-scheduling trade);
+* admission PREFILLS the prompt through the scalar-index path into a
+  side cache of batch 1 (chunked — the same ``_prefill`` the rollouts
+  use, prompts right-padded to a chunk multiple so compile count is
+  bounded by ``max_seq_len / prefill_chunk`` distinct shapes), then one
+  compiled INSERT scatters the row into the freed slot and stamps its
+  true length;
+* per-request ``max_new_tokens`` and stop tokens: budgets ride the
+  compiled segment as an ``[B]`` countdown (a stopped/funded-out slot
+  freezes inside the segment), the host finalizes completions and reuses
+  the slot.
+
+The bench criterion (``bench.py: serve_loop``): tokens/s/slot at 8k
+context with MIXED prompt lengths within ~15% of the fixed-batch
+rollout, which is the cost of the request layer — the decode step is the
+same kernels either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpudist.models.generate import (
+    _blank_cache,
+    _make_select,
+    _prefill,
+    _stop_array,
+    serving_layout,
+)
+from tpudist.models.speculative import _set_cache_index
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request: a prompt and its generation budget."""
+
+    prompt: np.ndarray            # [L] int32 tokens, L >= 1
+    max_new_tokens: int
+    rid: Any = None               # caller's correlation id
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: Any
+    prompt: np.ndarray
+    tokens: np.ndarray            # the generated tokens (stop included)
+    reason: str                   # "stop" | "length"
+
+
+def _first_index_leaf(cache: Any) -> jnp.ndarray:
+    """The per-row position vector: every layer's ``cache_index`` holds
+    the same value, so any one of them is THE slot-length vector."""
+    for leaf in jax.tree.leaves(cache):
+        if leaf.ndim <= 1:
+            return leaf
+    raise ValueError("cache holds no index leaves")
+
+
+class ServeLoop:
+    """Continuous-batching server over one model.
+
+    Args:
+      cfg / params: the model (scanned checkpoints are normalized via
+        :func:`serving_layout`).
+      num_slots: decode lanes (the B of the slot cache).  Pick the
+        fixed-batch size that saturates the chip; the request layer keeps
+        those lanes full across requests of different lengths.
+      steps_per_sync: decode ticks per compiled segment (the admission
+        latency / dispatch-amortization trade; ≥ the tunnel RTT in ticks).
+      decode_attention: "flash" (per-row kernel) or "dense".
+      prefill_chunk: admission prefill chunk; prompts are right-padded to
+        a multiple of it, so it also bounds the number of distinct
+        prefill executables.
+      stop_tokens / pad_token: EOS semantics as in ``greedy_generate``.
+      temperature / top_k / top_p: sampling controls (0 = greedy).
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Any,
+        num_slots: int,
+        *,
+        steps_per_sync: int = 32,
+        decode_attention: str = "flash",
+        prefill_chunk: int = 512,
+        stop_tokens: Sequence[int] | None = None,
+        pad_token: int = 0,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        key: jax.Array | None = None,
+        auto_unstack: bool = True,
+    ) -> None:
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if steps_per_sync < 1:
+            raise ValueError(
+                f"steps_per_sync must be >= 1, got {steps_per_sync}")
+        if auto_unstack:
+            cfg, params = serving_layout(cfg, params)
+        if cfg.scan_layers:
+            raise ValueError(
+                "ServeLoop needs the unrolled layout; pass the scanned "
+                "checkpoint with auto_unstack=True (the default)")
+        self.cfg = cfg
+        self.params = params
+        self.B = num_slots
+        self.steps = steps_per_sync
+        self.prefill_chunk = prefill_chunk
+        self.pad_token = int(pad_token)
+        self._stop = _stop_array(stop_tokens)
+        self._select = _make_select(temperature, top_k, top_p)
+        self._key = key if key is not None else jax.random.key(0)
+        self.model = TransformerLM(cfg, decode=True,
+                                   decode_attention=decode_attention)
+        # the slot cache: blank, with VECTOR index leaves (one position
+        # per slot) — this is what routes attention through the per-row
+        # cache path
+        blank = _blank_cache(self.model, num_slots)
+        self.cache = jax.tree.map(
+            lambda leaf: (jnp.zeros((num_slots,), jnp.int32)
+                          if leaf.ndim == 0 else leaf), blank)
+        self._blank1 = _blank_cache(self.model, 1)  # prefill side cache
+        self._tok = jnp.full((num_slots,), self.pad_token, jnp.int32)
+        self._active = jnp.zeros((num_slots,), bool)
+        self._remaining = jnp.zeros((num_slots,), jnp.int32)
+        self._segment = jax.jit(self._segment_impl)
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._prefill_one = jax.jit(self._prefill_impl,
+                                    static_argnames=("true_chunk",))
+
+    # -- compiled pieces ---------------------------------------------------
+
+    def _segment_impl(self, params, cache, tok, active, remaining, key):
+        stop_arr = self._stop
+        pad = jnp.int32(self.pad_token)
+        S = self.cfg.max_seq_len
+
+        def step(carry, _):
+            cache, tok, active, remaining, key = carry
+            pos = jnp.minimum(_first_index_leaf(cache), S - 1)
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                positions=pos[:, None], mutable=["cache"])
+            key, sk = jax.random.split(key)
+            nxt = self._select(logits[:, -1], sk).astype(jnp.int32)
+            emit = jnp.where(active, nxt, pad)
+            remaining = remaining - active.astype(jnp.int32)
+            hit_stop = (jnp.isin(nxt, stop_arr)
+                        if stop_arr is not None
+                        else jnp.zeros_like(active))
+            active = active & ~hit_stop & (remaining > 0)
+            tok = jnp.where(active, nxt, pad)
+            return (mut["cache"], tok, active, remaining, key), emit
+
+        (cache, tok, active, remaining, key), emits = lax.scan(
+            step, (cache, tok, active, remaining, key), None,
+            length=self.steps)
+        return cache, tok, active, remaining, key, emits.T  # [B, steps]
+
+    def _prefill_impl(self, params, prompt_padded, true_len, key,
+                      *, true_chunk):
+        """Chunked prefill of ONE prompt into a fresh batch-1 cache;
+        returns the cache (index stamped to the TRUE length — padded
+        positions hold garbage that masking hides and decode overwrites)
+        and the first generated token."""
+        cache, logits = _prefill(self.model, params, self._blank1,
+                                 prompt_padded, true_chunk)
+        cache = _set_cache_index(cache, true_len)
+        last = logits[0, true_len - 1 - (prompt_padded.shape[1]
+                                         - logits.shape[1])]
+        first = self._select(last[None, :], key)[0].astype(jnp.int32)
+        return cache, first
+
+    def _insert_impl(self, cache, cache1, slot, true_len):
+        def ins(big, small):
+            if big.ndim <= 1:          # index vector <- true length
+                return big.at[slot].set(true_len)
+            return big.at[slot].set(small[0])
+        return jax.tree.map(ins, cache, cache1)
+
+    # -- the host loop -----------------------------------------------------
+
+    def _validate(self, req: Request) -> None:
+        prompt = np.asarray(req.prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError("request prompt must be a non-empty 1-D "
+                             "token array")
+        if req.max_new_tokens < 1:
+            raise ValueError("request max_new_tokens must be >= 1")
+        if prompt.size + req.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"request needs {prompt.size + req.max_new_tokens} cache "
+                f"slots > max_seq_len {self.cfg.max_seq_len}")
+
+    def _admit(self, slot: int, req: Request) -> dict:
+        self._validate(req)
+        prompt = np.asarray(req.prompt, np.int32)
+        L = int(prompt.size)
+        chunk = min(self.prefill_chunk, self.cfg.max_seq_len)
+        # pad to a chunk multiple, CAPPED at the cache size: an uncapped
+        # pad past max_seq_len would make the final chunk's
+        # dynamic_update_slice clamp backwards and overwrite real prompt
+        # positions (observed: silently corrupted completions)
+        Lp = min(-(-L // chunk) * chunk, self.cfg.max_seq_len)
+        padded = np.full((1, Lp), self.pad_token, np.int32)
+        padded[0, :L] = prompt
+        self._key, pk = jax.random.split(self._key)
+        cache1, first = self._prefill_one(
+            self.params, jnp.asarray(padded), jnp.int32(L), pk,
+            true_chunk=chunk)
+        self.cache = self._insert(self.cache, cache1, jnp.int32(slot),
+                                  jnp.int32(L))
+        first = int(first)
+        state = {"req": req, "tokens": [first], "done": None}
+        if self._stop is not None and first in set(
+                np.asarray(self._stop).tolist()):
+            state["done"] = "stop"
+        elif req.max_new_tokens == 1:
+            state["done"] = "length"
+        self._tok = self._tok.at[slot].set(first)
+        self._active = self._active.at[slot].set(state["done"] is None)
+        self._remaining = self._remaining.at[slot].set(
+            req.max_new_tokens - 1)
+        return state
+
+    def run(self, requests: Sequence[Request]) -> list[Completion]:
+        """Serve every request to completion; returns completions in
+        FINISH order (slot events), each with its generated tokens."""
+        for req in requests:  # fail BEFORE any slot is touched, not mid-run
+            self._validate(req)
+        pending = deque(requests)
+        slot_state: list[dict | None] = [None] * self.B
+        done: list[Completion] = []
+
+        def finalize(slot: int, reason: str) -> None:
+            st = slot_state[slot]
+            done.append(Completion(
+                rid=st["req"].rid, prompt=np.asarray(st["req"].prompt),
+                tokens=np.asarray(st["tokens"], np.int32), reason=reason))
+            slot_state[slot] = None
+
+        stop_set = (set(np.asarray(self._stop).tolist())
+                    if self._stop is not None else set())
+        while pending or any(s is not None for s in slot_state):
+            for slot in range(self.B):
+                if slot_state[slot] is None and pending:
+                    st = self._admit(slot, pending.popleft())
+                    if st["done"] is not None:   # finished at prefill
+                        slot_state[slot] = st
+                        finalize(slot, st["done"])
+                    else:
+                        slot_state[slot] = st
+            if not any(s is not None for s in slot_state):
+                continue
+            self._key, sk = jax.random.split(self._key)
+            (self.cache, self._tok, self._active, self._remaining,
+             _, emits) = self._segment(
+                self.params, self.cache, self._tok, self._active,
+                self._remaining, sk)
+            emits = np.asarray(emits)
+            for slot in range(self.B):
+                st = slot_state[slot]
+                if st is None:
+                    continue
+                # the device emits real tokens exactly while the row is
+                # active; the first stop/budget hit below breaks BEFORE
+                # any frozen-row pad could be consumed, mirroring the
+                # compiled freeze rule token for token
+                for t in emits[slot]:
+                    t = int(t)
+                    st["tokens"].append(t)
+                    if t in stop_set:
+                        finalize(slot, "stop")
+                        break
+                    if len(st["tokens"]) >= st["req"].max_new_tokens:
+                        finalize(slot, "length")
+                        break
+        return done

@@ -275,6 +275,15 @@ class CausalSelfAttention(nn.Module):
         idx_var = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
         idx = idx_var.value
+        if idx.ndim == 1:
+            # PER-ROW cache positions (vector cache_index [B]) — the
+            # continuous-batching serve mode: every slot decodes at its
+            # own length (tpudist.models.serving swaps the scalar index
+            # leaves for vectors when building the slot cache).  One
+            # token per call only; prefill runs per-slot through a
+            # scalar-index side cache and is INSERTED (serving._insert).
+            return self._serve_attend(
+                q, k, v, cached_k, cached_v, idx_var)
         k_all = jax.lax.dynamic_update_slice(
             cached_k.value, k.astype(cached_k.value.dtype), (0, idx, 0, 0))
         v_all = jax.lax.dynamic_update_slice(
@@ -305,6 +314,46 @@ class CausalSelfAttention(nn.Module):
                 idx - jnp.arange(cfg.max_seq_len) < cfg.attention_window)
         k_all, v_all = repeat_kv(q, k_all, v_all)  # cache itself stays GQA
         return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
+
+    def _serve_attend(self, q, k, v, cached_k, cached_v, idx_var):
+        """One decode step with PER-ROW cache positions: row ``r`` writes
+        its K/V at its own ``idx[r]`` and attends over its own first
+        ``idx[r] + 1`` slots.  Writes clamp to the last slot (a retired
+        slot whose index ran past the buffer must not scatter out of
+        bounds; its garbage is overwritten at the next admission)."""
+        cfg = self.cfg
+        b, s = q.shape[0], q.shape[1]
+        if s != 1:
+            raise ValueError(
+                "per-row cache positions decode one token per call; "
+                "prefill goes through the scalar-index path "
+                "(tpudist.models.serving handles the insertion)")
+        idx = idx_var.value
+        rows = jnp.arange(b)
+        at = jnp.minimum(idx, cfg.max_seq_len - 1)
+        k_all = cached_k.value.at[rows, at].set(
+            k[:, 0].astype(cached_k.value.dtype))
+        v_all = cached_v.value.at[rows, at].set(
+            v[:, 0].astype(cached_v.value.dtype))
+        cached_k.value, cached_v.value = k_all, v_all
+        idx_var.value = idx + 1
+
+        if self.decode_shard is not None:
+            raise NotImplementedError(
+                "sharded decode with per-row cache positions is not "
+                "wired yet; serve through the replicated path")
+        n = idx + 1  # [B] valid lengths including the current token
+        if self.decode_attention == "flash" and cfg.attention_window is None:
+            from tpudist.ops.flash_decode import flash_decode
+
+            return flash_decode(q, k_all, v_all, n)
+        positions = jnp.arange(cfg.max_seq_len)[None, :]        # [1, S]
+        mask = positions < n[:, None]                           # [B, S]
+        if cfg.attention_window is not None:
+            mask = mask & (idx[:, None] - positions
+                           < cfg.attention_window)
+        k_rep, v_rep = repeat_kv(q, k_all, v_all)
+        return _masked_attend(q, k_rep, v_rep, mask[:, None, None, :])
 
     def _prefill_attend(self, q, k_all, v_all, idx):
         """Chunk prefill: queries at global positions [idx, idx+s) attend

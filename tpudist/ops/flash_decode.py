@@ -50,16 +50,19 @@ _NEG_BIG = -1e30
 
 def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
                    block_k: int, num_kb: int, window: int | None,
-                   with_lse: bool, quant: bool):
+                   with_lse: bool, quant: bool,
+                   rows_per_batch: int | None = None):
     """Online-softmax decode over one (batch·kv-head) row of the cache.
 
     ``meta_ref`` is the scalar-prefetch vector ``[cache_len, offset,
-    start_block]``: ``offset`` is this shard's global cache start
-    (sequence-parallel decode; 0 for the whole-cache case), and
-    ``start_block`` trims the K grid to the sliding window — with
-    ``window`` the grid runs only the ~``window/block_k`` blocks that
-    intersect it, so a windowed decode STREAMS ~``window`` positions
-    instead of the whole cache (bandwidth is the decode bound).
+    start_block]`` — or, with ``rows_per_batch`` set (per-row lengths),
+    ``[0, offset, start_block, len_0, ..., len_{B-1}]``: ``offset`` is
+    this shard's global cache start (sequence-parallel decode; 0 for the
+    whole-cache case), and ``start_block`` trims the K grid to the
+    sliding window — with ``window`` the grid runs only the
+    ~``window/block_k`` blocks that intersect it, so a windowed decode
+    STREAMS ~``window`` positions instead of the whole cache (bandwidth
+    is the decode bound).
 
     ``quant``: K/V tiles are int8 with per-token scales riding the LANE
     axis ([1, bk] blocks — a [bk, 1] layout would pad every scale to a
@@ -77,7 +80,14 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
     else:
         o_ref, m_scr, l_scr, acc_scr = rest
     kj = pl.program_id(1)
-    cache_len = meta_ref[0]
+    if rows_per_batch is None:
+        cache_len = meta_ref[0]
+    else:
+        # per-row lengths (the continuous-batching serve loop: every
+        # cache row decodes at its own position): meta carries [_, off,
+        # start, len_0..len_{B-1}] and grid row g belongs to batch row
+        # g // rows_per_batch
+        cache_len = meta_ref[3 + pl.program_id(0) // rows_per_batch]
     offset = meta_ref[1]
     kb_idx = meta_ref[2] + kj  # grid step kj streams cache block kb_idx
 
@@ -218,6 +228,15 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         interpret = jax.default_backend() == "cpu"
 
     cache_len = jnp.asarray(cache_len, jnp.int32)
+    per_row = cache_len.ndim == 1
+    if per_row and window is not None:
+        raise ValueError(
+            "per-row cache lengths compose with window=None only (the "
+            "sliding-window grid trim needs one start block per grid)")
+    if per_row and cache_len.shape[0] != b:
+        raise ValueError(
+            f"per-row cache_len has {cache_len.shape[0]} entries for "
+            f"batch {b}")
     offset = jnp.asarray(pos_offset, jnp.int32)
     if window is None:
         nb = num_kb_full
@@ -229,7 +248,11 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         nb = min(num_kb_full, -(-window // block_k) + 1)
         start_block = jnp.clip(
             (cache_len - window - offset) // block_k, 0, num_kb_full - nb)
-    meta = jnp.stack([cache_len, offset, start_block])
+    if per_row:
+        meta = jnp.concatenate(
+            [jnp.stack([jnp.int32(0), offset, start_block]), cache_len])
+    else:
+        meta = jnp.stack([cache_len, offset, start_block])
 
     # HEAD PAIRING for narrow head_dim: a [block_k, d] K/V tile with
     # d < 128 underfills the 128-lane width and streams at ~half
@@ -298,7 +321,9 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         functools.partial(
             _decode_kernel, scale=scale, block_k=block_k,
             num_kb=nb, window=window, with_lse=return_lse,
-            quant=quant),
+            quant=quant,
+            # h_kv here is POST-pairing: grid row g -> batch g // h_kv
+            rows_per_batch=h_kv if per_row else None),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * h_kv, nb),
