@@ -765,6 +765,27 @@ def bench_flash_decode_bandwidth(on_tpu: bool) -> None:
           window_us=round(t_win * 1e6, 1),
           rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=shadowed or sh_w)
 
+    # int8 × head pairing at NARROW head_dim (round-3 verdict #6): the
+    # cache-compression and lane-width fixes now compose — per-pair
+    # scales ride the paired tile.  Both sides of this ratio use the
+    # paired layout (d=64, even h_kv), so it isolates the int8 byte win
+    # at full DMA width; ceiling 2×.
+    d_n = 64 if on_tpu else 8
+    qn = jax.random.normal(jax.random.key(3), (b, 1, h, d_n), dtype)
+    kn = jax.random.normal(jax.random.key(4), (b, s, h_kv, d_n), dtype)
+    vn = jax.random.normal(jax.random.key(5), (b, s, h_kv, d_n), dtype)
+    kq2, ks2, vq2, vs2 = quantize_kv(kn, vn)
+    t_nb, sh_nb = _chained_rate(
+        lambda qc: flash_decode(qc, kn, vn, s), qn, base_reps, n_win)
+    t_nq, sh_nq = _chained_rate(
+        lambda qc: flash_decode_q8(qc, kq2, ks2, vq2, vs2, s), qn,
+        base_reps, n_win)
+    _emit("flash_decode_q8_paired_speedup", round(t_nb / t_nq, 2), "x",
+          None, batch=b, context=s, head_dim=d_n, kv_heads=h_kv,
+          ceiling=2.0, bf16_us=round(t_nb * 1e6, 1),
+          q8_us=round(t_nq * 1e6, 1),
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh_nb or sh_nq)
+
 
 def bench_serve_loop(on_tpu: bool) -> None:
     """Continuous-batching serving at 8k context with MIXED prompt

@@ -136,6 +136,17 @@ class ServeLoop:
         self.prefill_chunk = prefill_chunk
         self.pad_token = int(pad_token)
         self._stop = _stop_array(stop_tokens)
+        self._stop_set = (set(np.asarray(self._stop).tolist())
+                          if self._stop is not None else set())
+        if decode_attention == "flash" and cfg.attention_window is not None:
+            import warnings
+
+            warnings.warn(
+                "ServeLoop with a sliding-window model falls back to "
+                "DENSE per-row attention (the per-row flash kernel has "
+                "no window trim yet): every decode step streams the "
+                "whole cache instead of ~window positions",
+                stacklevel=2)
         self._select = _make_select(temperature, top_k, top_p)
         self._key = key if key is not None else jax.random.key(0)
         self.model = TransformerLM(cfg, decode=True,
@@ -240,8 +251,7 @@ class ServeLoop:
                                   jnp.int32(L))
         first = int(first)
         state = {"req": req, "tokens": [first], "done": None}
-        if self._stop is not None and first in set(
-                np.asarray(self._stop).tolist()):
+        if first in self._stop_set:
             state["done"] = "stop"
         elif req.max_new_tokens == 1:
             state["done"] = "length"
@@ -267,8 +277,6 @@ class ServeLoop:
                 tokens=np.asarray(st["tokens"], np.int32), reason=reason))
             slot_state[slot] = None
 
-        stop_set = (set(np.asarray(self._stop).tolist())
-                    if self._stop is not None else set())
         while pending or any(s is not None for s in slot_state):
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
@@ -297,7 +305,7 @@ class ServeLoop:
                 for t in emits[slot]:
                     t = int(t)
                     st["tokens"].append(t)
-                    if t in stop_set:
+                    if t in self._stop_set:
                         finalize(slot, "stop")
                         break
                     if len(st["tokens"]) >= st["req"].max_new_tokens:

@@ -347,6 +347,9 @@ class CausalSelfAttention(nn.Module):
             from tpudist.ops.flash_decode import flash_decode
 
             return flash_decode(q, k_all, v_all, n)
+        # NOTE: flash + attention_window falls back to the dense masked
+        # path here (the per-row kernel has no per-row window trim yet) —
+        # ServeLoop warns about the bandwidth cost at construction.
         positions = jnp.arange(cfg.max_seq_len)[None, :]        # [1, S]
         mask = positions < n[:, None]                           # [B, S]
         if cfg.attention_window is not None:

@@ -23,12 +23,13 @@ mask to -inf as before.
 
 Measured guideline (BASELINE.md round 3): ``head_dim < 128`` underfills
 the 128-lane tile width of the K/V blocks (measured: half DMA
-bandwidth).  With EVEN ``h_kv`` the bf16 path recovers full width by
-HEAD PAIRING (see ``_flash_decode_impl``): kernel-level parity with
-d=128 (636 vs 639 GB/s measured), model-level within ~1.37× (residual
-per-step packing overhead).  Odd-``h_kv`` narrow-head models and the
-int8 cache path (whose per-(token, head) scales would need
-per-pair-member handling) stay unpaired at ~half DMA width — prefer
+bandwidth).  With EVEN ``h_kv`` both the bf16 AND int8 paths recover
+full width by HEAD PAIRING (see ``_flash_decode_impl``; since round 4
+the int8 per-(token, head) scales ride the paired tile as one row per
+pair member, applied half-wise in the kernel): bf16 kernel-level parity
+with d=128 (636 vs 639 GB/s measured), model-level within ~1.37×
+(residual per-step packing overhead).  Odd-``h_kv`` narrow-head models
+stay unpaired at ~half DMA width — prefer even ``h_kv`` or
 head_dim-128 configurations where the model design allows.
 
 Reference scope note: the reference suite is training-only (SURVEY.md §2 —
@@ -105,7 +106,16 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
             s = jax.lax.dot_general(
                 q.astype(jnp.bfloat16), kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            s = s * (ks_ref[0] * scale)              # [gp, bk]·[1, bk]
+            ks = ks_ref[0] * scale                   # [rows, bk]
+            if ks.shape[0] == 2:
+                # paired tile: score rows of half m carry pair member
+                # m's K only (block-diagonal q), so member m's per-token
+                # scale applies to exactly those rows
+                half = s.shape[0] // 2
+                s = (s.reshape(2, half, s.shape[1])
+                     * ks[:, None, :]).reshape(s.shape)
+            else:
+                s = s * ks                           # [gp, bk]·[1, bk]
         else:
             s = jax.lax.dot_general(
                 q, k_ref[0], (((1,), (1,)), ((), ())),
@@ -124,7 +134,17 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
         m_scr[:] = new_m
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         if quant:
-            pv = (p * vs_ref[0]).astype(jnp.bfloat16)
+            vs = vs_ref[0]                           # [rows, bk]
+            if vs.shape[0] == 2:
+                # half m's output lands in member m's lane half (sliced
+                # out at unpack), so folding member m's V scale into
+                # half-m probability rows is exact
+                half = p.shape[0] // 2
+                pv32 = (p.reshape(2, half, p.shape[1])
+                        * vs[:, None, :]).reshape(p.shape)
+            else:
+                pv32 = p * vs
+            pv = pv32.astype(jnp.bfloat16)
             vb = v_ref[0].astype(jnp.bfloat16)
         else:
             vb = v_ref[0]
@@ -265,11 +285,16 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     # produces each head's output in its own lane half, sliced apart
     # below.  Costs 2x matmul FLOPs on zeros; buys full-width DMA rows
     # at the bandwidth-bound op — measured kernel parity with a d=128
-    # layout.  The int8 path stays UNPAIRED: its per-(token, head)
-    # scales are one row per real head and would need per-pair-member
-    # handling in the kernel.
+    # layout.  The int8 path pairs too (round-3 verdict #6 — the
+    # narrow-head fix and the cache-compression fix now COMPOSE): its
+    # per-(token, head) scales ride as [2, block_k] blocks, one row per
+    # pair member, and the kernel applies them half-wise — score rows of
+    # half m only ever contract member m's K (the zero q half
+    # annihilates the other member), and member m's V lands in its own
+    # lane half, so folding member m's scale into half-m score/prob rows
+    # is exact.
     scale = d ** -0.5
-    paired = not quant and h_kv % 2 == 0 and d * 2 <= 128
+    paired = h_kv % 2 == 0 and d * 2 <= 128
     q4 = q.reshape(b, h_kv, g, d)                    # [B, Hkv, g, d]
     q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
     if paired:
@@ -292,22 +317,31 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     # index maps see the prefetched meta first: grid step j streams cache
     # block meta[2] + j
     kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j, m: (g_, m[2] + j, 0))
-    # scales as [B·Hkv, 1, S]: the sequence dim rides the LANE axis so a
-    # block is a dense [1, block_k] row, not a strided [block_k, 1]
-    # column (measured 2× on the whole kernel)
-    sc_spec = pl.BlockSpec((1, 1, block_k), lambda g_, j, m: (g_, 0, m[2] + j))
+    # scales as [B·Hkv, rows, S] (rows = 2 pair members when paired, else
+    # 1): the sequence dim rides the LANE axis so a block is a dense
+    # [rows, block_k] row set, not a strided column (measured 2× on the
+    # whole kernel)
+    sc_rows = 2 if paired else 1
+    sc_spec = pl.BlockSpec((1, sc_rows, block_k),
+                           lambda g_, j, m: (g_, 0, m[2] + j))
+
+    def pack_scale(sc):
+        # [B, S, Hkv_orig, 1] -> [B·(Hkv_orig/rows), rows, S]
+        flat = sc[..., 0].swapaxes(1, 2)          # [B, Hkv_orig, S]
+        return flat.reshape(b * h_kv, sc_rows, s)
+
     args = [meta, q3, k3]
     in_specs = [
         pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0)),
         kv_spec,
     ]
     if quant:
-        args.append(k_scale[..., 0].swapaxes(1, 2).reshape(b * h_kv, 1, s))
+        args.append(pack_scale(k_scale))
         in_specs.append(sc_spec)
     args.append(v3)
     in_specs.append(kv_spec)
     if quant:
-        args.append(v_scale[..., 0].swapaxes(1, 2).reshape(b * h_kv, 1, s))
+        args.append(pack_scale(v_scale))
         in_specs.append(sc_spec)
 
     out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))]
