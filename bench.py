@@ -691,11 +691,15 @@ def bench_moe(on_tpu: bool) -> None:
 
     ragged = MoEMLP(d, f, MoEConfig(num_experts=experts, top_k=top_k,
                                     dispatch="ragged"))
+    fused = MoEMLP(d, f, MoEConfig(num_experts=experts, top_k=top_k,
+                                   dispatch="fused"))
 
     t_moe, sh1 = timed(
         lambda p, xc: moe.apply({"params": p}, xc)[0], moe_params)
     t_ragged, sh3 = timed(
         lambda p, xc: ragged.apply({"params": p}, xc)[0], moe_params)
+    t_fused, sh4 = timed(
+        lambda p, xc: fused.apply({"params": p}, xc)[0], moe_params)
     t_dense, sh2 = timed(
         lambda p, xc: dense.apply({"params": p}, xc), dense_params)
     # expert-MLP FLOPs both sides: tokens * top_k * 2 matmuls * 2*d*f
@@ -712,6 +716,12 @@ def bench_moe(on_tpu: bool) -> None:
           vs_einsum_dispatch=round(t_moe / t_ragged, 2),
           ragged_tflops=round(core_flops / t_ragged / 1e12, 1),
           rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh3 or sh2)
+    _emit("moe_fused_dispatch_overhead", round(t_fused / t_dense, 2),
+          "x", None, tokens=tokens, experts=experts, top_k=top_k,
+          fused_ms=round(t_fused * 1e3, 2),
+          vs_ragged=round(t_ragged / t_fused, 2),
+          fused_tflops=round(core_flops / t_fused / 1e12, 1),
+          rtt_ms=round(_RTT * 1e3, 1), rtt_shadowed=sh4 or sh2)
 
 
 def bench_flash_decode_bandwidth(on_tpu: bool) -> None:
