@@ -48,6 +48,10 @@ class MoEConfig:
     # VMEM.  Both non-einsum paths are single-shard only (ep_axis needs
     # the block layout).
     dispatch: str = "einsum"
+    # "fused" only: slot rows per kernel block.  Group starts pad to
+    # this, wasting up to E*fused_block_rows rows of expert FLOPs — at
+    # small token counts (decode-time MoE) shrink it or use "ragged".
+    fused_block_rows: int = 128
 
 
 def _gate_choices(gates: jnp.ndarray, top_k: int):
@@ -197,7 +201,8 @@ class MoEMLP(nn.Module):
             if self.moe.dispatch == "fused":
                 from tpudist.ops.moe_dispatch import fused_moe_mlp
 
-                out = fused_moe_mlp(x, w_up, w_down, top_idx, top_vals)
+                out = fused_moe_mlp(x, w_up, w_down, top_idx, top_vals,
+                                    block_rows=self.moe.fused_block_rows)
             else:
                 out = _ragged_moe(x, w_up, w_down, top_idx, top_vals)
             return out, aux.astype(jnp.float32)
