@@ -106,3 +106,14 @@ def test_long_context_lm_generation_demo(extra):
          "--log-every", "10", "--generate", "8", *extra]
     )
     assert loss == loss
+
+
+def test_serve_continuous_example():
+    """The continuous-batching demo: trains, serves mixed requests, and
+    its ground-truth continuation accuracy gate passes (returns 0)."""
+    import serve_continuous_tpu
+
+    rc = serve_continuous_tpu.main(
+        ["--requests", "4", "--train-steps", "150", "--slots", "2",
+         "--seq-len", "128"])
+    assert rc == 0
