@@ -50,11 +50,11 @@ def fetch(dest: Path, timeout_s: float = 30.0, quiet: bool = False) -> bool:
         if not quiet:
             print(f"already complete: {dest}")
         return True
-    except FileNotFoundError:
+    except Exception:  # noqa: BLE001 - missing OR corrupt: re-fetch below
         pass
     for name in FILES:
         out = dest / name
-        if out.exists():
+        if out.exists() and _valid_idx_bytes(out.read_bytes()):
             continue
         for mirror in MIRRORS:
             url = mirror + name
@@ -63,6 +63,16 @@ def fetch(dest: Path, timeout_s: float = 30.0, quiet: bool = False) -> bool:
                     print(f"fetching {url} ...", flush=True)
                 with urllib.request.urlopen(url, timeout=timeout_s) as r:
                     data = r.read()
+                # validate BEFORE accepting: a captive portal answers 200
+                # with an HTML page (and a truncated transfer is not a
+                # dataset either) — accepting bad bytes here would poison
+                # this file and skip the healthy mirrors behind it
+                if not _valid_idx_bytes(data):
+                    if not quiet:
+                        print(f"  {url}: not a complete gzip/IDX file "
+                              f"(captive portal?) — trying next mirror",
+                              file=sys.stderr)
+                    continue
                 out.write_bytes(data)
                 break
             except (urllib.error.URLError, OSError, TimeoutError) as e:
@@ -70,18 +80,46 @@ def fetch(dest: Path, timeout_s: float = 30.0, quiet: bool = False) -> bool:
                     print(f"  {type(e).__name__}: {e}", file=sys.stderr)
         else:
             return False
-    try:  # verify by parsing — a captive-portal HTML page is not a dataset
+    try:  # final verification: fully parse the dataset
         load_mnist_idx(dest, "train")
         load_mnist_idx(dest, "test")
     except Exception as e:  # noqa: BLE001 - any parse failure = bad download
         if not quiet:
             print(f"downloaded files failed to parse: {e}", file=sys.stderr)
-        # remove the bad bytes: leaving them would make every retry skip
-        # the download (the exists() check) and fail the parse forever
+        # per-file validation passed but the SET doesn't parse (e.g. an
+        # images/labels count mismatch across files) — no way to tell
+        # which file is the odd one out, so clear all four; every accepted
+        # file was individually validated, so a retry re-fetches cleanly
         for name in FILES:
             (dest / name).unlink(missing_ok=True)
         return False
     return True
+
+
+def _valid_idx_bytes(data: bytes) -> bool:
+    """Full standalone validation of one (possibly gzipped) IDX file:
+    decompresses, checks the IDX magic (``\\x00\\x00\\x08`` + dim count
+    1 or 3), and verifies the payload length matches the declared dims —
+    catching captive-portal pages AND truncated transfers."""
+    import gzip
+    import struct
+
+    try:
+        if data[:2] == b"\x1f\x8b":
+            data = gzip.decompress(data)
+        if len(data) < 8 or data[:3] != b"\x00\x00\x08":
+            return False
+        ndim = data[3]
+        if ndim not in (1, 3):
+            return False
+        header = 4 + 4 * ndim
+        dims = struct.unpack(f">{ndim}I", data[4:header])
+        count = 1
+        for d in dims:
+            count *= d
+        return len(data) == header + count
+    except Exception:  # noqa: BLE001 - any decode failure = invalid
+        return False
 
 
 def main() -> int:

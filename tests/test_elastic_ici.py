@@ -74,8 +74,12 @@ def test_ici_kill9_shrink_grads_ride_xla_collectives(tmp_path):
         assert done[-1]["lr"] == pytest.approx(0.1 * 2 / 3)
         # the verdict's HLO proof: every round's gradient sync compiled
         # to an XLA all-reduce — including the post-shrink world-2 round
+        # the worker tolerates transient collective failures that re-form
+        # at the unchanged size, so a benign world-3 re-formation may emit
+        # an extra world-3 hlo event — assert first/last, not the exact
+        # sequence
         hlos = [e for e in ev if e["event"] == "hlo"]
-        assert [h["world"] for h in hlos] == [3, 2]
+        assert hlos and hlos[0]["world"] == 3 and hlos[-1]["world"] == 2
         assert all(h["all_reduce"] for h in hlos)
 
     d0 = _events(tmp_path, 0)[-1]

@@ -30,6 +30,13 @@ class TestCollectiveFailureClassifier:
             "unsupported operand type(s) for +: 'int' and 'str'"))
         assert not is_collective_failure(ValueError("shapes do not match"))
 
+    def test_unrelated_type_with_matching_message_does_not_match(self):
+        # only RuntimeError/ValueError (what XLA raises from a compiled
+        # collective) are classified — an arbitrary exception whose
+        # message happens to contain a marker is not a membership change
+        assert not is_collective_failure(KeyError("socket closed"))
+        assert not is_collective_failure(OSError("connection refused"))
+
     def test_control_plane_outage_does_not_match(self):
         # the coord-store client raises ConnectionError; a dead store must
         # propagate, not trigger re-rendezvous against itself

@@ -82,11 +82,16 @@ class FormationTimeout(RuntimeError):
 def is_collective_failure(exc: BaseException) -> bool:
     """Does this exception look like a peer-loss inside the compiled data
     plane (rather than a bug)?  Matched on the message because XLA surfaces
-    gloo/coordination failures as plain ``ValueError``/``RuntimeError``.
-    ``ConnectionError`` is excluded: the coord-store client raises it, and
-    a control-plane outage must propagate, not trigger re-rendezvous
-    against a dead store."""
+    gloo/coordination failures as plain ``ValueError``/``RuntimeError`` —
+    and ONLY on those types, so an unrelated exception whose message
+    happens to contain e.g. "socket closed" is not silently treated as a
+    membership change.  ``ConnectionError`` is excluded explicitly even
+    though it is not a RuntimeError/ValueError: the coord-store client
+    raises it, and a control-plane outage must propagate, not trigger
+    re-rendezvous against a dead store."""
     if isinstance(exc, ConnectionError):
+        return False
+    if not isinstance(exc, (RuntimeError, ValueError)):
         return False
     msg = str(exc).lower()
     return any(mark in msg for mark in _COLLECTIVE_FAILURE_MARKS)
@@ -404,6 +409,21 @@ class IciCollectives:
         self.local_rows = sum(
             1 for d in mesh.devices.flat if d.process_index == me)
         self.num_processes = jax.process_count()
+        # _stack_local contributes one row per LOCAL DEVICE and the pmean
+        # averages over device rows; with heterogeneous per-process device
+        # counts that would be a device-weighted mean, not the per-process
+        # mean allreduce_mean promises (and allreduce_sum = mean × procs
+        # would be silently wrong).  Fail loudly at formation instead —
+        # counting EVERY process's rows (mesh.devices is global), so the
+        # failure is symmetric: no member proceeds into a collective its
+        # peers refused to join.
+        from collections import Counter
+
+        per_proc = Counter(d.process_index for d in mesh.devices.flat)
+        if len(set(per_proc.values())) > 1:
+            raise RuntimeError(
+                f"IciCollectives requires a uniform device count per "
+                f"process; mesh devices per process: {dict(per_proc)}")
         self._sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(self.axis))
         self._execs: dict[Any, Any] = {}
