@@ -856,25 +856,34 @@ def bench_serve_loop(on_tpu: bool) -> None:
                      decode_attention=attn, prefill_chunk=chunk)
     reqs = [Request(rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32),
                     gen, rid=i) for i, n in enumerate(lens)]
-    # warm THIS instance's segment executable (jit caches are per
-    # instance) with a throwaway request before instrumenting
-    loop.run([Request(np.asarray(reqs[0].prompt), 2, rid="warm")])
+    # warm THIS instance's executables (jit caches are per instance) for
+    # EVERY distinct prefill shape the run will see, so no compile lands
+    # inside the instrumented window
+    for n in sorted(set(lens)):
+        loop.run([Request(rng.integers(0, cfg.vocab_size, (n,)).astype(
+            np.int32), 2, rid="warm")])
 
-    # instrument admissions so decode-rate excludes prompt prefill (the
-    # fixed-batch subtraction excludes its prefill too), and count host
-    # syncs: every segment pays one tunnel round trip, which at the dev
-    # tunnel's 1–130 ms RTT dominates the wall clock (a local chip pays
-    # ~0.1 ms) — the rtt-corrected rate is the hardware-honest number,
-    # the raw one is what THIS tunnel delivers
-    prefill_s = {"t": 0.0}
+    # Admission is dispatch-only since round 5 (the prefill rides the
+    # device queue under the decode segments; the first token resolves at
+    # the next segment sync) — so the instrumented quantities are:
+    # * admit host stall (pure dispatch time; target < one segment),
+    # * per-segment host syncs (each pays one tunnel RTT; at the dev
+    #   tunnel's 1–130 ms RTT that dominates wall clock, a local chip
+    #   pays ~0.1 ms — the rtt-corrected rate is the hardware-honest
+    #   number, the raw one is what THIS tunnel delivers),
+    # * prefill DEVICE time, estimated per distinct shape afterwards and
+    #   deducted (the fixed-batch baseline excludes its prefill too).
+    admit_s = {"t": 0.0, "max": 0.0, "n": 0}
     syncs = {"n": 0}
     orig_admit, orig_segment = loop._admit, loop._segment
 
     def timed_admit(slot, req):
         t0 = _t.perf_counter()
         out = orig_admit(slot, req)
-        jax.block_until_ready(loop.cache)
-        prefill_s["t"] += _t.perf_counter() - t0
+        dt = _t.perf_counter() - t0
+        admit_s["t"] += dt
+        admit_s["max"] = max(admit_s["max"], dt)
+        admit_s["n"] += 1
         return out
 
     def counted_segment(*a):
@@ -885,13 +894,40 @@ def bench_serve_loop(on_tpu: bool) -> None:
     t0 = _t.perf_counter()
     comps = loop.run(reqs)
     wall = _t.perf_counter() - t0
-    # each request's FIRST token is generated during (excluded) admission
+    loop._admit, loop._segment = orig_admit, orig_segment
+    # each request's FIRST token is generated during (deducted) admission
     # prefill — count len-1 per request, matching fixed-batch's (gen - 1)
     total_tokens = sum(len(c.tokens) - 1 for c in comps)
-    decode_s = max(wall - prefill_s["t"], 1e-9)
+    # estimate the prefill device time the run's admissions enqueued:
+    # time each distinct padded shape with CHAINED dispatches and one
+    # sync (a single timed call is max(RTT, device) on the tunnel, which
+    # under-reports any prefill shorter than the RTT)
+    shape_cost: dict = {}
+    n_chain = 6
+    for n in sorted(set(lens)):
+        L = int(n)
+        Lp = min(-(-L // chunk) * chunk, cfg.max_seq_len)
+        padded = np.full((1, Lp), 0, np.int32)
+        padded[0, :L] = rng.integers(0, cfg.vocab_size, (L,))
+        arr = jnp.asarray(padded)
+
+        def burst(arr=arr, L=L):
+            f = None
+            for _ in range(n_chain):
+                _c1, f = loop._prefill_one(
+                    loop.params, arr, jnp.int32(L), jax.random.key(0),
+                    true_chunk=chunk)
+            int(f)   # one sync for the whole burst
+        burst()
+        t1 = _t.perf_counter()
+        burst()
+        shape_cost[L] = max(_t.perf_counter() - t1 - _RTT, 0.0) / n_chain
+    prefill_est = sum(shape_cost[int(n)] for n in lens)
+    decode_s = max(wall - prefill_est - admit_s["t"], 1e-9)
     decode_net = max(decode_s - syncs["n"] * _RTT, 1e-9)
     serve_slot_tps = total_tokens / decode_s / slots
     net_slot_tps = total_tokens / decode_net / slots
+    seg_s = decode_net / max(syncs["n"], 1)
     _emit("serve_loop_tokens_per_slot", round(net_slot_tps, 1),
           "tokens/sec/slot", round(net_slot_tps / fb_slot_tps, 3),
           context=cfg.max_seq_len, slots=slots, requests=len(reqs),
@@ -900,8 +936,90 @@ def bench_serve_loop(on_tpu: bool) -> None:
           raw_tokens_per_slot=round(serve_slot_tps, 1),
           raw_vs_fixed_batch=round(serve_slot_tps / fb_slot_tps, 3),
           segments=syncs["n"],
-          admission_s=round(prefill_s["t"], 2),
+          admission_host_s=round(admit_s["t"], 3),
+          admission_stall_max_segments=round(
+              admit_s["max"] / max(seg_s, 1e-9), 2),
+          prefill_device_est_s=round(prefill_est, 2),
           decode_s=round(decode_s, 2),
+          rtt_ms=round(_RTT * 1e3, 1))
+
+
+def bench_serve_capacity(on_tpu: bool) -> None:
+    """int8 KV as CAPACITY, not step time (round-4 verdict #4): at a
+    fixed HBM budget the int8 cache holds ~2× the (slots × context) of
+    bf16, and decode at capacity is bandwidth-bound — both configurations
+    stream the whole budget per step, so the int8 fleet's AGGREGATE
+    tokens/sec scales with its extra slots.  Measured by actually
+    allocating both caches at the budget and timing one decode step at
+    capacity (8k context, GQA 8q/2kv, d=64 — the serving bench model's
+    geometry)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist.ops.flash_decode import flash_decode, flash_decode_q8
+
+    S, h, h_kv, d = (8192, 8, 2, 64) if on_tpu else (256, 4, 2, 32)
+    budget = int(4e9) if on_tpu else int(4e6)
+    bytes_bf16 = S * h_kv * d * 2 * 2                 # K+V, 2B each
+    bytes_q8 = S * h_kv * d * 2 + S * h_kv * 4 * 2    # int8 data + f32 scales
+    slots_bf16 = budget // bytes_bf16
+    slots_q8 = budget // bytes_q8
+    rng = np.random.default_rng(0)
+
+    def rate(slots, q8):
+        q = jnp.asarray(rng.standard_normal((slots, 1, h, d)), jnp.bfloat16)
+        if q8:
+            # synthesize the int8 cache DIRECTLY at the budget (staging a
+            # bf16 cache through quantize_kv at the q8 slot count would
+            # transiently hold ~3x the budget); bandwidth timing only
+            # needs the bytes, and a small real-data sample keeps the
+            # kernel numerics honest elsewhere (bench_decode's q8 line)
+            kq = jnp.asarray(rng.integers(-127, 128, (slots, S, h_kv, d)),
+                             jnp.int8)
+            vq = jnp.asarray(rng.integers(-127, 128, (slots, S, h_kv, d)),
+                             jnp.int8)
+            ks = jnp.asarray(
+                rng.uniform(0.005, 0.02, (slots, S, h_kv, 1)), jnp.float32)
+            vs = jnp.asarray(
+                rng.uniform(0.005, 0.02, (slots, S, h_kv, 1)), jnp.float32)
+            fn = jax.jit(lambda q: flash_decode_q8(
+                q, kq, ks, vq, vs, S - 1))
+        else:
+            k = jnp.asarray(rng.standard_normal((slots, S, h_kv, d)),
+                            jnp.bfloat16)
+            v = jnp.asarray(rng.standard_normal((slots, S, h_kv, d)),
+                            jnp.bfloat16)
+            fn = jax.jit(lambda q: flash_decode(q, k, v, S - 1))
+        reps = 8 if on_tpu else 2
+
+        @jax.jit
+        def many(q):
+            def body(q, _):
+                o = fn(q)
+                return (q + o.astype(q.dtype) * 1e-6), None
+            return jax.lax.scan(body, q, None, length=reps)[0]
+
+        many(q).block_until_ready()
+        best = 1e9
+        for _ in range(3):
+            t0 = _t.perf_counter()
+            many(q).block_until_ready()
+            best = min(best, (_t.perf_counter() - t0 - _RTT) / reps)
+        return slots / max(best, 1e-9)         # aggregate tokens/sec
+
+    tps_bf16 = rate(slots_bf16, q8=False)
+    tps_q8 = rate(slots_q8, q8=True)
+    _emit("serve_loop_capacity", round(slots_q8 / slots_bf16, 2),
+          "x slots at fixed HBM", None,
+          context=S, hbm_budget_gb=round(budget / 1e9, 1),
+          slots_bf16=int(slots_bf16), slots_q8=int(slots_q8),
+          bytes_per_slot_bf16=bytes_bf16, bytes_per_slot_q8=bytes_q8,
+          agg_tokens_per_sec_bf16=round(tps_bf16, 0),
+          agg_tokens_per_sec_q8=round(tps_q8, 0),
+          capacity_throughput_ratio=round(tps_q8 / tps_bf16, 2),
           rtt_ms=round(_RTT * 1e3, 1))
 
 
@@ -1276,38 +1394,64 @@ def bench_speculative_decode(on_tpu: bool) -> None:
               plain_tokens_per_sec=round(plain_tps, 1),
               exact_match=match_t, rtt_ms=round(_RTT * 1e3, 1))
 
-    # ---- adaptive num_draft at the worst tier -------------------------
-    # the policy turns the measured acceptance into the throughput-
-    # optimal K; run that K on the same degraded draft and compare with
-    # the fixed ceiling-tuned K=16
-    low_tps, low_acc, low_sigma = tier_results[0.6]
-    pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16), draft_cost_ratio=0.1)
-    a_hat = pol.infer_acceptance(low_acc, k_spec)
-    k_low = pol.best_k(a_hat, batch=batch)
-    if k_low != k_spec:
-        dp_low = noised(low_sigma)  # the 0.6 tier's calibration, reused
-        tk_n = spec_call(spec_fn(new_tokens, k_low), dp_low)
-        # the n=1 rollout never runs a draft/verify round (rounds == 0 at
-        # max_new_tokens == 1), so its wall time is K-independent — reuse
-        # the already-compiled K=16 executable for the subtraction
-        tk_1 = spec_call(fn_one, dp_low)
-        t_k = timed(tk_n) - timed(tk_1)
-        k_tps = batch * (new_tokens - 1) / max(t_k, 1e-9)
-        match_k = bool(jnp.all(
-            tk_n(prompt)[:, prompt_len:] == plain_tokens))
-    else:
-        # the policy independently confirmed the fixed K — the tier's own
-        # measurement IS the policy's measurement
-        k_tps, match_k = low_tps, True
-    _emit("speculative_adaptive_num_draft",
-          round(k_tps / low_tps, 2), "x", None,
-          context=target_cfg.max_seq_len, batch=batch,
-          policy_k=k_low, fixed_k=k_spec,
-          inferred_acceptance=round(a_hat, 3),
-          policy_tokens_per_sec=round(k_tps, 1),
-          fixed_tokens_per_sec=round(low_tps, 1),
-          vs_plain=round(k_tps / plain_tps, 2),
-          exact_match=match_k, rtt_ms=round(_RTT * 1e3, 1))
+    # ---- adaptive num_draft at EVERY tier (round-4 verdict #2) --------
+    # The policy's costs are MEASURED, not modeled: per-round seconds at
+    # each ladder K (one round's cost is ~acceptance-independent — the
+    # acceptance changes how many rounds run, not what a round costs; the
+    # 0.8-tier draft supplies plenty of rounds for the estimate), plus
+    # the plain-decode per-token cost arming the break-even gate.  The
+    # policy must then be >= fixed K=16 at every tier AND >= plain always
+    # (at low acceptance the armed gate falls back to the plain rollout).
+    ladder = (2, 4, 8, 16)
+    pol = AdaptiveDraftPolicy(ladder=ladder)
+    pol.set_plain_cost(t_plain / (new_tokens - 1))
+    dp_cost = noised(tier_results[0.8][2])
+    # the n=1 rollout never runs a draft/verify round, so its wall time
+    # is K-independent — ONE measurement serves every K's subtraction
+    t_one = timed(spec_call(fn_one, dp_cost))
+    fns = {k_spec: fn_full}
+    for kk in ladder:
+        if kk not in fns:
+            fns[kk] = spec_fn(new_tokens, kk)
+        ck_n = spec_call(fns[kk], dp_cost)
+        t_full = timed(ck_n)          # stats_box: the LAST full run's
+        rounds_k = max(stats_box.get("rounds", 0), 1)
+        pol.observe_round_cost(kk, max(t_full - t_one, 1e-9) / rounds_k)
+    note(f"ladder round costs (ms): "
+         f"{ {k: round(pol.round_cost(k) * 1e3, 2) for k in ladder} }")
+
+    all_tiers = [("ceiling", spec_tps, accept_rate, None)] + [
+        (tier, tps, acc, sigma)
+        for tier, (tps, acc, sigma) in sorted(tier_results.items(),
+                                              reverse=True)]
+    for tier_name, fixed_tps, acc, sigma in all_tiers:
+        a_hat = pol.infer_acceptance(acc, k_spec)
+        k_pol = pol.best_k(a_hat, batch=batch)
+        if k_pol == 0:
+            # break-even gate: the policy serves this tier through the
+            # PLAIN rollout — by construction never worse than plain
+            k_tps, match_k = plain_tps, True
+        elif k_pol == k_spec:
+            # policy confirmed the fixed K — the tier's own measurement
+            # IS the policy's measurement
+            k_tps, match_k = fixed_tps, True
+        else:
+            dp = d_params if sigma is None else noised(sigma)
+            tk_n = spec_call(fns[k_pol], dp)
+            tk_1 = spec_call(fn_one, dp)
+            t_k = timed(tk_n) - timed(tk_1)
+            k_tps = batch * (new_tokens - 1) / max(t_k, 1e-9)
+            match_k = bool(jnp.all(
+                tk_n(prompt)[:, prompt_len:] == plain_tokens))
+        _emit("speculative_adaptive_num_draft",
+              round(k_tps / fixed_tps, 2), "x", None,
+              context=target_cfg.max_seq_len, batch=batch,
+              tier=tier_name, policy_k=k_pol, fixed_k=k_spec,
+              inferred_acceptance=round(a_hat, 3),
+              policy_tokens_per_sec=round(k_tps, 1),
+              fixed_tokens_per_sec=round(fixed_tps, 1),
+              vs_plain=round(k_tps / plain_tps, 2),
+              exact_match=match_k, rtt_ms=round(_RTT * 1e3, 1))
 
 
 def main() -> None:
@@ -1323,9 +1467,17 @@ def main() -> None:
                bench_resnet50_pipeline,
                bench_flash_attention, bench_window_speedup, bench_decode,
                bench_moe, bench_flash_decode_bandwidth,
-               bench_serve_loop,
+               bench_serve_loop, bench_serve_capacity,
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode]
+    # optional name filters: `python bench.py serve_loop moe` runs only
+    # the benches whose function name contains a given substring (dev
+    # iteration aid; the driver runs the full suite with no args)
+    import sys as _sys
+    if len(_sys.argv) > 1:
+        pats = _sys.argv[1:]
+        benches = [b for b in benches
+                   if any(p in b.__name__ for p in pats)]
     for bench in benches:
         try:
             bench(on_tpu)

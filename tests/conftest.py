@@ -14,12 +14,28 @@ the config again after importing jax — unit tests must never touch real
 hardware.
 """
 
-from tpudist.runtime.simulate import force_cpu_devices
+import os
+
+# The persistent-cache AOT loader logs a full machine-feature dump at E
+# level for XLA's prefer-no-scatter/gather PSEUDO-features on every cache
+# hit (same machine, no real ISA mismatch) — silence the C++ log stream
+# before jax loads; Python exceptions still propagate normally.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+from tpudist.runtime.simulate import force_cpu_devices  # noqa: E402
 
 force_cpu_devices(8)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+from tpudist.runtime.cache import enable_compilation_cache  # noqa: E402
+
+# Persistent compilation cache across test runs (round-4 verdict #9: the
+# default suite's budget is dominated by CPU-backend compiles of the
+# deep-rollout tests; measured 5.7 s -> 0.9 s on a warm 4-layer rollout).
+# Worker subprocesses inherit it via the env var.
+os.environ.setdefault("TPUDIST_CACHE_DIR", enable_compilation_cache())
 
 
 @pytest.fixture(scope="session")

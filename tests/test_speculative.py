@@ -374,6 +374,46 @@ class TestAdaptiveDraftPolicy:
                    num_draft=4)
         assert pol.acceptance > 0.95
 
+    def test_measured_costs_override_analytic_prior(self):
+        from tpudist.models.speculative import AdaptiveDraftPolicy
+
+        pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16),
+                                  draft_cost_ratio=0.3)
+        # analytic prior at modest acceptance: long chunks look costly
+        assert pol.best_k(0.75, batch=4) < 16
+        # measured reality: the round cost is nearly K-independent (the
+        # verify chunk is cache-stream-bound) — long chunks win
+        for k in (2, 4, 8, 16):
+            pol.observe_round_cost(k, 1.0 + 0.001 * k)
+        assert pol.calibrated
+        assert pol.best_k(0.9, batch=4) == 16
+
+    def test_round_cost_linear_fit_interpolates(self):
+        from tpudist.models.speculative import AdaptiveDraftPolicy
+
+        pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16))
+        pol.observe_round_cost(2, 1.2)
+        pol.observe_round_cost(16, 2.6)   # slope 0.1, intercept 1.0
+        assert abs(pol.round_cost(8) - 1.8) < 1e-9
+        assert pol.round_cost(2) == 1.2   # observed points stay exact
+
+    def test_break_even_gate_falls_back_to_plain(self):
+        from tpudist.models.speculative import AdaptiveDraftPolicy
+
+        pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16))
+        for k in (2, 4, 8, 16):
+            pol.observe_round_cost(k, 1.0)   # 1 s per round
+        # at near-zero acceptance a round advances ~1 token/s; plain
+        # decode at 10 tokens/s wins -> gate says 0 (plain)
+        pol.set_plain_cost(0.1)
+        assert pol.best_k(0.05, batch=4) == 0
+        # at perfect acceptance a K=16 round advances 17 tokens/s > 10
+        assert pol.best_k(1.0, batch=4) == 16
+        # without the plain cost the gate is disarmed
+        pol2 = AdaptiveDraftPolicy(ladder=(2, 4))
+        pol2.observe_round_cost(2, 1.0)
+        assert pol2.best_k(0.05, batch=4) in (2, 4)
+
     def test_adaptive_rollout_exactness_and_adaptation(self):
         from tpudist.models.speculative import (
             AdaptiveDraftPolicy,
@@ -387,7 +427,7 @@ class TestAdaptiveDraftPolicy:
                                   initial_acceptance=0.97)
         toks, stats = adaptive_speculative_generate(
             TARGET_CFG, t_params, DRAFT_CFG, d_params, prompt, 24, pol,
-            segment_tokens=8, return_stats=True)
+            segment_tokens=8, return_stats=True, probe_plain=False)
         want = greedy_generate(TARGET_CFG, t_params, prompt, 24)
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(want))
         # segments adapted: the random draft's acceptance is near zero,
@@ -395,6 +435,31 @@ class TestAdaptiveDraftPolicy:
         assert stats["ks"][0] == 8          # optimistic start
         assert set(stats["ks"][1:]) == {2}  # measured reality
         assert stats["acceptance"][-1] < 0.3
+
+    def test_plain_probe_arms_gate_and_stays_exact(self):
+        """probe_plain (default): segments 2-3 run the plain rollout —
+        the second arms the break-even gate — and with a hopeless draft
+        the armed gate keeps every later segment on plain decode, all
+        while the output still bit-matches plain greedy."""
+        from tpudist.models.speculative import (
+            AdaptiveDraftPolicy,
+            adaptive_speculative_generate,
+        )
+
+        t_params = _make(TARGET_CFG, 0)
+        d_params = _make(DRAFT_CFG, 1)  # random draft: near-zero accept
+        prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, 64)
+        pol = AdaptiveDraftPolicy(ladder=(2, 8),
+                                  initial_acceptance=0.97)
+        toks, stats = adaptive_speculative_generate(
+            TARGET_CFG, t_params, DRAFT_CFG, d_params, prompt, 48, pol,
+            segment_tokens=8, return_stats=True)
+        want = greedy_generate(TARGET_CFG, t_params, prompt, 48)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(want))
+        assert stats["ks"][1] == 0 and stats["ks"][2] == 0  # the probe
+        assert pol._plain_tok_s is not None                 # gate armed
+        # CPU timing noise decides later segments' K; exactness and the
+        # armed gate are the invariants this test pins
 
     def test_validation(self):
         from tpudist.models.speculative import (

@@ -12,7 +12,8 @@ import sys
 
 from tpudist.runtime.simulate import force_cpu_devices
 
-force_cpu_devices(1, check=False)
+force_cpu_devices(int(os.environ.get("WORKER_LOCAL_DEVICES", "1")),
+                  check=False)
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -32,7 +33,8 @@ def main() -> int:
 
     mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
     sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
-    local = np.full((1, 4), ctx.process_index + 1, np.float32)
+    local = np.full((ctx.local_device_count, 4), ctx.process_index + 1,
+                    np.float32)
     x = jax.make_array_from_process_local_data(
         sh, local, (ctx.global_device_count, 4))
 
@@ -48,6 +50,33 @@ def main() -> int:
     out["psum"] = float(np.asarray(summed.addressable_shards[0].data)[0, 0])
     out["hlo_all_reduce"] = "all-reduce" in jax.jit(
         lambda x: allsum(x)).lower(x).compile().as_text()
+
+    if os.environ.get("WORKER_HYBRID"):
+        # the 2-axis DCN×ICI mesh (the reference's nodes × procs flagship
+        # shape, `mnist_ddp_elastic.py:5-6`): axis 0 spans PROCESSES
+        # (DCN on real pods), axis 1 each process's own devices (ICI);
+        # the gradient-style reduction runs over BOTH axes in one
+        # compiled program — XLA inserts the cross-process collective
+        P_, L_ = ctx.process_count, ctx.local_device_count
+        mesh2 = jax.sharding.Mesh(
+            np.array(jax.devices()).reshape(P_, L_), ("dcn", "ici"))
+        spec2 = jax.sharding.PartitionSpec(("dcn", "ici"))
+        sh2 = jax.sharding.NamedSharding(mesh2, spec2)
+        x2 = jax.make_array_from_process_local_data(
+            sh2, local, (ctx.global_device_count, 4))
+
+        @jax.jit
+        def allsum2(x):
+            def f(x):
+                return jax.lax.psum(x, ("dcn", "ici"))
+            return jax.shard_map(f, mesh=mesh2, in_specs=spec2,
+                                 out_specs=spec2)(x)
+
+        s2 = allsum2(x2)
+        out["hybrid_psum"] = float(
+            np.asarray(s2.addressable_shards[0].data)[0, 0])
+        out["hybrid_hlo_all_reduce"] = "all-reduce" in jax.jit(
+            lambda x: allsum2(x)).lower(x2).compile().as_text()
 
     with open(os.path.join(os.environ["WORKER_OUT_DIR"],
                            f"dcn_{ctx.process_index}.json"), "w") as fh:

@@ -188,9 +188,22 @@ class ServeLoop:
         self._tok = jnp.full((num_slots,), self.pad_token, jnp.int32)
         self._active = jnp.zeros((num_slots,), bool)
         self._remaining = jnp.zeros((num_slots,), jnp.int32)
-        self._segment = jax.jit(self._segment_impl)
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._merge = jax.jit(self._merge_impl, donate_argnums=(0,))
+        # deferred first-from-prefill tokens, one lane per slot: admission
+        # stamps it on device; the next segment's emits carry it to the
+        # host as column 0 — so resolving a first token costs ZERO extra
+        # transfers (a per-slot int() fetch measured one full tunnel RTT
+        # per admission, ~0.1 s each on the dev tunnel)
+        self._first = jnp.full((num_slots,), self.pad_token, jnp.int32)
+        self._segment = jax.jit(self._segment_impl, donate_argnums=(1,))
+        # params is a jit ARGUMENT (a closure capture would lower the
+        # whole parameter tree into the traced program — the HTTP-413 /
+        # duplicated-constants hazard bench.py documents — and would pin
+        # first-trace weights if self.params is ever rebound)
+        self._admit_dev = jax.jit(self._admit_dev_impl,
+                                  donate_argnums=(1, 2, 3, 4, 5),
+                                  static_argnames=("true_chunk",))
+        # standalone prefill, used by benchmarks to price admission's
+        # device work without touching live state
         self._prefill_one = jax.jit(self._prefill_impl,
                                     static_argnames=("true_chunk",))
 
@@ -211,16 +224,20 @@ class ServeLoop:
 
     # -- compiled pieces ---------------------------------------------------
 
-    def _segment_impl(self, params, cache, tok, active, remaining, key):
+    def _segment_impl(self, params, cache, tok, active, remaining, first,
+                      key):
         stop_arr = self._stop
         pad = jnp.int32(self.pad_token)
         S = self.cfg.max_seq_len
 
         def step(carry, _):
-            cache, tok, active, remaining, key = carry
+            cache, tok, active, remaining, lived, key = carry
             main_idx, side_idx = _index_leaves(cache)
             pos = main_idx if side_idx is None else main_idx + side_idx
             pos = jnp.minimum(pos, S - 1)
+            # a row active at step ENTRY writes a real token's K/V this
+            # step — the merge later scatters exactly these side slots
+            lived = lived + active.astype(jnp.int32)
             logits, mut = self.model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 positions=pos[:, None], mutable=["cache"])
@@ -233,12 +250,22 @@ class ServeLoop:
                         else jnp.zeros_like(active))
             active = active & ~hit_stop & (remaining > 0)
             tok = jnp.where(active, nxt, pad)
-            return (mut["cache"], tok, active, remaining, key), emit
+            return (mut["cache"], tok, active, remaining, lived, key), emit
 
-        (cache, tok, active, remaining, key), emits = lax.scan(
-            step, (cache, tok, active, remaining, key), None,
+        lived0 = jnp.zeros((self.B,), jnp.int32)
+        (cache, tok, active, remaining, lived, key), emits = lax.scan(
+            step, (cache, tok, active, remaining, lived0, key), None,
             length=self.steps)
-        return cache, tok, active, remaining, key, emits.T  # [B, steps]
+        if self.side:
+            # side -> main merge INSIDE the segment executable: one
+            # dispatch per wave instead of two (each dispatch costs
+            # multiple ms through the dev tunnel), and XLA can overlap
+            # the merge with the tail of the scan
+            cache = self._merge_impl(cache, lived)
+        # column 0 carries the admission-deferred first tokens so ONE
+        # host fetch resolves them together with the segment's emits
+        emits = jnp.concatenate([first[:, None], emits.T], axis=1)
+        return cache, tok, active, remaining, key, emits
 
     def _prefill_impl(self, params, prompt_padded, true_len, key,
                       *, true_chunk):
@@ -268,11 +295,45 @@ class ServeLoop:
                     for k, v in big.items()}
         return walk(cache, cache1)
 
-    def _merge_impl(self, cache):
+    def _admit_dev_impl(self, params, cache, tok, active, remaining,
+                        first_buf, prompt_padded, true_len, slot, max_new,
+                        key, *, true_chunk):
+        """The WHOLE of admission's device work — chunked prefill of the
+        prompt into a fresh batch-1 cache, insertion into the freed slot,
+        and the slot's token/active/budget lane stamps (plus the
+        deferred-first lane the next segment's emits carry home) — in
+        ONE dispatch with no host sync.  The first token's stop check
+        runs on device too: the host learns the token's value at the
+        NEXT segment sync, by which time the prefill has long finished
+        (chunked-prefill overlap: admission stalls the decode cadence by
+        dispatch time only, not the prefill's round trip)."""
+        cache1, first = self._prefill_impl(
+            params, prompt_padded, true_len, key, true_chunk=true_chunk)
+        cache = self._insert_impl(cache, cache1, slot, true_len)
+        tok = tok.at[slot].set(first)
+        act = max_new > 1
+        if self._stop is not None:
+            act = act & ~jnp.isin(first, self._stop)
+        active = active.at[slot].set(act)
+        remaining = remaining.at[slot].set(max_new - 1)
+        first_buf = first_buf.at[slot].set(first)
+        return cache, tok, active, remaining, first_buf
+
+    def _merge_impl(self, cache, lived):
         """End-of-segment: scatter each layer's side buffer into the main
         cache at every row's own offset (per-row-index writes, but ONCE
         per segment instead of once per step), advance the per-row
-        lengths by the segment's token count, reset the side counter."""
+        lengths, reset the side counter.
+
+        ``lived`` is the per-row count of REAL side tokens (steps the row
+        entered active); the merge is masked to exactly those slots and
+        the length advance uses it too, so a frozen row's garbage side
+        writes never land in the main cache and its length never drifts —
+        local correctness, not a host-loop invariant.  Near the cache end
+        the cap-aligned write window shifts below ``idx[r]``; the side
+        row is re-aligned by ``sh`` so live token ``t`` still lands at
+        global position ``idx[r] + t`` and everything below ``idx[r]``
+        rewrites the main cache's own (sliced-out) values."""
         B = self.B
 
         def walk(node):
@@ -280,21 +341,30 @@ class ServeLoop:
                 return node
             out = {k: walk(v) for k, v in node.items()}
             if "side_key" in out:
-                used = out["side_index"]
                 idx = out["cache_index"]
                 S = out["cached_key"].shape[1]
                 cap = out["side_key"].shape[1]
+                p = jnp.arange(cap)
                 for name, side_name in (("cached_key", "side_key"),
                                         ("cached_value", "side_value")):
                     main = out[name]
                     side = out[side_name]
                     for r in range(B):
                         start = jnp.minimum(idx[r], S - cap)
+                        sh = idx[r] - start          # 0 unless near S
+                        src = p - sh
+                        cur = jax.lax.dynamic_slice(
+                            main, (r, start, 0, 0),
+                            (1, cap, *main.shape[2:]))
+                        live = ((src >= 0) & (src < lived[r]))[
+                            None, :, None, None]
+                        shifted = side[r][jnp.clip(src, 0, cap - 1)][None]
+                        merged = jnp.where(
+                            live, shifted.astype(main.dtype), cur)
                         main = jax.lax.dynamic_update_slice(
-                            main, side[r:r + 1].astype(main.dtype),
-                            (r, start, 0, 0))
+                            main, merged, (r, start, 0, 0))
                     out[name] = main
-                out["cache_index"] = jnp.minimum(idx + used, S)
+                out["cache_index"] = jnp.minimum(idx + lived, S)
                 out["side_index"] = jnp.zeros((), jnp.int32)
             return out
         return walk(cache)
@@ -314,6 +384,10 @@ class ServeLoop:
                 f"slots > max_seq_len {self.cfg.max_seq_len}")
 
     def _admit(self, slot: int, req: Request) -> dict:
+        """Admit ``req`` into ``slot`` WITHOUT a host sync: the prefill
+        and the state stamp are dispatched; the first token stays a
+        device scalar until the next segment sync resolves it (by which
+        point the decode segment has already hidden the prefill)."""
         self._validate(req)
         prompt = np.asarray(req.prompt, np.int32)
         L = int(prompt.size)
@@ -326,22 +400,13 @@ class ServeLoop:
         padded = np.full((1, Lp), self.pad_token, np.int32)
         padded[0, :L] = prompt
         self._key, pk = jax.random.split(self._key)
-        cache1, first = self._prefill_one(
-            self.params, jnp.asarray(padded), jnp.int32(L), pk,
+        (self.cache, self._tok, self._active, self._remaining,
+         self._first) = self._admit_dev(
+            self.params, self.cache, self._tok, self._active,
+            self._remaining, self._first, padded, np.int32(L),
+            np.int32(slot), np.int32(req.max_new_tokens), pk,
             true_chunk=chunk)
-        self.cache = self._insert(self.cache, cache1, jnp.int32(slot),
-                                  jnp.int32(L))
-        first = int(first)
-        state = {"req": req, "tokens": [first], "done": None}
-        if first in self._stop_set:
-            state["done"] = "stop"
-        elif req.max_new_tokens == 1:
-            state["done"] = "length"
-        self._tok = self._tok.at[slot].set(first)
-        self._active = self._active.at[slot].set(state["done"] is None)
-        self._remaining = self._remaining.at[slot].set(
-            req.max_new_tokens - 1)
-        return state
+        return {"req": req, "tokens": [], "pending_first": True}
 
     def run(self, requests: Sequence[Request]) -> list[Completion]:
         """Serve every request to completion; returns completions in
@@ -359,40 +424,39 @@ class ServeLoop:
                 tokens=np.asarray(st["tokens"], np.int32), reason=reason))
             slot_state[slot] = None
 
+        def drain(slot: int, emit_row) -> None:
+            """Feed a slot's newly visible tokens (column 0 = the
+            admission-deferred first token, then the segment's emits)
+            through the stop/budget rules; the first hit finalizes
+            BEFORE any frozen-row pad could be consumed, mirroring the
+            compiled freeze rule token for token."""
+            st = slot_state[slot]
+            row = [int(t) for t in emit_row]
+            if st["pending_first"]:
+                st["pending_first"] = False
+            else:
+                row = row[1:]               # column 0 is a stale first
+            for t in row:
+                st["tokens"].append(t)
+                if t in self._stop_set:
+                    finalize(slot, "stop")
+                    return
+                if len(st["tokens"]) >= st["req"].max_new_tokens:
+                    finalize(slot, "length")
+                    return
+
         while pending or any(s is not None for s in slot_state):
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
-                    st = self._admit(slot, pending.popleft())
-                    if st["done"] is not None:   # finished at prefill
-                        slot_state[slot] = st
-                        finalize(slot, st["done"])
-                    else:
-                        slot_state[slot] = st
-            if not any(s is not None for s in slot_state):
-                continue
-            self._key, sk = jax.random.split(self._key)
+                    slot_state[slot] = self._admit(slot, pending.popleft())
+            # the segment splits per-step keys and returns the advanced
+            # key — no per-wave host-side split dispatch needed
             (self.cache, self._tok, self._active, self._remaining,
-             _, emits) = self._segment(
+             self._key, emits) = self._segment(
                 self.params, self.cache, self._tok, self._active,
-                self._remaining, sk)
-            if self.side:
-                self.cache = self._merge(self.cache)
-            emits = np.asarray(emits)
+                self._remaining, self._first, self._key)
+            emits = np.asarray(emits)       # the one host sync per segment
             for slot in range(self.B):
-                st = slot_state[slot]
-                if st is None:
-                    continue
-                # the device emits real tokens exactly while the row is
-                # active; the first stop/budget hit below breaks BEFORE
-                # any frozen-row pad could be consumed, mirroring the
-                # compiled freeze rule token for token
-                for t in emits[slot]:
-                    t = int(t)
-                    st["tokens"].append(t)
-                    if t in self._stop_set:
-                        finalize(slot, "stop")
-                        break
-                    if len(st["tokens"]) >= st["req"].max_new_tokens:
-                        finalize(slot, "length")
-                        break
+                if slot_state[slot] is not None:
+                    drain(slot, emits[slot])
         return done

@@ -628,13 +628,29 @@ class AdaptiveDraftPolicy:
     (:func:`adaptive_speculative_generate`), each ladder K reusing its
     own jit-cached executable.
 
+    COSTS ARE MEASURED, NOT MODELED (round-4 verdict #2): the analytic
+    ``K·r + 1`` round-cost shape mispredicts on real hardware — the
+    verify chunk is cache-stream-bound (nearly K-independent) with a
+    large fixed cost, so long chunks stay cheap even at modest
+    acceptance.  Feed the policy realized per-round seconds via
+    :meth:`observe_round_cost` (the adaptive driver does this
+    automatically, skipping each K's first — compile-polluted — segment)
+    and, optionally, the plain-decode per-token cost via
+    :meth:`set_plain_cost`; :meth:`best_k` then maximizes MEASURED
+    tokens/second over the ladder, interpolating a linear fit for
+    not-yet-probed Ks, and — the break-even gate — returns ``0``
+    ("use plain decode") whenever even the best ladder K's predicted
+    rate loses to the plain rollout.  Until any cost is observed the
+    analytic shape with ``draft_cost_ratio`` is the prior (and the gate
+    stays off: unit-less analytic costs cannot be compared to plain
+    seconds).
+
     Args:
       ladder: candidate K values (each gets its own compiled rollout).
-      draft_cost_ratio: c_draft / c_verify — the relative cost of one
-        draft step vs one verify chunk.  Measurable (time one of each) or
-        estimable as draft_params_bytes / target_params_bytes at long
-        context where both are bandwidth-bound.
-      ema: smoothing for the acceptance estimate across updates.
+      draft_cost_ratio: PRIOR c_draft / c_verify used only before any
+        measured cost arrives.
+      ema: smoothing for the acceptance estimate AND the cost estimates
+        across updates.
     """
 
     def __init__(self, ladder: Sequence[int] = (4, 8, 16),
@@ -649,6 +665,8 @@ class AdaptiveDraftPolicy:
         self.ema = float(ema)
         self.acceptance = float(initial_acceptance)
         self.rounds_seen = 0
+        self._round_cost: dict[int, float] = {}   # K -> seconds/round
+        self._plain_tok_s: float | None = None    # seconds/token, plain
 
     # -- the algebra -------------------------------------------------------
 
@@ -676,13 +694,68 @@ class AdaptiveDraftPolicy:
                                   batch: int) -> float:
         return 1.0 + sum(a ** (batch * j) for j in range(1, k + 1))
 
+    # -- measured costs ----------------------------------------------------
+
+    def observe_round_cost(self, k: int, seconds_per_round: float) -> None:
+        """Fold one measured draft+verify round cost at chunk ``k`` into
+        the cost model (EMA-smoothed per K)."""
+        if seconds_per_round <= 0:
+            return
+        prev = self._round_cost.get(k)
+        self._round_cost[k] = (
+            seconds_per_round if prev is None
+            else self.ema * seconds_per_round + (1 - self.ema) * prev)
+
+    def set_plain_cost(self, seconds_per_token: float) -> None:
+        """Arm the break-even gate with the measured plain-decode cost."""
+        if seconds_per_token > 0:
+            self._plain_tok_s = float(seconds_per_token)
+
+    @property
+    def calibrated(self) -> bool:
+        return bool(self._round_cost)
+
+    def round_cost(self, k: int) -> float:
+        """Seconds (measured mode) or c_verify units (analytic prior)
+        for one draft+verify round at chunk ``k``: exact where observed;
+        a least-squares linear-in-K fit where ≥ 2 Ks were observed; the
+        one observed point scaled by the analytic shape at 1; the pure
+        analytic shape at 0."""
+        if k in self._round_cost:
+            return self._round_cost[k]
+        pts = sorted(self._round_cost.items())
+        if len(pts) >= 2:
+            xs = [p[0] for p in pts]
+            ys = [p[1] for p in pts]
+            n = len(pts)
+            mx = sum(xs) / n
+            my = sum(ys) / n
+            den = sum((x - mx) ** 2 for x in xs)
+            slope = (sum((x - mx) * (y - my) for x, y in pts) / den
+                     if den else 0.0)
+            return max(my + slope * (k - mx), 1e-9)
+        if len(pts) == 1:
+            k0, c0 = pts[0]
+            return c0 * (k * self.r + 1.0) / (k0 * self.r + 1.0)
+        return k * self.r + 1.0
+
     def best_k(self, a: float | None = None, batch: int = 1) -> int:
         """The ladder K maximizing expected tokens per unit cost at
-        acceptance ``a`` (default: the policy's running estimate)."""
+        acceptance ``a`` (default: the policy's running estimate) —
+        or ``0``, meaning "fall back to plain decode", when the break-
+        even gate is armed (measured costs + plain cost known) and even
+        the best K's predicted tokens/sec loses to the plain rollout."""
         a = self.acceptance if a is None else a
-        return max(self.ladder, key=lambda k:
-                   self.expected_tokens_per_round(a, k, batch)
-                   / (k * self.r + 1.0))
+
+        def rate(k):
+            return (self.expected_tokens_per_round(a, k, batch)
+                    / self.round_cost(k))
+
+        k_star = max(self.ladder, key=rate)
+        if self.calibrated and self._plain_tok_s is not None:
+            if rate(k_star) <= 1.0 / self._plain_tok_s:
+                return 0
+        return k_star
 
     # -- the feedback loop -------------------------------------------------
 
@@ -722,6 +795,7 @@ def adaptive_speculative_generate(
     prefill_chunk: int | None = None,
     return_stats: bool = False,
     auto_unstack: bool = True,
+    probe_plain: bool = True,
 ):
     """Speculative decoding with ``num_draft`` ADAPTED to measured
     acceptance, in segments.
@@ -741,9 +815,23 @@ def adaptive_speculative_generate(
     rollout segments); serve bounded-length requests through the
     continuous-batching loop instead.
 
+    Segment wall times feed the policy's MEASURED cost model (each K's
+    first segment is skipped — it contains the compile), so the K choice
+    adapts to realized hardware costs, not the analytic prior.  With
+    ``probe_plain`` (default), segments 2 and 3 run the PLAIN rollout —
+    the first carries its compile, the second's timing arms the policy's
+    break-even gate — after which any segment where even the best K's
+    predicted rate loses to plain decode runs the plain rollout instead
+    (the "never worse than plain" guarantee costs two early plain
+    segments; pass ``probe_plain=False`` to skip the probe and arm the
+    gate manually via ``policy.set_plain_cost``).  Exactness is
+    untouched either way: both continuations are exact samples.
+
     Returns tokens ``[B, prompt_len + max_new_tokens]`` (and, with
-    ``return_stats``, a dict with per-segment ``ks``, acceptance
-    estimates, and summed rounds/accepted)."""
+    ``return_stats``, a dict with per-segment ``ks`` (0 = plain
+    fallback), acceptance estimates, and summed rounds/accepted)."""
+    import time as _time
+
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -757,21 +845,63 @@ def adaptive_speculative_generate(
     remaining = max_new_tokens
     seg_stats: dict = {"ks": [], "acceptance": [], "rounds": 0,
                        "draft_accepted": 0}
+    # compile-pollution guard keyed by (K, n): jit executables are per
+    # SEGMENT LENGTH too, so a shorter final segment would otherwise feed
+    # its compile time into the measured cost model
+    uses: dict[tuple[int, int], int] = {}
+    seg_i = 0
     while remaining > 0:
         n = min(segment_tokens, remaining)
         k_seg = policy.best_k(batch=batch)
+        if (probe_plain and policy._plain_tok_s is None
+                and seg_i in (1, 2)):
+            k_seg = 0   # plain probe: compile (seg 1), then arm (seg 2)
         key, seg_key = jax.random.split(key)
-        toks, stats = speculative_generate(
-            target_cfg, target_params, draft_cfg, draft_params, toks, n,
-            num_draft=k_seg, key=seg_key, temperature=temperature,
-            top_k=top_k, top_p=top_p, decode_attention=decode_attention,
-            draft_decode_attention=draft_decode_attention,
-            prefill_chunk=prefill_chunk, return_stats=True,
-            auto_unstack=auto_unstack)
-        policy.update(stats, batch, k_seg)
+        t0 = _time.perf_counter()
+        if k_seg == 0:
+            # break-even fallback: plain rollout for this segment
+            from tpudist.models.generate import (
+                greedy_generate, sample_generate,
+            )
+
+            if temperature > 0:
+                toks = sample_generate(
+                    target_cfg, target_params, toks, n, key=seg_key,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    decode_attention=decode_attention,
+                    prefill_chunk=prefill_chunk,
+                    auto_unstack=auto_unstack)
+            else:
+                toks = greedy_generate(
+                    target_cfg, target_params, toks, n,
+                    decode_attention=decode_attention,
+                    prefill_chunk=prefill_chunk,
+                    auto_unstack=auto_unstack)
+            jax.block_until_ready(toks)
+            dt = _time.perf_counter() - t0
+            if uses.get((0, n), 0) >= 1:   # first call holds the compile
+                policy.set_plain_cost(dt / n)
+            stats = {"rounds": 0, "draft_accepted": 0}
+        else:
+            toks, stats = speculative_generate(
+                target_cfg, target_params, draft_cfg, draft_params, toks,
+                n, num_draft=k_seg, key=seg_key, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                decode_attention=decode_attention,
+                draft_decode_attention=draft_decode_attention,
+                prefill_chunk=prefill_chunk, return_stats=True,
+                auto_unstack=auto_unstack)
+            jax.block_until_ready(toks)
+            dt = _time.perf_counter() - t0
+            rounds = int(stats["rounds"])
+            if rounds > 0 and uses.get((k_seg, n), 0) >= 1:
+                policy.observe_round_cost(k_seg, dt / rounds)
+            policy.update(stats, batch, k_seg)
+        uses[(k_seg, n)] = uses.get((k_seg, n), 0) + 1
         seg_stats["ks"].append(k_seg)
         seg_stats["acceptance"].append(policy.acceptance)
         seg_stats["rounds"] += int(stats["rounds"])
         seg_stats["draft_accepted"] += int(stats["draft_accepted"])
         remaining -= n
+        seg_i += 1
     return (toks, seg_stats) if return_stats else toks

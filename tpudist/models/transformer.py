@@ -329,11 +329,12 @@ class CausalSelfAttention(nn.Module):
         while per-row-indexed main-cache writes measured +0.35 ms/step on
         the 8-layer 8k bench model (neither batched scatters nor
         per-row-index DUS chains stay in place inside the full segment
-        graph).  Attention is then per-row flash over the FROZEN main
-        cache merged by log-sum-exp with a small dense attend over the
-        side buffer; the ServeLoop scatters side → main once per segment
-        (amortized to ~nothing).  ``serve_side_slots == 0`` keeps the
-        direct per-row-write path (simple, correct, slower)."""
+        graph).  Attention then runs as ONE fused flash-decode call over
+        the frozen main cache (per-row lengths) plus the side buffer's
+        live positions (:meth:`_serve_attend_sided`); the ServeLoop
+        scatters side → main once per segment (amortized to ~nothing).
+        ``serve_side_slots == 0`` keeps the direct per-row-write path
+        (simple, correct, slower)."""
         cfg = self.cfg
         b, s = q.shape[0], q.shape[1]
         if s != 1:
@@ -385,7 +386,15 @@ class CausalSelfAttention(nn.Module):
         tokens (every row writes the same side slot each step — admission
         only happens at segment boundaries, so side occupancy is uniform
         across rows; frozen rows write garbage that their discarded
-        outputs never expose and the merge-time mask drops)."""
+        outputs never expose and the merge-time mask drops).
+
+        Attention runs as ONE fused kernel call: the flash-decode kernel
+        streams the frozen main cache at each row's own length and then
+        attends the side buffer's live positions as a trailing grid step
+        of the SAME online softmax (``flash_decode(side_k=...)``) — the
+        separate dense side attend + explicit log-sum-exp merge this
+        method used through round 4 measured +0.15–0.2 ms/step on the
+        8-layer 8k bench model."""
         cfg = self.cfg
         b = q.shape[0]
         cap = self.serve_side_slots
@@ -407,35 +416,10 @@ class CausalSelfAttention(nn.Module):
 
         from tpudist.ops.flash_decode import flash_decode
 
-        main_len = idx_var.value                       # [B], frozen
-        out_m, lse_m = flash_decode(
-            q, cached_k.value, cached_v.value, main_len, return_lse=True)
-
-        # dense attend over the tiny side buffer (positions <= s_at are
-        # live this step), with its own log-sum-exp for the merge.
-        # repeat_kv on the SIDE buffer only (cap tokens, not the 8k
-        # cache); a GQA-grouped einsum variant avoiding the repeat was
-        # measured SLOWER in situ (1.07 vs 0.78 ms/step — the tiny
-        # [B, Hkv, g, ·] layouts tile poorly), so the simple form stays
-        k_rep, v_rep = repeat_kv(q, side_k.value, side_v.value)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
-            k_rep.astype(jnp.float32)) * (d ** -0.5)   # [B, H, 1, cap]
-        mask = (jnp.arange(cap) <= s_at)[None, None, None, :]
-        scores = jnp.where(mask, scores, -jnp.inf)
-        m_s = jnp.max(scores, axis=-1, keepdims=True)
-        p = jnp.exp(scores - m_s)
-        l_s = jnp.sum(p, axis=-1, keepdims=True)
-        out_s = jnp.einsum(
-            "bhqk,bkhd->bqhd", p / l_s, v_rep.astype(jnp.float32))
-        lse_s = (m_s + jnp.log(l_s))[:, :, 0, 0]       # [B, H]
-
-        # log-sum-exp merge (the sp_flash_decode rule)
-        lse_max = jnp.maximum(lse_m, lse_s)
-        w_m = jnp.exp(lse_m - lse_max)[:, None, :, None]
-        w_s = jnp.exp(lse_s - lse_max)[:, None, :, None]
-        out = (out_m.astype(jnp.float32) * w_m + out_s * w_s) / (w_m + w_s)
-        return out.astype(q.dtype)
+        return flash_decode(
+            q, cached_k.value, cached_v.value, idx_var.value,
+            side_k=side_k.value, side_v=side_v.value,
+            side_len=side_idx.value)
 
     def _prefill_attend(self, q, k_all, v_all, idx):
         """Chunk prefill: queries at global positions [idx, idx+s) attend

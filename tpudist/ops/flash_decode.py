@@ -52,18 +52,31 @@ _NEG_BIG = -1e30
 def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
                    block_k: int, num_kb: int, window: int | None,
                    with_lse: bool, quant: bool,
-                   rows_per_batch: int | None = None):
+                   rows_per_batch: int | None = None,
+                   paired_q: bool = False,
+                   side: bool = False):
     """Online-softmax decode over one (batch·kv-head) row of the cache.
 
     ``meta_ref`` is the scalar-prefetch vector ``[cache_len, offset,
     start_block]`` — or, with ``rows_per_batch`` set (per-row lengths),
-    ``[0, offset, start_block, len_0, ..., len_{B-1}]``: ``offset`` is
-    this shard's global cache start (sequence-parallel decode; 0 for the
-    whole-cache case), and ``start_block`` trims the K grid to the
-    sliding window — with ``window`` the grid runs only the
+    ``[side_len, offset, start_block, len_0, ..., len_{B-1}]``:
+    ``offset`` is this shard's global cache start (sequence-parallel
+    decode; 0 for the whole-cache case), and ``start_block`` trims the K
+    grid to the sliding window — with ``window`` the grid runs only the
     ~``window/block_k`` blocks that intersect it, so a windowed decode
     STREAMS ~``window`` positions instead of the whole cache (bandwidth
     is the decode bound).
+
+    ``paired_q``: the head-paired layout's block-diagonal query tile is
+    built IN VMEM from the two natural [gp, d] halves (a couple of
+    concatenates against a zero tile) instead of being scattered into an
+    HBM array by XLA every decode step — the per-step packing cost the
+    round-4 verdict measured as the d=64 model-level residual.
+
+    ``side``: one extra trailing grid step attends over a small side
+    buffer (the continuous-batching segment-local K/V staging) with
+    ``meta[0]`` live positions — folding the serve loop's side attention
+    and its log-sum-exp merge into this kernel's own online softmax.
 
     ``quant``: K/V tiles are int8 with per-token scales riding the LANE
     axis ([1, bk] blocks — a [bk, 1] layout would pad every scale to a
@@ -76,6 +89,9 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
     else:
         v_ref = rest[0]
         rest = rest[1:]
+    if side:
+        sk_ref, sv_ref = rest[:2]
+        rest = rest[2:]
     if with_lse:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -85,9 +101,9 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
         cache_len = meta_ref[0]
     else:
         # per-row lengths (the continuous-batching serve loop: every
-        # cache row decodes at its own position): meta carries [_, off,
-        # start, len_0..len_{B-1}] and grid row g belongs to batch row
-        # g // rows_per_batch
+        # cache row decodes at its own position): meta carries
+        # [side_len, off, start, len_0..len_{B-1}] and grid row g
+        # belongs to batch row g // rows_per_batch
         cache_len = meta_ref[3 + pl.program_id(0) // rows_per_batch]
     offset = meta_ref[1]
     kb_idx = meta_ref[2] + kj  # grid step kj streams cache block kb_idx
@@ -98,9 +114,51 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
+    def q_tile():
+        if not paired_q:
+            return q_ref[0]                          # [gp, D]
+        # block-diagonal [2gp, 2d] from the two [gp, d] members: rows
+        # [0, gp) carry member 0's queries in lanes [0, d), rows
+        # [gp, 2gp) member 1's in lanes [d, 2d) — the zero half
+        # annihilates the other member in the single 2d contraction
+        q0, q1 = q_ref[0, 0], q_ref[0, 1]
+        z = jnp.zeros_like(q0)
+        return jnp.concatenate(
+            [jnp.concatenate([q0, z], axis=1),
+             jnp.concatenate([z, q1], axis=1)], axis=0)
+
+    def _accum(s, pv_scale, vb):
+        """One online-softmax rank update from masked scores ``s`` and
+        the value tile ``vb`` (``pv_scale`` folds per-token V scales
+        into the probability rows; None for the bf16 path)."""
+        m = m_scr[:]
+        new_m = jnp.maximum(m, jnp.maximum(
+            jnp.max(s, axis=-1, keepdims=True), _NEG_BIG))
+        p = jnp.exp(s - new_m)
+        corr = jnp.exp(m - new_m)
+        m_scr[:] = new_m
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if pv_scale is not None:
+            vs = pv_scale                            # [rows, bk]
+            if vs.shape[0] == 2:
+                # half m's output lands in member m's lane half (sliced
+                # out at unpack), so folding member m's V scale into
+                # half-m probability rows is exact
+                half = p.shape[0] // 2
+                pv32 = (p.reshape(2, half, p.shape[1])
+                        * vs[:, None, :]).reshape(p.shape)
+            else:
+                pv32 = p * vs
+            pv = pv32.astype(jnp.bfloat16)
+        else:
+            pv = p.astype(vb.dtype)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            pv, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     @pl.when(offset + kb_idx * block_k < cache_len)
     def _compute():
-        q = q_ref[0]                                 # [gp, D]
+        q = q_tile()
         if quant:
             kb = k_ref[0].astype(jnp.bfloat16)       # int8 fits exactly
             s = jax.lax.dot_general(
@@ -126,32 +184,22 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
         if window is not None:
             keep = jnp.logical_and(keep, k_pos >= cache_len - window)
         s = jnp.where(keep, s, -jnp.inf)
-        m = m_scr[:]
-        new_m = jnp.maximum(m, jnp.maximum(
-            jnp.max(s, axis=-1, keepdims=True), _NEG_BIG))
-        p = jnp.exp(s - new_m)
-        corr = jnp.exp(m - new_m)
-        m_scr[:] = new_m
-        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        if quant:
-            vs = vs_ref[0]                           # [rows, bk]
-            if vs.shape[0] == 2:
-                # half m's output lands in member m's lane half (sliced
-                # out at unpack), so folding member m's V scale into
-                # half-m probability rows is exact
-                half = p.shape[0] // 2
-                pv32 = (p.reshape(2, half, p.shape[1])
-                        * vs[:, None, :]).reshape(p.shape)
-            else:
-                pv32 = p * vs
-            pv = pv32.astype(jnp.bfloat16)
-            vb = v_ref[0].astype(jnp.bfloat16)
-        else:
-            vb = v_ref[0]
-            pv = p.astype(vb.dtype)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            pv, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _accum(s, vs_ref[0] if quant else None, (
+            v_ref[0].astype(jnp.bfloat16) if quant else v_ref[0]))
+
+    if side:
+        # the side buffer rides the LAST main grid step (an extra
+        # sequential step measured +17 µs — pipeline bubbles at the
+        # boundary of every grid row; folded here it is one more rank
+        # update on tiles that are already resident)
+        @pl.when(kj == num_kb - 1)
+        def _side():
+            s = jax.lax.dot_general(
+                q_tile(), sk_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < meta_ref[0], s, -jnp.inf)
+            _accum(s, None, sv_ref[0])
 
     @pl.when(kj == num_kb - 1)
     def _finalize():
@@ -202,6 +250,9 @@ def flash_decode(
     interpret: bool | None = None,
     pos_offset: jnp.ndarray | int = 0,
     return_lse: bool = False,
+    side_k: jnp.ndarray | None = None,
+    side_v: jnp.ndarray | None = None,
+    side_len: jnp.ndarray | int = 0,
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """One decode step of attention.
 
@@ -214,27 +265,37 @@ def flash_decode(
         token (the flax ``cache_index + 1``); may be traced.  With
         ``pos_offset`` it stays GLOBAL: this buffer's slot ``j`` holds
         global position ``pos_offset + j`` (the sequence-parallel shard
-        layout); validity and windowing are evaluated globally.
+        layout); validity and windowing are evaluated globally.  A
+        VECTOR ``[B]`` selects per-row lengths (the continuous-batching
+        serve path; row ``r`` attends over its own first ``len_r``
+        slots).
       window: sliding-window width (attend to the last ``window``
         positions only), matching :func:`tpudist.models.sdpa` semantics.
       return_lse: also return the per-head log-sum-exp ``[B, H]`` — the
         merge key for combining partial attention across cache shards
         (:func:`sp_flash_decode`).
+      side_k / side_v: optional ``[B, cap, H_kv, D]`` side buffers (the
+        serve loop's segment-local K/V staging); the first ``side_len``
+        positions are attended AFTER the main cache in the same online
+        softmax — no separate attend, no log-sum-exp merge.  Requires
+        per-row ``cache_len`` and ``window=None``.
 
     Returns ``[B, 1, H, D]`` (plus ``[B, H]`` lse when requested).
     """
     return _flash_decode_impl(
         q, k_cache, None, v_cache, None, cache_len, window=window,
         block_k=block_k, interpret=interpret, pos_offset=pos_offset,
-        return_lse=return_lse)
+        return_lse=return_lse, side_k=side_k, side_v=side_v,
+        side_len=side_len)
 
 
 def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
                        *, window, block_k, interpret, pos_offset,
-                       return_lse):
+                       return_lse, side_k=None, side_v=None, side_len=0):
     """Shared wrapper for the bf16 and int8 cache paths (``k_scale`` /
     ``v_scale`` None selects bf16)."""
     quant = k_scale is not None
+    side = side_k is not None
     b, s_q, h, d = q.shape
     assert s_q == 1, "flash_decode consumes one query token"
     s, h_kv = k_cache.shape[1], k_cache.shape[2]
@@ -257,6 +318,24 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         raise ValueError(
             f"per-row cache_len has {cache_len.shape[0]} entries for "
             f"batch {b}")
+    if side:
+        if quant:
+            raise ValueError("side buffers compose with the bf16 cache "
+                             "path only")
+        if not per_row or window is not None:
+            raise ValueError(
+                "side buffers require per-row cache_len and window=None "
+                "(the continuous-batching serve configuration)")
+        # pad the side capacity to the 8-row sublane tile; side_len masks
+        # the padding rows
+        cap = side_k.shape[1]
+        capp = max(8, -(-cap // 8) * 8)
+        if capp != cap:
+            pad = ((0, 0), (0, capp - cap), (0, 0), (0, 0))
+            side_k = jnp.pad(side_k, pad)
+            side_v = jnp.pad(side_v, pad)
+        side_k = side_k.astype(k_cache.dtype)
+        side_v = side_v.astype(v_cache.dtype)
     offset = jnp.asarray(pos_offset, jnp.int32)
     if window is None:
         nb = num_kb_full
@@ -270,7 +349,8 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
             (cache_len - window - offset) // block_k, 0, num_kb_full - nb)
     if per_row:
         meta = jnp.concatenate(
-            [jnp.stack([jnp.int32(0), offset, start_block]), cache_len])
+            [jnp.stack([jnp.asarray(side_len, jnp.int32), offset,
+                        start_block]), cache_len])
     else:
         meta = jnp.stack([cache_len, offset, start_block])
 
@@ -298,25 +378,37 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     q4 = q.reshape(b, h_kv, g, d)                    # [B, Hkv, g, d]
     q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
     if paired:
+        # the block-diagonal query tile is built INSIDE the kernel
+        # (paired_q) from this natural [·, 2, gp, d] layout — building it
+        # here cost an HBM zeros + two scatters EVERY decode step, the
+        # measured model-level residual of the paired path (round-4
+        # verdict #8); in VMEM it is two concatenates against a zero tile
         n_rows, kv_rows, d_eff = 2 * gp, h_kv // 2, 2 * d
-        q4 = q4.reshape(b, kv_rows, 2, gp, d)
-        qbd = jnp.zeros((b, kv_rows, 2, gp, 2, d), q.dtype)
-        qbd = qbd.at[:, :, 0, :, 0].set(q4[:, :, 0])
-        qbd = qbd.at[:, :, 1, :, 1].set(q4[:, :, 1])
-        q3 = qbd.reshape(b * kv_rows, n_rows, d_eff)
+        q3 = q4.reshape(b * kv_rows, 2, gp, d)
         k3 = k_cache.reshape(b, s, kv_rows, d_eff).swapaxes(1, 2).reshape(
             b * kv_rows, s, d_eff)
         v3 = v_cache.reshape(b, s, kv_rows, d_eff).swapaxes(1, 2).reshape(
             b * kv_rows, s, d_eff)
+        if side:
+            side_k = side_k.reshape(
+                b, capp, kv_rows, d_eff).swapaxes(1, 2).reshape(
+                b * kv_rows, capp, d_eff)
+            side_v = side_v.reshape(
+                b, capp, kv_rows, d_eff).swapaxes(1, 2).reshape(
+                b * kv_rows, capp, d_eff)
         gp, h_kv, d = n_rows, kv_rows, d_eff
     else:
         q3 = q4.reshape(b * h_kv, gp, d)
         k3 = k_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
         v3 = v_cache.swapaxes(1, 2).reshape(b * h_kv, s, d)
+        if side:
+            side_k = side_k.swapaxes(1, 2).reshape(b * h_kv, capp, d)
+            side_v = side_v.swapaxes(1, 2).reshape(b * h_kv, capp, d)
 
     # index maps see the prefetched meta first: grid step j streams cache
     # block meta[2] + j
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j, m: (g_, m[2] + j, 0))
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda g_, j, m: (g_, m[2] + j, 0))
     # scales as [B·Hkv, rows, S] (rows = 2 pair members when paired, else
     # 1): the sequence dim rides the LANE axis so a block is a dense
     # [rows, block_k] row set, not a strided column (measured 2× on the
@@ -330,11 +422,13 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         flat = sc[..., 0].swapaxes(1, 2)          # [B, Hkv_orig, S]
         return flat.reshape(b * h_kv, sc_rows, s)
 
+    if paired:
+        q_spec = pl.BlockSpec((1, 2, gp // 2, d // 2),
+                              lambda g_, j, m: (g_, 0, 0, 0))
+    else:
+        q_spec = pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))
     args = [meta, q3, k3]
-    in_specs = [
-        pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0)),
-        kv_spec,
-    ]
+    in_specs = [q_spec, kv_spec]
     if quant:
         args.append(pack_scale(k_scale))
         in_specs.append(sc_spec)
@@ -343,6 +437,10 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     if quant:
         args.append(pack_scale(v_scale))
         in_specs.append(sc_spec)
+    if side:
+        side_spec = pl.BlockSpec((1, capp, d), lambda g_, j, m: (g_, 0, 0))
+        args += [side_k, side_v]
+        in_specs += [side_spec, side_spec]
 
     out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
@@ -357,7 +455,8 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
             num_kb=nb, window=window, with_lse=return_lse,
             quant=quant,
             # h_kv here is POST-pairing: grid row g -> batch g // h_kv
-            rows_per_batch=h_kv if per_row else None),
+            rows_per_batch=h_kv if per_row else None,
+            paired_q=paired, side=side),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * h_kv, nb),

@@ -76,6 +76,8 @@ def launch(
     restart_cooldown: tuple[float, float] | float | None = None,
     discover_cmd: str | None = None,
     elastic_inprocess: bool = False,
+    blacklist_after: int | None = None,
+    blacklist_cooldown: tuple[float, float] | float | None = None,
 ) -> int:
     """Run ``cmd`` as an ``nprocs``-process gang; returns the gang's exit
     code (0 only if every worker of some attempt exited 0).
@@ -91,7 +93,18 @@ def launch(
       (an integer) sets the next world size, clamped to
       ``[min_nprocs or 1, nprocs]`` (≙ ``--host-discovery-script``).
     * ``restart_cooldown`` — seconds (or a ``(lo, hi)`` range sampled
-      uniformly) to wait before each restart (≙ the blacklist cooldown).
+      uniformly) to wait before each restart.
+    * ``blacklist_after`` — PER-WORKER blacklist (Horovod's actual
+      per-host semantics: the SPECIFIC failing host is excluded, healthy
+      ones keep their place).  Every spawn slot carries a stable
+      ``TPUDIST_SPAWN_ID`` across attempts; a slot whose worker exits
+      nonzero in ``blacklist_after`` attempts is excluded from the roster
+      and a FRESH spawn id re-grows the world (≙ a replacement host from
+      discovery), while healthy slots are never the ones dropped.
+    * ``blacklist_cooldown`` — seconds (or ``(lo, hi)`` sampled) until a
+      blacklisted slot may rejoin the roster with its failure count reset
+      (``--blacklist-cooldown-range``); ``None`` = excluded for the rest
+      of this launch.
     * ``elastic_inprocess`` — a dying worker does NOT tear the gang down:
       survivors are expected to detect the loss themselves via coordination-
       service TTL heartbeats and re-rendezvous smaller in-process
@@ -102,6 +115,9 @@ def launch(
     if min_nprocs is not None and min_nprocs > nprocs:
         raise ValueError(
             f"min_nprocs ({min_nprocs}) must not exceed nprocs ({nprocs})")
+    if blacklist_after is not None and blacklist_after < 1:
+        raise ValueError(
+            f"blacklist_after must be >= 1, got {blacklist_after}")
     server = None
     base_env = dict(os.environ)
     # Workers must resolve the same tpudist the launcher runs from, however
@@ -123,18 +139,52 @@ def launch(
 
     world = nprocs
     floor = max(1, min_nprocs) if min_nprocs else None
+
+    def _sample(cool):
+        lo, hi = cool if isinstance(cool, tuple) else (cool,) * 2
+        return random.uniform(lo, hi)
+
+    # per-spawn-slot failure ledger (blacklist_after mode): slots carry a
+    # stable spawn id across attempts; repeat offenders are excluded and
+    # replaced by FRESH ids so healthy workers keep their place
+    roster = list(range(nprocs))
+    next_sid = nprocs
+    fail_counts: dict[int, int] = {}
+    black_until: dict[int, float] = {}
     try:
         for attempt in range(max_restarts + 1):
             if attempt > 0:
                 if restart_cooldown is not None:
-                    lo, hi = (restart_cooldown if isinstance(
-                        restart_cooldown, tuple) else (restart_cooldown,) * 2)
-                    time.sleep(random.uniform(lo, hi))
+                    time.sleep(_sample(restart_cooldown))
                 if discover_cmd is not None:
                     world = _discover_world_size(
                         discover_cmd, world, floor or 1, nprocs)
-                elif floor is not None:
+                elif floor is not None and blacklist_after is None:
                     world = max(floor, world - 1)
+                if blacklist_after is not None:
+                    now = time.monotonic()
+                    for sid, until in list(black_until.items()):
+                        if until <= now:   # cooled down: eligible again
+                            del black_until[sid]
+                            fail_counts.pop(sid, None)
+                            roster.append(sid)
+                    for sid in list(roster):
+                        if fail_counts.get(sid, 0) >= blacklist_after:
+                            black_until[sid] = (
+                                now + _sample(blacklist_cooldown)
+                                if blacklist_cooldown is not None
+                                else float("inf"))
+                            roster.remove(sid)
+                            log.warning(
+                                "spawn id %d blacklisted after %d failed "
+                                "attempts%s", sid, fail_counts[sid],
+                                "" if blacklist_cooldown is None else
+                                f" (cooldown until +{black_until[sid] - now:.1f}s)")
+                    while len(roster) < world:
+                        roster.append(next_sid)   # fresh replacement slot
+                        next_sid += 1
+            ids = (roster[:world] if blacklist_after is not None
+                   else list(range(world)))
             coordinator = f"127.0.0.1:{_free_port()}"
             procs: list[subprocess.Popen] = []
             for rank in range(world):
@@ -144,6 +194,7 @@ def launch(
                     "TPUDIST_NUM_PROCESSES": str(world),
                     "TPUDIST_PROCESS_ID": str(rank),
                     "TPUDIST_LOCAL_RANK": str(rank),
+                    "TPUDIST_SPAWN_ID": str(ids[rank]),
                     "TPUDIST_RESTART_ATTEMPT": str(attempt),
                 })
                 if platform:
@@ -159,6 +210,14 @@ def launch(
                         wenv["XLA_FLAGS"] = " ".join(flags)
                 procs.append(subprocess.Popen(cmd, env=wenv))
             codes = _supervise(procs, tear_down=not elastic_inprocess)
+            if blacklist_after is not None:
+                # charge the ACTUAL failers, not supervisor-terminated
+                # survivors (they exit -SIGTERM; a straggler escalated to
+                # SIGKILL is indistinguishable from a kill -9 death and is
+                # charged — acceptable: it failed to exit cleanly)
+                for sid, code in zip(ids, codes):
+                    if code not in (0, -signal.SIGTERM):
+                        fail_counts[sid] = fail_counts.get(sid, 0) + 1
             if elastic_inprocess:
                 if sum(c == 0 for c in codes) >= (floor or 1):
                     return 0
@@ -228,8 +287,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="shrink the gang toward this floor on repeated "
                          "failure (horovodrun --min-np semantics)")
     ap.add_argument("--restart-cooldown", default=None,
-                    help="seconds before each restart, or LO:HI range "
-                         "(horovodrun --blacklist-cooldown-range)")
+                    help="seconds before each restart, or LO:HI range")
+    ap.add_argument("--blacklist-after", type=int, default=None,
+                    help="exclude a spawn slot after this many failed "
+                         "attempts, re-growing the world with a fresh "
+                         "slot (per-host blacklist semantics)")
+    ap.add_argument("--blacklist-cooldown", default=None,
+                    help="seconds (or LO:HI range) until a blacklisted "
+                         "slot may rejoin (horovodrun "
+                         "--blacklist-cooldown-range); default: excluded "
+                         "for the rest of the run")
     ap.add_argument("--discover-cmd", default=None,
                     help="shell command printing the next world size "
                          "(horovodrun --host-discovery-script)")
@@ -247,19 +314,22 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("missing worker command")
     if cmd[0].endswith(".py"):
         cmd = [sys.executable, *cmd]
-    cooldown = None
-    if args.restart_cooldown is not None:
+    def parse_cooldown(value, flag):
+        if value is None:
+            return None
         try:
-            parts = [float(v) for v in str(args.restart_cooldown).split(":")]
+            parts = [float(v) for v in str(value).split(":")]
         except ValueError:
-            ap.error(f"--restart-cooldown must be SECONDS or LO:HI, got "
-                     f"{args.restart_cooldown!r}")
+            ap.error(f"{flag} must be SECONDS or LO:HI, got {value!r}")
         if len(parts) > 2:
-            ap.error(f"--restart-cooldown must be SECONDS or LO:HI, got "
-                     f"{args.restart_cooldown!r}")
+            ap.error(f"{flag} must be SECONDS or LO:HI, got {value!r}")
         if any(p < 0 for p in parts):
-            ap.error("--restart-cooldown values must be non-negative")
-        cooldown = (parts[0], parts[1]) if len(parts) == 2 else parts[0]
+            ap.error(f"{flag} values must be non-negative")
+        return (parts[0], parts[1]) if len(parts) == 2 else parts[0]
+
+    cooldown = parse_cooldown(args.restart_cooldown, "--restart-cooldown")
+    bl_cooldown = parse_cooldown(args.blacklist_cooldown,
+                                 "--blacklist-cooldown")
     if args.min_nprocs is not None and args.min_nprocs > args.nprocs:
         ap.error(f"--min-nprocs ({args.min_nprocs}) must not exceed "
                  f"-n ({args.nprocs})")
@@ -269,6 +339,8 @@ def main(argv: list[str] | None = None) -> int:
         coord_server=not args.no_coord, min_nprocs=args.min_nprocs,
         restart_cooldown=cooldown, discover_cmd=args.discover_cmd,
         elastic_inprocess=args.elastic_inprocess,
+        blacklist_after=args.blacklist_after,
+        blacklist_cooldown=bl_cooldown,
     )
 
 
