@@ -34,7 +34,7 @@ class TestPerWorkerBlacklist:
         rc = launch(
             [sys.executable, FLAKY], nprocs=3, max_restarts=3,
             blacklist_after=2, coord_server=False,
-            env={"WORKER_OUT_DIR": str(tmp_path),
+            env={"PYTHONPATH": "", "WORKER_OUT_DIR": str(tmp_path),
                  "WORKER_FAIL_SPAWN_IDS": "1"},
         )
         assert rc == 0
@@ -56,7 +56,7 @@ class TestPerWorkerBlacklist:
         rc = launch(
             [sys.executable, FLAKY], nprocs=2, max_restarts=1,
             blacklist_after=1, coord_server=False,
-            env={"WORKER_OUT_DIR": str(tmp_path),
+            env={"PYTHONPATH": "", "WORKER_OUT_DIR": str(tmp_path),
                  "WORKER_FAIL_SPAWN_IDS": "1"},
         )
         assert rc == 0
@@ -75,7 +75,7 @@ class TestPerWorkerBlacklist:
         rc = launch(
             [sys.executable, FLAKY], nprocs=2, max_restarts=2,
             blacklist_after=1, blacklist_cooldown=0.0, coord_server=False,
-            env={"WORKER_OUT_DIR": str(tmp_path),
+            env={"PYTHONPATH": "", "WORKER_OUT_DIR": str(tmp_path),
                  "WORKER_FAIL_SPAWN_IDS": "1,2"},   # fresh sid 2 bad too
         )
         assert rc != 0

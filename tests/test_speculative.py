@@ -397,6 +397,26 @@ class TestAdaptiveDraftPolicy:
         assert abs(pol.round_cost(8) - 1.8) < 1e-9
         assert pol.round_cost(2) == 1.2   # observed points stay exact
 
+    def test_plain_cost_is_ema_smoothed_once_armed(self):
+        from tpudist.models.speculative import AdaptiveDraftPolicy
+
+        pol = AdaptiveDraftPolicy(ladder=(2, 4), ema=0.5)
+        pol.set_plain_cost(0.1)
+        assert pol._plain_tok_s == pytest.approx(0.1)
+        pol.set_plain_cost(0.3)   # one noisy timing: damped, not adopted
+        assert pol._plain_tok_s == pytest.approx(0.2)
+
+    def test_best_k_allow_plain_false_bypasses_gate(self):
+        from tpudist.models.speculative import AdaptiveDraftPolicy
+
+        pol = AdaptiveDraftPolicy(ladder=(2, 4, 8, 16))
+        for k in (2, 4, 8, 16):
+            pol.observe_round_cost(k, 1.0)
+        pol.set_plain_cost(0.1)
+        assert pol.best_k(0.05, batch=4) == 0
+        # the re-probe path must still get a real ladder K
+        assert pol.best_k(0.05, batch=4, allow_plain=False) in (2, 4, 8, 16)
+
     def test_break_even_gate_falls_back_to_plain(self):
         from tpudist.models.speculative import AdaptiveDraftPolicy
 
@@ -437,10 +457,9 @@ class TestAdaptiveDraftPolicy:
         assert stats["acceptance"][-1] < 0.3
 
     def test_plain_probe_arms_gate_and_stays_exact(self):
-        """probe_plain (default): segments 2-3 run the plain rollout —
-        the second arms the break-even gate — and with a hopeless draft
-        the armed gate keeps every later segment on plain decode, all
-        while the output still bit-matches plain greedy."""
+        """probe_plain (default): segment 2 runs the plain rollout as a
+        probe (compile + same-input re-timed run arms the break-even
+        gate), all while the output still bit-matches plain greedy."""
         from tpudist.models.speculative import (
             AdaptiveDraftPolicy,
             adaptive_speculative_generate,
@@ -456,10 +475,31 @@ class TestAdaptiveDraftPolicy:
             segment_tokens=8, return_stats=True)
         want = greedy_generate(TARGET_CFG, t_params, prompt, 48)
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(want))
-        assert stats["ks"][1] == 0 and stats["ks"][2] == 0  # the probe
+        assert stats["ks"][1] == 0                          # the probe
         assert pol._plain_tok_s is not None                 # gate armed
         # CPU timing noise decides later segments' K; exactness and the
         # armed gate are the invariants this test pins
+
+    def test_probe_arms_even_when_final_segment_truncates(self):
+        """Review repro: max_new=20 / segment_tokens=8 gives lengths
+        8, 8, 4 — the probe segment's re-timed same-length run must arm
+        the gate even though no two PLAIN segments share a length."""
+        from tpudist.models.speculative import (
+            AdaptiveDraftPolicy,
+            adaptive_speculative_generate,
+        )
+
+        t_params = _make(TARGET_CFG, 0)
+        d_params = _make(DRAFT_CFG, 1)
+        prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, 64)
+        pol = AdaptiveDraftPolicy(ladder=(2, 8),
+                                  initial_acceptance=0.97)
+        toks, stats = adaptive_speculative_generate(
+            TARGET_CFG, t_params, DRAFT_CFG, d_params, prompt, 20, pol,
+            segment_tokens=8, return_stats=True)
+        want = greedy_generate(TARGET_CFG, t_params, prompt, 20)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(want))
+        assert pol._plain_tok_s is not None
 
     def test_validation(self):
         from tpudist.models.speculative import (

@@ -707,9 +707,15 @@ class AdaptiveDraftPolicy:
             else self.ema * seconds_per_round + (1 - self.ema) * prev)
 
     def set_plain_cost(self, seconds_per_token: float) -> None:
-        """Arm the break-even gate with the measured plain-decode cost."""
-        if seconds_per_token > 0:
-            self._plain_tok_s = float(seconds_per_token)
+        """Arm the break-even gate with the measured plain-decode cost
+        (EMA-smoothed once armed, like the per-K round costs — one noisy
+        timing must not flip the gate wholesale)."""
+        if seconds_per_token <= 0:
+            return
+        prev = self._plain_tok_s
+        self._plain_tok_s = (
+            float(seconds_per_token) if prev is None
+            else self.ema * float(seconds_per_token) + (1 - self.ema) * prev)
 
     @property
     def calibrated(self) -> bool:
@@ -739,12 +745,16 @@ class AdaptiveDraftPolicy:
             return c0 * (k * self.r + 1.0) / (k0 * self.r + 1.0)
         return k * self.r + 1.0
 
-    def best_k(self, a: float | None = None, batch: int = 1) -> int:
+    def best_k(self, a: float | None = None, batch: int = 1,
+               allow_plain: bool = True) -> int:
         """The ladder K maximizing expected tokens per unit cost at
         acceptance ``a`` (default: the policy's running estimate) —
         or ``0``, meaning "fall back to plain decode", when the break-
         even gate is armed (measured costs + plain cost known) and even
-        the best K's predicted tokens/sec loses to the plain rollout."""
+        the best K's predicted tokens/sec loses to the plain rollout.
+        ``allow_plain=False`` bypasses the gate (the adaptive driver's
+        periodic re-probe: a plain-locked policy would otherwise never
+        see acceptance recover)."""
         a = self.acceptance if a is None else a
 
         def rate(k):
@@ -752,7 +762,7 @@ class AdaptiveDraftPolicy:
                     / self.round_cost(k))
 
         k_star = max(self.ladder, key=rate)
-        if self.calibrated and self._plain_tok_s is not None:
+        if allow_plain and self.calibrated and self._plain_tok_s is not None:
             if rate(k_star) <= 1.0 / self._plain_tok_s:
                 return 0
         return k_star
@@ -796,6 +806,7 @@ def adaptive_speculative_generate(
     return_stats: bool = False,
     auto_unstack: bool = True,
     probe_plain: bool = True,
+    reprobe_every: int = 4,
 ):
     """Speculative decoding with ``num_draft`` ADAPTED to measured
     acceptance, in segments.
@@ -818,14 +829,16 @@ def adaptive_speculative_generate(
     Segment wall times feed the policy's MEASURED cost model (each K's
     first segment is skipped — it contains the compile), so the K choice
     adapts to realized hardware costs, not the analytic prior.  With
-    ``probe_plain`` (default), segments 2 and 3 run the PLAIN rollout —
-    the first carries its compile, the second's timing arms the policy's
-    break-even gate — after which any segment where even the best K's
-    predicted rate loses to plain decode runs the plain rollout instead
-    (the "never worse than plain" guarantee costs two early plain
-    segments; pass ``probe_plain=False`` to skip the probe and arm the
-    gate manually via ``policy.set_plain_cost``).  Exactness is
-    untouched either way: both continuations are exact samples.
+    ``probe_plain`` (default), segment 2 runs the PLAIN rollout as a
+    probe — its first call carries the compile, and a same-input re-run
+    of the compiled executable supplies the clean timing that arms the
+    policy's break-even gate — after which any segment where even the
+    best K's predicted rate loses to plain decode runs the plain rollout
+    instead (the "never worse than plain" guarantee costs ~two plain
+    segments' device time once; pass ``probe_plain=False`` to skip the
+    probe and arm the gate manually via ``policy.set_plain_cost``).
+    Exactness is untouched either way: both continuations are exact
+    samples.
 
     Returns tokens ``[B, prompt_len + max_new_tokens]`` (and, with
     ``return_stats``, a dict with per-segment ``ks`` (0 = plain
@@ -850,12 +863,20 @@ def adaptive_speculative_generate(
     # its compile time into the measured cost model
     uses: dict[tuple[int, int], int] = {}
     seg_i = 0
+    plain_streak = 0
     while remaining > 0:
         n = min(segment_tokens, remaining)
         k_seg = policy.best_k(batch=batch)
-        if (probe_plain and policy._plain_tok_s is None
-                and seg_i in (1, 2)):
-            k_seg = 0   # plain probe: compile (seg 1), then arm (seg 2)
+        if probe_plain and policy._plain_tok_s is None and seg_i == 1:
+            k_seg = 0   # the plain probe segment (arms the gate below)
+        elif (k_seg == 0 and reprobe_every > 0
+                and plain_streak >= reprobe_every):
+            # plain segments observe no acceptance, so a gate-locked
+            # policy would never notice the draft recovering — re-probe
+            # speculation periodically (one spec segment per
+            # ``reprobe_every`` plain ones, bounded cost)
+            k_seg = policy.best_k(batch=batch, allow_plain=False)
+        plain_streak = plain_streak + 1 if k_seg == 0 else 0
         key, seg_key = jax.random.split(key)
         t0 = _time.perf_counter()
         if k_seg == 0:
@@ -864,23 +885,35 @@ def adaptive_speculative_generate(
                 greedy_generate, sample_generate,
             )
 
-            if temperature > 0:
-                toks = sample_generate(
-                    target_cfg, target_params, toks, n, key=seg_key,
-                    temperature=temperature, top_k=top_k, top_p=top_p,
+            def plain_call(t):
+                if temperature > 0:
+                    return sample_generate(
+                        target_cfg, target_params, t, n, key=seg_key,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        decode_attention=decode_attention,
+                        prefill_chunk=prefill_chunk,
+                        auto_unstack=auto_unstack)
+                return greedy_generate(
+                    target_cfg, target_params, t, n,
                     decode_attention=decode_attention,
                     prefill_chunk=prefill_chunk,
                     auto_unstack=auto_unstack)
-            else:
-                toks = greedy_generate(
-                    target_cfg, target_params, toks, n,
-                    decode_attention=decode_attention,
-                    prefill_chunk=prefill_chunk,
-                    auto_unstack=auto_unstack)
+
+            toks_in = toks
+            toks = plain_call(toks_in)
             jax.block_until_ready(toks)
             dt = _time.perf_counter() - t0
             if uses.get((0, n), 0) >= 1:   # first call holds the compile
                 policy.set_plain_cost(dt / n)
+            elif policy._plain_tok_s is None:
+                # first plain call at this length carried the compile —
+                # re-run the now-compiled executable on the SAME input
+                # (output discarded) so the gate arms in ONE probe
+                # segment regardless of segment-length truncation
+                t1 = _time.perf_counter()
+                jax.block_until_ready(plain_call(toks_in))
+                policy.set_plain_cost(
+                    (_time.perf_counter() - t1) / n)
             stats = {"rounds": 0, "draft_accepted": 0}
         else:
             toks, stats = speculative_generate(
