@@ -40,6 +40,7 @@ no inference path anywhere); this kernel + the TP rollout in
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +93,9 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
     if side:
         sk_ref, sv_ref = rest[:2]
         rest = rest[2:]
+    if paired_q:
+        q_scr = rest[-1]
+        rest = rest[:-1]
     if with_lse:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -113,19 +117,23 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
         m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        if paired_q:
+            # block-diagonal [2gp, 2d] from the two [gp, d] members:
+            # rows [0, gp) carry member 0's queries in lanes [0, d),
+            # rows [gp, 2gp) member 1's in lanes [d, 2d) — the zero
+            # half annihilates the other member in the single 2d
+            # contraction.  Built ONCE per grid row into scratch: the
+            # lane-offset concatenates are not free under Mosaic, and
+            # rebuilding them every K step measured ~2x on the whole
+            # kernel at B=8
+            q0, q1 = q_ref[0, 0], q_ref[0, 1]
+            z = jnp.zeros_like(q0)
+            q_scr[:] = jnp.concatenate(
+                [jnp.concatenate([q0, z], axis=1),
+                 jnp.concatenate([z, q1], axis=1)], axis=0)
 
     def q_tile():
-        if not paired_q:
-            return q_ref[0]                          # [gp, D]
-        # block-diagonal [2gp, 2d] from the two [gp, d] members: rows
-        # [0, gp) carry member 0's queries in lanes [0, d), rows
-        # [gp, 2gp) member 1's in lanes [d, 2d) — the zero half
-        # annihilates the other member in the single 2d contraction
-        q0, q1 = q_ref[0, 0], q_ref[0, 1]
-        z = jnp.zeros_like(q0)
-        return jnp.concatenate(
-            [jnp.concatenate([q0, z], axis=1),
-             jnp.concatenate([z, q1], axis=1)], axis=0)
+        return q_scr[:] if paired_q else q_ref[0]    # [gp, D]
 
     def _accum(s, pv_scale, vb):
         """One online-softmax rank update from masked scores ``s`` and
@@ -204,7 +212,19 @@ def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,
     @pl.when(kj == num_kb - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        o = (acc_scr[:] / l).astype(o_ref.dtype)
+        if paired_q:
+            # UNPACK in kernel: member m's output lives in rows
+            # [m·gp, (m+1)·gp) × lanes [m·d, (m+1)·d) of the block-
+            # diagonal result — write each member's tile to its own
+            # [gp, d] output slot, so XLA sees the natural layout and
+            # pays no per-token lane-half slicing/stacking
+            half_r = o.shape[0] // 2
+            half_d = o.shape[1] // 2
+            o_ref[0, 0] = o[:half_r, :half_d]
+            o_ref[0, 1] = o[half_r:, half_d:]
+        else:
+            o_ref[0] = o
         if with_lse:
             # log-sum-exp of this shard's scores: the merge key for
             # sequence-parallel decode (out = Σ out_i·exp(lse_i − LSE))
@@ -374,7 +394,10 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     # lane half, so folding member m's scale into half-m score/prob rows
     # is exact.
     scale = d ** -0.5
-    paired = h_kv % 2 == 0 and d * 2 <= 128
+    # TPUDIST_DISABLE_HEAD_PAIRING: benchmarking/debug escape to measure
+    # the unpaired narrow-head path (normally strictly slower)
+    paired = (h_kv % 2 == 0 and d * 2 <= 128
+              and not os.environ.get("TPUDIST_DISABLE_HEAD_PAIRING"))
     q4 = q.reshape(b, h_kv, g, d)                    # [B, Hkv, g, d]
     q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
     if paired:
@@ -442,8 +465,14 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
         args += [side_k, side_v]
         in_specs += [side_spec, side_spec]
 
-    out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
+    if paired:
+        out_specs = [pl.BlockSpec((1, 2, gp // 2, d // 2),
+                                  lambda g_, j, m: (g_, 0, 0, 0))]
+        out_shape = [jax.ShapeDtypeStruct(
+            (b * h_kv, 2, gp // 2, d // 2), q.dtype)]
+    else:
+        out_specs = [pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))]
+        out_shape = [jax.ShapeDtypeStruct((b * h_kv, gp, d), q.dtype)]
     if return_lse:
         out_specs.append(
             pl.BlockSpec((1, 1, gp), lambda g_, j, m: (g_, 0, 0)))
@@ -466,7 +495,7 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
                 pltpu.VMEM((gp, 1), jnp.float32),
                 pltpu.VMEM((gp, 1), jnp.float32),
                 pltpu.VMEM((gp, d), jnp.float32),
-            ],
+            ] + ([pltpu.VMEM((gp, d), q.dtype)] if paired else []),
         ),
         out_shape=out_shape if return_lse else out_shape[0],
         compiler_params=pltpu.CompilerParams(
@@ -475,12 +504,12 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     )(*args)
     def unpack_out(out):
         if paired:
-            # [B·Hkv/2, 2gp, 2d'] -> per pair member, its own lane half
+            # the kernel already wrote each pair member's [gp, d'] tile
+            # to its own output slot — unpacking is a pure reshape +
+            # row slice, no lane-half gathers
             d0 = d // 2
-            o = out.reshape(b, h_kv, 2, gp // 2, 2, d0)
-            o = jnp.stack([o[:, :, 0, :, 0], o[:, :, 1, :, 1]], axis=2)
-            return o.reshape(b, h_kv * 2, gp // 2, d0)[:, :, :g].reshape(
-                b, 1, h, d0)
+            o = out.reshape(b, h_kv * 2, gp // 2, d0)
+            return o[:, :, :g].reshape(b, 1, h, d0)
         return out.reshape(b, h_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
 
     def unpack_lse(lse):

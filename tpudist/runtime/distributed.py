@@ -84,6 +84,14 @@ def initialize(
     (`mnist_ddp_elastic.py:26`).
     """
     global _initialized
+    # Elastic recovery = process restart + re-jit (SURVEY.md §5), so a
+    # restarted worker's compiles should be warm: honor an ambient
+    # persistent-cache directory (the launcher/test env exports it; the
+    # flag is harmless to set repeatedly).
+    if os.environ.get("TPUDIST_CACHE_DIR"):
+        from tpudist.runtime.cache import enable_compilation_cache
+
+        enable_compilation_cache()
     launcher = _launcher_env()
     if launcher is not None and coordinator_address is None:
         coordinator_address, num_processes, process_id = launcher
