@@ -930,6 +930,10 @@ def bench_serve_loop(on_tpu: bool) -> None:
     seg_s = decode_net / max(syncs["n"], 1)
     _emit("serve_loop_tokens_per_slot", round(net_slot_tps, 1),
           "tokens/sec/slot", round(net_slot_tps / fb_slot_tps, 3),
+          # the RTT subtraction becomes unreliable once the corrected
+          # window shrinks toward the subtracted amount — read the raw
+          # ratio (and the in-graph step decomposition) when this flags
+          rtt_correction_reliable=bool(decode_net > syncs["n"] * _RTT),
           context=cfg.max_seq_len, slots=slots, requests=len(reqs),
           mixed_prompt_lens=sorted(set(lens)),
           fixed_batch_tokens_per_slot=round(fb_slot_tps, 1),

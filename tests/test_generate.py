@@ -77,8 +77,11 @@ def test_generate_gqa_cache_is_grouped():
     decode_model = TransformerLM(cfg, decode=True)
     cache = decode_model.init(
         jax.random.key(0), prompt[:, :1])["cache"]
+    # the cache is stored PACKED [B, S, Hkv*D] (lane-multiple minor dim;
+    # see CausalSelfAttention._cached_attend) — the GQA memory win shows
+    # as Hkv*D = 2*head_dim, not num_heads*head_dim
     k_shape = cache["block0"]["attn"]["cached_key"].shape
-    assert k_shape == (2, 16, 2, cfg.head_dim), k_shape  # Hkv=2, not 4
+    assert k_shape == (2, 16, 2 * cfg.head_dim), k_shape  # Hkv=2, not 4
 
     out = greedy_generate(cfg, params, prompt, 6)
     assert out.shape == (2, 10)

@@ -106,8 +106,8 @@ def beam_search_generate(
     cache, logits = _prefill(
         model, params, _blank_cache(model, b), prompt, prefill_chunk)
     cache = jax.tree.map(
-        lambda leaf: (jnp.repeat(leaf, w, axis=leaf.ndim - 4)
-                      if leaf.ndim >= 4 else leaf), cache)
+        lambda leaf: (jnp.repeat(leaf, w, axis=leaf.ndim - 3)
+                      if leaf.ndim >= 3 else leaf), cache)
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
 
     # first expansion: top-W tokens of the prompt's next-token dist seed
@@ -140,14 +140,15 @@ def beam_search_generate(
         token = (flat_idx % v).astype(jnp.int32)            # [B, W]
 
         # reindex every per-beam buffer to the winning parents.  K/V
-        # leaves carry the folded batch on axis 0 unrolled ([B·W, S,
-        # H_kv, D]) and axis 1 under scan_layers ([L, B·W, S, H_kv, D]);
-        # cache_index scalars are beam-uniform and skip the gather.
+        # leaves are PACKED [·, S, Hkv*D] and carry the folded batch on
+        # axis 0 unrolled ([B·W, S, F]) and axis 1 under scan_layers
+        # ([L, B·W, S, F]) — i.e. always axis ndim-3; cache_index
+        # scalars are beam-uniform and skip the gather.
         gather = lambda x: jnp.take_along_axis(x, parent, axis=1)
         row = (jnp.arange(b)[:, None] * w + parent).reshape(-1)  # [B·W]
         cache = jax.tree.map(
-            lambda leaf: (jnp.take(leaf, row, axis=leaf.ndim - 4)
-                          if leaf.ndim >= 4 else leaf), cache)
+            lambda leaf: (jnp.take(leaf, row, axis=leaf.ndim - 3)
+                          if leaf.ndim >= 3 else leaf), cache)
         out = jnp.take_along_axis(
             out, parent[:, :, None], axis=1).at[:, :, t].set(
                 jnp.where(gather(done), jnp.int32(pad_token), token))

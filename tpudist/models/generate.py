@@ -270,7 +270,7 @@ def _sharded_generate(cfg, params, prompt, max_new_tokens, mesh, *,
     # cache-sharding constraint)
 
     def cache_constraint(leaf):
-        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
+        if leaf.ndim == 3:  # PACKED [B, S, Hkv*D] K/V buffers
             return NamedSharding(mesh, cache_spec)
         return NamedSharding(mesh, P())  # cache_index scalars
 
@@ -342,7 +342,7 @@ def tp_generate(
     specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
     return _sharded_generate(
         cfg, shard_tree(params, mesh, specs), prompt, max_new_tokens, mesh,
-        cache_spec=P(None, None, axis, None),
+        cache_spec=P(None, None, axis),
         decode_shard=((mesh, axis) if decode_attention == "flash"
                       else None),
         decode_attention=decode_attention, prefill_chunk=prefill_chunk,
@@ -391,7 +391,7 @@ def sp_generate(
             f"size {mesh.shape[axis]}")
     return _sharded_generate(
         cfg, params, prompt, max_new_tokens, mesh,
-        cache_spec=P(None, axis, None, None),
+        cache_spec=P(None, axis, None),
         decode_shard=((mesh, axis, "seq") if decode_attention == "flash"
                       else None),
         decode_attention=decode_attention, prefill_chunk=prefill_chunk,
@@ -450,7 +450,7 @@ def tp_sp_generate(
     specs = spec_tree_from_rules(params, rules or transformer_tp_rules(axis))
     return _sharded_generate(
         cfg, shard_tree(params, mesh, specs), prompt, max_new_tokens, mesh,
-        cache_spec=P(None, seq_axis, axis, None),
+        cache_spec=P(None, seq_axis, axis),
         decode_shard=((mesh, (axis, seq_axis), "heads_seq")
                       if decode_attention == "flash" else None),
         decode_attention=decode_attention, prefill_chunk=prefill_chunk,

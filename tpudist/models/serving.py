@@ -213,11 +213,13 @@ class ServeLoop:
                 return node
             out = {k: walk(v) for k, v in node.items()}
             if "cached_key" in out:
-                b, _, h_kv, d = out["cached_key"].shape
+                # the cache (and therefore the side buffers) is PACKED
+                # [B, S, Hkv*D] — see CausalSelfAttention._cached_attend
+                b, _, flat = out["cached_key"].shape
                 out["side_key"] = jnp.zeros(
-                    (b, self.side, h_kv, d), out["cached_key"].dtype)
+                    (b, self.side, flat), out["cached_key"].dtype)
                 out["side_value"] = jnp.zeros(
-                    (b, self.side, h_kv, d), out["cached_value"].dtype)
+                    (b, self.side, flat), out["cached_value"].dtype)
                 out["side_index"] = jnp.zeros((), jnp.int32)
             return out
         return walk(cache)
@@ -347,22 +349,21 @@ class ServeLoop:
                 p = jnp.arange(cap)
                 for name, side_name in (("cached_key", "side_key"),
                                         ("cached_value", "side_value")):
-                    main = out[name]
-                    side = out[side_name]
+                    main = out[name]                 # packed [B, S, F]
+                    side = out[side_name]            # packed [B, cap, F]
                     for r in range(B):
                         start = jnp.minimum(idx[r], S - cap)
                         sh = idx[r] - start          # 0 unless near S
                         src = p - sh
                         cur = jax.lax.dynamic_slice(
-                            main, (r, start, 0, 0),
-                            (1, cap, *main.shape[2:]))
+                            main, (r, start, 0), (1, cap, main.shape[2]))
                         live = ((src >= 0) & (src < lived[r]))[
-                            None, :, None, None]
+                            None, :, None]
                         shifted = side[r][jnp.clip(src, 0, cap - 1)][None]
                         merged = jnp.where(
                             live, shifted.astype(main.dtype), cur)
                         main = jax.lax.dynamic_update_slice(
-                            main, merged, (r, start, 0, 0))
+                            main, merged, (r, start, 0))
                     out[name] = main
                 out["cache_index"] = jnp.minimum(idx + lived, S)
                 out["side_index"] = jnp.zeros((), jnp.int32)

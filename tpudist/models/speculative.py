@@ -148,7 +148,8 @@ def _set_cache_index(cache: Any, idx: jnp.ndarray) -> Any:
     by every cached-attention path and overwritten on the next write at
     that position).  Index leaves are 0-D scalars in the unrolled layout
     and [num_layers] vectors under ``cfg.scan_layers``; K/V buffers are
-    always >= 4-D, so dimensionality separates them."""
+    always >= 3-D (packed [B, S, Hkv·D]), so dimensionality separates
+    them."""
     return jax.tree.map(
         lambda leaf: (jnp.full_like(leaf, idx) if leaf.ndim <= 1 else leaf),
         cache)
@@ -387,7 +388,7 @@ def _sharded_speculative(
     # runs serving_layout on target AND draft before its shardings
 
     def cache_constraint(leaf):
-        if leaf.ndim == 4:  # [B, S, H_kv, D] K/V buffers
+        if leaf.ndim == 3:  # PACKED [B, S, Hkv*D] K/V buffers
             return NamedSharding(mesh, cache_spec)
         return NamedSharding(mesh, P())
 
@@ -471,7 +472,7 @@ def tp_speculative_generate(
     return _sharded_speculative(
         target_cfg, shard_tree(target_params, mesh, specs), draft_cfg,
         draft_params, prompt, max_new_tokens, mesh,
-        cache_spec=P(None, None, axis, None),
+        cache_spec=P(None, None, axis),
         decode_shard=((mesh, axis) if decode_attention == "flash"
                       else None),
         decode_attention=decode_attention, num_draft=num_draft, key=key,
@@ -539,7 +540,7 @@ def tp_sp_speculative_generate(
     return _sharded_speculative(
         target_cfg, shard_tree(target_params, mesh, specs), draft_cfg,
         draft_params, prompt, max_new_tokens, mesh,
-        cache_spec=P(None, seq_axis, axis, None),
+        cache_spec=P(None, seq_axis, axis),
         decode_shard=None, decode_attention="dense",
         num_draft=num_draft, key=key, temperature=temperature,
         top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,
@@ -592,7 +593,7 @@ def sp_speculative_generate(
     return _sharded_speculative(
         target_cfg, target_params, draft_cfg, draft_params, prompt,
         max_new_tokens, mesh,
-        cache_spec=P(None, axis, None, None),
+        cache_spec=P(None, axis, None),
         decode_shard=None, decode_attention="dense",
         num_draft=num_draft, key=key, temperature=temperature,
         top_k=top_k, top_p=top_p, prefill_chunk=prefill_chunk,

@@ -113,14 +113,15 @@ def _head_sharded(decode_shard, fn, q, k, v, scalar):
         out_specs=spec, check_vma=False)(q, k, v, scalar)
 
 
-def _seq_sharded_decode(decode_shard, q, k_all, v_all, n, window):
-    """Sequence-sharded kernelized decode: cache slices stay put, each
-    shard runs flash_decode with global masking, partial softmaxes merge
-    by log-sum-exp (one [B, H] all-gather + one psum — no cache
-    movement).  With the 2-D ``"heads_seq"`` kind the axis pair
-    ``(head_axis, seq_axis)`` shards heads AND sequence: each shard
-    kernels its own (head slice × cache slice) and the merge runs over
-    the sequence axis only — heads need no collective at all."""
+def _seq_sharded_decode(decode_shard, q, k_all, v_all, n, window, h_kv):
+    """Sequence-sharded kernelized decode over the PACKED cache: cache
+    slices stay put, each shard runs flash_decode with global masking,
+    partial softmaxes merge by log-sum-exp (one [B, H] all-gather + one
+    psum — no cache movement).  With the 2-D ``"heads_seq"`` kind the
+    axis pair ``(head_axis, seq_axis)`` shards heads AND sequence: each
+    shard kernels its own (head slice × cache slice) — the packed minor
+    dim shards by whole KV heads, contiguous per head — and the merge
+    runs over the sequence axis only."""
     from jax.sharding import PartitionSpec as P
 
     from tpudist.ops.flash_decode import sp_flash_decode
@@ -130,11 +131,40 @@ def _seq_sharded_decode(decode_shard, q, k_all, v_all, n, window):
         hax, sax = ax
     else:
         hax, sax = None, ax
+    n_h = mesh.shape[hax] if hax else 1
+    if h_kv % n_h:
+        raise ValueError(
+            f"kv heads {h_kv} not divisible by {hax!r} axis size {n_h}")
+    local_kv = h_kv // n_h
     q_spec = P(None, None, hax, None)
-    kv_spec = P(None, sax, hax, None)
+    kv_spec = P(None, sax, hax)
     return jax.shard_map(
         lambda qs, ks, vs, nn_: sp_flash_decode(
-            qs, ks, vs, nn_, sax, window=window),
+            qs, ks, vs, nn_, sax, window=window,
+            packed_kv_heads=local_kv),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, P()),
+        out_specs=q_spec, check_vma=False)(q, k_all, v_all, n)
+
+
+def _head_sharded_packed(decode_shard, q, k_all, v_all, n, window, h_kv):
+    """Head-sharded flash decode over the PACKED cache: each shard owns
+    whole KV-head chunks of the packed minor dim and runs the kernel on
+    its slice — the TP layout, no collectives at all."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudist.ops.flash_decode import flash_decode
+
+    mesh, ax = decode_shard[0], decode_shard[1]
+    if h_kv % mesh.shape[ax]:
+        raise ValueError(
+            f"kv heads {h_kv} not divisible by {ax!r} axis size "
+            f"{mesh.shape[ax]}")
+    local_kv = h_kv // mesh.shape[ax]
+    q_spec = P(None, None, ax, None)
+    kv_spec = P(None, None, ax)
+    return jax.shard_map(
+        lambda qs, ks, vs, nn_: flash_decode(
+            qs, ks, vs, nn_, window=window, packed_kv_heads=local_kv),
         mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, P()),
         out_specs=q_spec, check_vma=False)(q, k_all, v_all, n)
 
@@ -269,12 +299,20 @@ class CausalSelfAttention(nn.Module):
         cfg = self.cfg
         b, s, _, d = q.shape
         h_kv = k.shape[2]  # the GQA cache-memory win: Hkv slots, not H
+        # The cache is stored PACKED [B, S, Hkv*D]: with the per-head
+        # 4-D shape and narrow heads (e.g. [B, S, 2, 64]), XLA lays the
+        # carry out S-minor and inserts TWO full-cache layout-conversion
+        # copies per decode step feeding the pallas kernel (measured ~2x
+        # step time at 8k context; see flash_decode's packed mode).  A
+        # lane-multiple minor dim keeps every consumer relayout-free;
+        # per-head views are reshaped where semantics need them.
+        flat = h_kv * d
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (b, cfg.max_seq_len, h_kv, d), cfg.compute_dtype)
+            (b, cfg.max_seq_len, flat), cfg.compute_dtype)
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (b, cfg.max_seq_len, h_kv, d), cfg.compute_dtype)
+            (b, cfg.max_seq_len, flat), cfg.compute_dtype)
         idx_var = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
         idx = idx_var.value
@@ -288,14 +326,21 @@ class CausalSelfAttention(nn.Module):
             return self._serve_attend(
                 q, k, v, cached_k, cached_v, idx_var)
         k_all = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cached_k.value.dtype), (0, idx, 0, 0))
+            cached_k.value,
+            k.reshape(b, s, flat).astype(cached_k.value.dtype),
+            (0, idx, 0))
         v_all = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cached_v.value.dtype), (0, idx, 0, 0))
+            cached_v.value,
+            v.reshape(b, s, flat).astype(cached_v.value.dtype),
+            (0, idx, 0))
         cached_k.value, cached_v.value = k_all, v_all
         idx_var.value = idx + s
 
+        def view4(x):
+            return x.reshape(b, cfg.max_seq_len, h_kv, d)
+
         if s > 1:
-            return self._prefill_attend(q, k_all, v_all, idx)
+            return self._prefill_attend(q, view4(k_all), view4(v_all), idx)
         if self.decode_attention == "flash":
             from tpudist.ops.flash_decode import flash_decode
 
@@ -303,20 +348,19 @@ class CausalSelfAttention(nn.Module):
                 if _shard_kind(self.decode_shard) in ("seq", "heads_seq"):
                     return _seq_sharded_decode(
                         self.decode_shard, q, k_all, v_all, idx + 1,
-                        cfg.attention_window)
-                return _head_sharded(
-                    self.decode_shard,
-                    lambda qs, ks, vs, n: flash_decode(
-                        qs, ks, vs, n, window=cfg.attention_window),
-                    q, k_all, v_all, idx + 1)
+                        cfg.attention_window, h_kv)
+                return _head_sharded_packed(
+                    self.decode_shard, q, k_all, v_all, idx + 1,
+                    cfg.attention_window, h_kv)
             return flash_decode(q, k_all, v_all, idx + 1,
-                                window=cfg.attention_window)
+                                window=cfg.attention_window,
+                                packed_kv_heads=h_kv)
         mask = jnp.arange(cfg.max_seq_len) <= idx            # causal: ≤ self
         if cfg.attention_window is not None:  # sliding window: last W only
             mask = mask & (
                 idx - jnp.arange(cfg.max_seq_len) < cfg.attention_window)
-        k_all, v_all = repeat_kv(q, k_all, v_all)  # cache itself stays GQA
-        return _masked_attend(q, k_all, v_all, mask[None, None, None, :])
+        k4, v4 = repeat_kv(q, view4(k_all), view4(v_all))
+        return _masked_attend(q, k4, v4, mask[None, None, None, :])
 
     def _serve_attend(self, q, k, v, cached_k, cached_v, idx_var):
         """One decode step with PER-ROW cache positions: row ``r``'s K/V
@@ -352,13 +396,17 @@ class CausalSelfAttention(nn.Module):
             return self._serve_attend_sided(
                 q, k, v, cached_k, cached_v, idx_var)
 
+        h_kv, d = k.shape[2], k.shape[3]
+        flat = h_kv * d
         at = jnp.minimum(idx, cfg.max_seq_len - 1)
         k_all, v_all = cached_k.value, cached_v.value
+        kf = k.reshape(b, 1, flat)
+        vf = v.reshape(b, 1, flat)
         for r in range(b):
             k_all = jax.lax.dynamic_update_slice(
-                k_all, k[r:r + 1].astype(k_all.dtype), (r, at[r], 0, 0))
+                k_all, kf[r:r + 1].astype(k_all.dtype), (r, at[r], 0))
             v_all = jax.lax.dynamic_update_slice(
-                v_all, v[r:r + 1].astype(v_all.dtype), (r, at[r], 0, 0))
+                v_all, vf[r:r + 1].astype(v_all.dtype), (r, at[r], 0))
         cached_k.value, cached_v.value = k_all, v_all
         idx_var.value = idx + 1
 
@@ -366,7 +414,8 @@ class CausalSelfAttention(nn.Module):
         if self.decode_attention == "flash" and cfg.attention_window is None:
             from tpudist.ops.flash_decode import flash_decode
 
-            return flash_decode(q, k_all, v_all, n)
+            return flash_decode(q, k_all, v_all, n,
+                                packed_kv_heads=h_kv)
         # NOTE: flash + attention_window falls back to the dense masked
         # path here (the per-row kernel has no per-row window trim yet) —
         # ServeLoop warns about the bandwidth cost at construction.
@@ -375,7 +424,9 @@ class CausalSelfAttention(nn.Module):
         if cfg.attention_window is not None:
             mask = mask & (idx[:, None] - positions
                            < cfg.attention_window)
-        k_rep, v_rep = repeat_kv(q, k_all, v_all)
+        k4 = k_all.reshape(b, cfg.max_seq_len, h_kv, d)
+        v4 = v_all.reshape(b, cfg.max_seq_len, h_kv, d)
+        k_rep, v_rep = repeat_kv(q, k4, v4)
         return _masked_attend(q, k_rep, v_rep, mask[:, None, None, :])
 
     def _serve_attend_sided(self, q, k, v, cached_k, cached_v, idx_var):
@@ -399,19 +450,22 @@ class CausalSelfAttention(nn.Module):
         b = q.shape[0]
         cap = self.serve_side_slots
         h_kv, d = k.shape[2], k.shape[3]
+        flat = h_kv * d
         side_k = self.variable(
-            "cache", "side_key", jnp.zeros, (b, cap, h_kv, d),
+            "cache", "side_key", jnp.zeros, (b, cap, flat),
             cfg.compute_dtype)
         side_v = self.variable(
-            "cache", "side_value", jnp.zeros, (b, cap, h_kv, d),
+            "cache", "side_value", jnp.zeros, (b, cap, flat),
             cfg.compute_dtype)
         side_idx = self.variable(
             "cache", "side_index", lambda: jnp.zeros((), jnp.int32))
         s_at = jnp.minimum(side_idx.value, cap - 1)
         side_k.value = jax.lax.dynamic_update_slice(
-            side_k.value, k.astype(side_k.value.dtype), (0, s_at, 0, 0))
+            side_k.value, k.reshape(b, 1, flat).astype(side_k.value.dtype),
+            (0, s_at, 0))
         side_v.value = jax.lax.dynamic_update_slice(
-            side_v.value, v.astype(side_v.value.dtype), (0, s_at, 0, 0))
+            side_v.value, v.reshape(b, 1, flat).astype(side_v.value.dtype),
+            (0, s_at, 0))
         side_idx.value = side_idx.value + 1
 
         from tpudist.ops.flash_decode import flash_decode
@@ -419,7 +473,7 @@ class CausalSelfAttention(nn.Module):
         return flash_decode(
             q, cached_k.value, cached_v.value, idx_var.value,
             side_k=side_k.value, side_v=side_v.value,
-            side_len=side_idx.value)
+            side_len=side_idx.value, packed_kv_heads=h_kv)
 
     def _prefill_attend(self, q, k_all, v_all, idx):
         """Chunk prefill: queries at global positions [idx, idx+s) attend
