@@ -46,10 +46,13 @@ _EMITTED: list[dict] = []  # every metric line, re-printed in the recap
 
 
 def _emit(metric, value, unit, vs_baseline=None, **extra) -> None:
-    line = {"metric": metric, "value": value, "unit": unit,
-            "vs_baseline": vs_baseline, **extra}
-    _EMITTED.append(line)
-    print(json.dumps(line), flush=True)
+    # formatting goes through the obs JSONL exporter (same schema this
+    # function always printed; BENCH_*.json parsers see identical lines)
+    from tpudist.obs.export import jsonl_line
+
+    line = jsonl_line(metric, value, unit, vs_baseline, **extra)
+    _EMITTED.append(json.loads(line))
+    print(line, flush=True)
 
 
 def _recap() -> None:

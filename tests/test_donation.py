@@ -82,3 +82,30 @@ def test_donated_loop_trains():
         first = first if first is not None else float(metrics["loss"][0])
     assert float(metrics["loss"][-1]) < first
     assert int(state.step) == 20
+
+
+def test_serve_segment_donates_full_carry():
+    """ServeLoop._segment donates every rebound carry — cache AND
+    tok/active/remaining/key (mirroring _admit_dev) — while the persistent
+    ``first`` lane is NOT donated (self._first outlives the call)."""
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            num_kv_heads=2, embed_dim=64, max_seq_len=96)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                     decode_attention="dense", prefill_chunk=8)
+    loop._admit(0, Request(np.arange(1, 6, dtype=np.int32), 8))
+    old_cache_leaf = jax.tree.leaves(loop.cache)[0]
+    old = (loop._tok, loop._active, loop._remaining, loop._key)
+    out = loop._segment(loop.params, loop.cache, *old[:3], loop._first,
+                        old[3])
+    jax.block_until_ready(out[-1])
+    assert old_cache_leaf.is_deleted()
+    for buf in old:
+        assert buf.is_deleted()
+    assert not loop._first.is_deleted()
+    with pytest.raises((RuntimeError, ValueError)):
+        _ = np.asarray(old[0]) + 1

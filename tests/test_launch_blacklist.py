@@ -86,6 +86,32 @@ class TestPerWorkerBlacklist:
         assert "1" in a2                   # readmitted: 2 blacklisted and
         assert "2" not in a2               # 1's cooldown had elapsed
 
+    def test_recovered_slot_rescheduled_ahead_of_fresh_sids(self, tmp_path):
+        """A slot whose cooldown expires with the roster FULL must rejoin
+        ahead of the synthetic replacement sids, not behind them: the
+        scheduled set is roster[:world], so a tail append would leave the
+        recovered slot parked forever.  Here sid 1 fails attempts 0-1 and
+        is blacklisted with an instant cooldown; fresh sid 2 replaces it
+        at attempt 2, where sid 0 fails (once — not enough to blacklist).
+        At attempt 3 the roster holds [0, 1, 2]: recovered 1 must outrank
+        replacement 2 (the buggy tail append scheduled {0, 2})."""
+        rc = launch(
+            [sys.executable, FLAKY], nprocs=2, max_restarts=3,
+            blacklist_after=2, blacklist_cooldown=0.0, coord_server=False,
+            env={"PYTHONPATH": "", "WORKER_OUT_DIR": str(tmp_path),
+                 "WORKER_FAIL_SPAWN_IDS": "1@0,1@1,0@2"},
+        )
+        assert rc == 0
+        ev = self._events(tmp_path)
+        by_attempt = {}
+        for e in ev:
+            by_attempt.setdefault(e["attempt"], set()).add(e["sid"])
+        assert by_attempt[0] == {"0", "1"}
+        assert by_attempt[1] == {"0", "1"}
+        assert by_attempt[2] == {"0", "2"}   # 1 cooling; fresh 2 fills in
+        assert by_attempt[3] == {"0", "1"}   # recovered 1 ahead of fresh 2
+        assert all(e["world"] == 2 for e in ev)
+
     def test_blacklist_after_validation(self):
         with pytest.raises(ValueError, match="blacklist_after"):
             launch([sys.executable, FLAKY], nprocs=2, blacklist_after=0)

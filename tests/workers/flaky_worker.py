@@ -1,20 +1,23 @@
 """Minimal (jax-free) gang worker for launcher blacklist tests: records
-its stable spawn id / attempt / world, then fails iff its spawn id is in
-``WORKER_FAIL_SPAWN_IDS`` (a persistently bad "host")."""
+its stable spawn id / attempt / world, then fails iff
+``WORKER_FAIL_SPAWN_IDS`` lists its spawn id — either bare (``"1"``, a
+persistently bad "host") or pinned to one attempt (``"1@0"``, a host
+that is bad only then — lets tests steer exactly which attempts fail)."""
 
 import json
 import os
 import sys
 
 sid = os.environ.get("TPUDIST_SPAWN_ID", "?")
+attempt = int(os.environ["TPUDIST_RESTART_ATTEMPT"])
 out = os.environ.get("WORKER_OUT_DIR")
 if out:
     with open(os.path.join(out, "events.jsonl"), "a") as fh:
         fh.write(json.dumps({
             "sid": sid,
-            "attempt": int(os.environ["TPUDIST_RESTART_ATTEMPT"]),
+            "attempt": attempt,
             "world": int(os.environ["TPUDIST_NUM_PROCESSES"]),
             "rank": int(os.environ["TPUDIST_PROCESS_ID"]),
         }) + "\n")
 fail_ids = os.environ.get("WORKER_FAIL_SPAWN_IDS", "").split(",")
-sys.exit(3 if sid in fail_ids else 0)
+sys.exit(3 if sid in fail_ids or f"{sid}@{attempt}" in fail_ids else 0)

@@ -22,7 +22,11 @@ differentiates across devices natively.  What remains — and what this package
 provides — are the *capabilities*, re-expressed mesh-first.
 """
 
-from tpudist import data, elastic, models, ops, parallel, runtime, train, utils
+from tpudist.utils.compat import install_jax_compat
+
+install_jax_compat()  # before any module touches renamed jax symbols
+
+from tpudist import data, elastic, models, obs, ops, parallel, runtime, train, utils
 from tpudist.runtime.mesh import (
     MeshSpec,
     data_mesh,
@@ -48,6 +52,7 @@ __all__ = [
     "get_devices",
     "make_mesh",
     "models",
+    "obs",
     "ops",
     "parallel",
     "pipeline_mesh",

@@ -39,6 +39,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from tpudist import obs
 from tpudist.elastic.loop import WorldChanged
 from tpudist.elastic.state import ElasticState
 from tpudist.runtime.collectives import HostCollectives, PeerLost
@@ -200,11 +201,14 @@ def run_elastic_worker(
                     round_id, wid, timeout_s=rendezvous_timeout_s,
                     min_world=min_world, superseded_key="elastic/round")
             except TimeoutError:
+                obs.counter("elastic/rendezvous_timeouts").inc()
                 rounds += 1
                 if rounds > max_rounds:
                     raise
                 round_id = _next_round(client, round_id)
                 continue
+            obs.counter("elastic/rounds").inc()
+            obs.gauge("elastic/world_size", unit="workers").set(world)
             monitor.resize(world)
             if rank == 0:
                 # publish forward only: a lagging splinter round must never
@@ -311,6 +315,7 @@ def run_elastic_worker(
                         state.state = restore()
                 return state
             except WorldChanged as e:
+                obs.counter("elastic/world_changed").inc()
                 rounds += 1
                 if rounds > max_rounds:
                     raise
@@ -340,6 +345,7 @@ def run_elastic_worker(
                                or is_collective_failure(e))
                 if not peerish:
                     raise
+                obs.counter("elastic/peer_lost").inc()
                 rounds += 1
                 if rounds > max_rounds:
                     raise

@@ -37,6 +37,7 @@ same kernels either way.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Any, Optional, Sequence
 
@@ -45,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpudist import obs
 from tpudist.models.generate import (
     _blank_cache,
     _make_select,
@@ -194,7 +196,19 @@ class ServeLoop:
         # transfers (a per-slot int() fetch measured one full tunnel RTT
         # per admission, ~0.1 s each on the dev tunnel)
         self._first = jnp.full((num_slots,), self.pad_token, jnp.int32)
-        self._segment = jax.jit(self._segment_impl, donate_argnums=(1,))
+        # obs handles cached once; recording on the serve loop is host
+        # ints/floats only, never a device fetch
+        self._obs_requests = obs.counter("serve/requests", unit="reqs")
+        self._obs_tokens = obs.counter("serve/tokens", unit="tokens")
+        self._obs_segments = obs.counter("serve/segments", unit="segments")
+        self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
+        self._obs_latency = obs.histogram("serve/request_latency", unit="s")
+        # donate every rebound carry: cache, tok, active, remaining, key
+        # (argnums 2-4 and 6) mirror _admit_dev — their inputs are dead
+        # the moment the segment returns replacements.  `first` (argnum 5)
+        # is NOT donated: self._first persists across segments.
+        self._segment = jax.jit(self._segment_impl,
+                                donate_argnums=(1, 2, 3, 4, 6))
         # params is a jit ARGUMENT (a closure capture would lower the
         # whole parameter tree into the traced program — the HTTP-413 /
         # duplicated-constants hazard bench.py documents — and would pin
@@ -423,6 +437,9 @@ class ServeLoop:
             done.append(Completion(
                 rid=st["req"].rid, prompt=np.asarray(st["req"].prompt),
                 tokens=np.asarray(st["tokens"], np.int32), reason=reason))
+            self._obs_tokens.inc(len(st["tokens"]))
+            if "t_admit" in st:
+                self._obs_latency.record(time.perf_counter() - st["t_admit"])
             slot_state[slot] = None
 
         def drain(slot: int, emit_row) -> None:
@@ -449,13 +466,22 @@ class ServeLoop:
         while pending or any(s is not None for s in slot_state):
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
-                    slot_state[slot] = self._admit(slot, pending.popleft())
+                    with obs.span("serve/admit", slot=slot):
+                        slot_state[slot] = self._admit(
+                            slot, pending.popleft())
+                    # stamped here, not in _admit: benches wrap
+                    # loop._admit, and latency must cover the wrapper too
+                    slot_state[slot]["t_admit"] = time.perf_counter()
+                    self._obs_requests.inc()
+            self._obs_queue.set(len(pending))
             # the segment splits per-step keys and returns the advanced
             # key — no per-wave host-side split dispatch needed
-            (self.cache, self._tok, self._active, self._remaining,
-             self._key, emits) = self._segment(
-                self.params, self.cache, self._tok, self._active,
-                self._remaining, self._first, self._key)
+            with obs.span("serve/segment", steps=self.steps):
+                (self.cache, self._tok, self._active, self._remaining,
+                 self._key, emits) = self._segment(
+                    self.params, self.cache, self._tok, self._active,
+                    self._remaining, self._first, self._key)
+            self._obs_segments.inc()
             emits = np.asarray(emits)       # the one host sync per segment
             for slot in range(self.B):
                 if slot_state[slot] is not None:

@@ -1,0 +1,82 @@
+"""tpudist.obs — distributed observability: metrics, spans, aggregation,
+exporters.
+
+The subsystem every layer reports through (see docs/OBSERVABILITY.md):
+
+* :mod:`tpudist.obs.registry` — counters / gauges / log-bucket histograms
+  with MetricLogger-style lazy device accumulation (recording never
+  syncs; one batched ``device_get`` per snapshot).
+* :mod:`tpudist.obs.spans` — ``with obs.span("train_step"):`` Chrome-trace
+  timelines, optional ``jax.effects_barrier()`` fencing, composes with
+  the XProf trace from :func:`tpudist.utils.metrics.maybe_profile`.
+* :mod:`tpudist.obs.aggregate` — workers publish snapshots through the
+  coord KV store; rank 0 merges them into a cluster view.
+* :mod:`tpudist.obs.export` — bench-schema JSONL, Prometheus text, and a
+  stdlib-only HTTP ``/metrics`` endpoint.
+
+Module-level conveniences bind to one process-global registry and tracer,
+so library code can just ``from tpudist import obs; obs.counter(...)``.
+Env knobs (parsed by :func:`tpudist.utils.config.env_flag`, so ``=0`` and
+``=false`` really mean off): ``TPUDIST_OBS_FENCE`` fences spans with
+``jax.effects_barrier()``.
+"""
+
+from __future__ import annotations
+
+from tpudist.obs.aggregate import (
+    MetricsPublisher,
+    collect,
+    collect_and_merge,
+    merge_snapshots,
+)
+from tpudist.obs.export import (
+    MetricsServer,
+    jsonl_line,
+    snapshot_to_jsonl,
+    to_prometheus,
+)
+from tpudist.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    hist_quantile,
+    summarize,
+)
+from tpudist.obs.spans import SpanTracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "MetricsPublisher",
+    "MetricsServer",
+    "SpanTracer",
+    "collect",
+    "collect_and_merge",
+    "counter",
+    "gauge",
+    "histogram",
+    "hist_quantile",
+    "jsonl_line",
+    "merge_snapshots",
+    "registry",
+    "snapshot",
+    "snapshot_to_jsonl",
+    "span",
+    "summarize",
+    "to_prometheus",
+    "tracer",
+]
+
+# process-global registry + tracer: instrumentation all over the stack
+# reports here, snapshot()/tracer.dump() read it out
+registry = MetricRegistry()
+tracer = SpanTracer()
+
+counter = registry.counter
+gauge = registry.gauge
+histogram = registry.histogram
+snapshot = registry.snapshot
+span = tracer.span

@@ -1,0 +1,163 @@
+"""Cross-host aggregation over the coord KV service.
+
+Each worker periodically publishes its registry snapshot as JSON under
+``{namespace}/{rank}`` through :class:`tpudist.runtime.coord.CoordClient`
+(set is last-write-wins, so a slow worker's stale snapshot is simply
+replaced by its next publish).  Rank 0 — or any observer with a client —
+collects every published snapshot and merges them into one cluster view:
+
+* counters: sum across workers;
+* gauges: sum across workers (queue depths and world sizes add; a
+  consumer that wants one worker's value reads ``per_worker``);
+* histograms: bucket-by-bucket count merge (the whole point of the
+  log-bucket design — quantiles of the merged histogram are computed
+  from merged counts, never averaged from per-worker quantiles).
+
+Every merged entry carries ``per_worker`` ({rank: value/count}) so the
+cluster view keeps per-host attribution for debugging skew.
+
+This is pull-based pub/sub on a plain KV store: no new coord verbs, and
+a worker that dies mid-round just stops refreshing its key.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["MetricsPublisher", "collect", "merge_snapshots"]
+
+DEFAULT_NAMESPACE = "obs/metrics"
+
+
+class MetricsPublisher:
+    """Publishes ``registry.snapshot()`` to the coord store, either on
+    demand (:meth:`publish`) or on a background daemon thread every
+    ``interval_s`` (:meth:`start` / :meth:`stop`).  The background thread
+    takes its own client clone — CoordClient sockets are not shared
+    across threads."""
+
+    def __init__(self, client, rank: int, registry,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 interval_s: float = 5.0) -> None:
+        self._client = client
+        self._rank = rank
+        self._registry = registry
+        self._namespace = namespace
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self._namespace}/{self._rank}"
+
+    def publish(self, client=None) -> dict:
+        snap = self._registry.snapshot()
+        snap["rank"] = self._rank
+        (client or self._client).set(
+            self.key, json.dumps(snap).encode("utf-8"))
+        return snap
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            client = self._client.clone()
+            try:
+                while not self._stop.wait(self._interval_s):
+                    try:
+                        self.publish(client)
+                    except Exception:
+                        # the store may be tearing down; keep trying
+                        # until stop() — observability must never take
+                        # the worker down
+                        pass
+            finally:
+                client.close()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"obs-publish-r{self._rank}", daemon=True)
+        self._thread.start()
+
+    def stop(self, final_publish: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_publish:
+            try:
+                self.publish()
+            except Exception:
+                pass
+
+
+def collect(client, namespace: str = DEFAULT_NAMESPACE) -> dict[int, dict]:
+    """Fetch every published snapshot: {rank: snapshot}.  Keys listed but
+    deleted between list and get (a departing worker) are skipped."""
+    out: dict[int, dict] = {}
+    prefix = namespace + "/"
+    for key in client.keys(prefix):
+        raw = client.get(key)
+        if raw is None:
+            continue
+        snap = json.loads(raw.decode("utf-8"))
+        out[int(key[len(prefix):])] = snap
+    return out
+
+
+def _merge_hist(merged: dict, hist: dict, rank: int) -> None:
+    if hist["growth"] != merged["growth"]:
+        raise ValueError(
+            f"cannot merge histograms with growth {hist['growth']} into "
+            f"{merged['growth']}: bucket indices are incompatible")
+    merged["count"] += hist["count"]
+    merged["sum"] += hist["sum"]
+    merged["zero"] += hist.get("zero", 0)
+    for bound in ("min", "max"):
+        vals = [v for v in (merged[bound], hist[bound]) if v is not None]
+        merged[bound] = (min(vals) if bound == "min" else max(vals)) \
+            if vals else None
+    for idx, n in hist["buckets"].items():
+        merged["buckets"][idx] = merged["buckets"].get(idx, 0) + n
+    merged["per_worker"][str(rank)] = hist["count"]
+
+
+def merge_snapshots(snapshots: dict[int, dict]) -> dict:
+    """Merge per-worker snapshots into the cluster view (sum counters and
+    gauges, merge histogram buckets), keeping ``per_worker`` attribution
+    on every metric."""
+    merged: dict = {"workers": sorted(snapshots),
+                    "counters": {}, "gauges": {}, "histograms": {}}
+    for rank in sorted(snapshots):
+        snap = snapshots[rank]
+        for kind in ("counters", "gauges"):
+            for name, m in snap.get(kind, {}).items():
+                slot = merged[kind].setdefault(
+                    name, {"value": 0.0, "unit": m.get("unit", ""),
+                           "per_worker": {}})
+                if m["value"] is not None:
+                    slot["value"] += m["value"]
+                slot["per_worker"][str(rank)] = m["value"]
+        for name, h in snap.get("histograms", {}).items():
+            slot = merged["histograms"].get(name)
+            if slot is None:
+                slot = merged["histograms"][name] = {
+                    "unit": h.get("unit", ""), "growth": h["growth"],
+                    "count": 0, "sum": 0.0, "min": None, "max": None,
+                    "zero": 0, "buckets": {}, "per_worker": {}}
+            _merge_hist(slot, h, rank)
+    # canonical bucket order for stable JSON / prometheus output
+    for h in merged["histograms"].values():
+        h["buckets"] = {str(i): h["buckets"][str(i)]
+                        for i in sorted(int(k) for k in h["buckets"])}
+    return merged
+
+
+def collect_and_merge(client, namespace: str = DEFAULT_NAMESPACE) -> dict:
+    """Rank 0's one-call cluster view."""
+    merged = merge_snapshots(collect(client, namespace))
+    merged["time"] = time.time()
+    return merged

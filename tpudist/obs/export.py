@@ -1,0 +1,156 @@
+"""Exporters: bench-schema JSONL, Prometheus text format, HTTP /metrics.
+
+Three renderings of the same registry snapshot:
+
+* :func:`jsonl_line` / :func:`snapshot_to_jsonl` — one JSON object per
+  line in the exact ``bench.py`` schema (``{"metric", "value", "unit",
+  "vs_baseline", ...}``, insertion order preserved) so BENCH_*.json
+  parsers keep working when bench emits through the registry.
+* :func:`to_prometheus` — Prometheus text exposition format 0.0.4.
+  Log-bucket histograms become classic cumulative ``le`` histograms
+  whose upper bounds are the bucket upper edges ``growth**(idx+1)``.
+* :class:`MetricsServer` — optional stdlib-only HTTP endpoint serving
+  ``/metrics`` (Prometheus text) and ``/metrics.json`` (raw snapshot)
+  from a daemon thread; no third-party dependency, safe to leave off.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+from tpudist.obs.registry import summarize
+
+__all__ = ["jsonl_line", "snapshot_to_jsonl", "to_prometheus",
+           "MetricsServer"]
+
+
+# -- JSONL (the bench.py wire schema) ---------------------------------------
+
+def jsonl_line(metric: str, value, unit: str, vs_baseline=None,
+               **extra) -> str:
+    """One bench-schema line.  Key order is load-bearing: existing
+    BENCH_*.json tooling reads these positionally-ish and the recap
+    printer re-dumps them verbatim."""
+    return json.dumps({"metric": metric, "value": value, "unit": unit,
+                       "vs_baseline": vs_baseline, **extra})
+
+
+def snapshot_to_jsonl(snapshot: dict, **extra) -> list[str]:
+    """Render a registry (or merged cluster) snapshot as bench-schema
+    lines: counters/gauges one line each, histograms one line per summary
+    stat (count/mean/p50/p90/p99/...)."""
+    lines: list[str] = []
+    for name, m in snapshot.get("counters", {}).items():
+        lines.append(jsonl_line(name, m["value"], m["unit"], **extra))
+    for name, m in snapshot.get("gauges", {}).items():
+        lines.append(jsonl_line(name, m["value"], m["unit"], **extra))
+    for name, h in snapshot.get("histograms", {}).items():
+        summary = summarize(h)
+        for stat in ("count", "mean", "min", "max", "p50", "p90", "p99"):
+            unit = "" if stat == "count" else h.get("unit", "")
+            lines.append(
+                jsonl_line(f"{name}/{stat}", summary[stat], unit, **extra))
+    return lines
+
+
+# -- Prometheus text format -------------------------------------------------
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _NAME_BAD.sub("_", name)
+    return "_" + out if out[:1].isdigit() else out
+
+
+def _prom_num(v) -> str:
+    if v is None:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v))
+
+
+def to_prometheus(snapshot: dict) -> str:
+    """Prometheus text exposition of a registry or merged snapshot.
+    Histograms are cumulative: ``le`` edges are the log-bucket UPPER
+    bounds (``growth**(idx+1)``; the zero bucket folds into the smallest
+    edge since its values are <= 0 < every positive edge), closing with
+    ``+Inf``, ``_sum`` and ``_count``."""
+    out: list[str] = []
+    for name, m in snapshot.get("counters", {}).items():
+        pname = _prom_name(name)
+        out.append(f"# TYPE {pname} counter")
+        out.append(f"{pname} {_prom_num(m['value'])}")
+    for name, m in snapshot.get("gauges", {}).items():
+        pname = _prom_name(name)
+        out.append(f"# TYPE {pname} gauge")
+        out.append(f"{pname} {_prom_num(m['value'])}")
+    for name, h in snapshot.get("histograms", {}).items():
+        pname = _prom_name(name)
+        out.append(f"# TYPE {pname} histogram")
+        growth = h["growth"]
+        cum = h.get("zero", 0)
+        for idx in sorted(int(i) for i in h["buckets"]):
+            cum += h["buckets"][str(idx)]
+            out.append(
+                f'{pname}_bucket{{le="{_prom_num(growth ** (idx + 1))}"}} '
+                f"{cum}")
+        out.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
+        out.append(f"{pname}_sum {_prom_num(h['sum'])}")
+        out.append(f"{pname}_count {h['count']}")
+    return "\n".join(out) + "\n"
+
+
+# -- HTTP /metrics ----------------------------------------------------------
+
+class MetricsServer:
+    """stdlib-only metrics endpoint.
+
+    ``MetricsServer(registry).port`` binds an ephemeral port; pass
+    ``snapshot_fn`` to serve something other than the local registry
+    (e.g. rank 0 serving the merged cluster view from
+    :func:`tpudist.obs.aggregate.collect_and_merge`).  Runs in a daemon
+    thread; :meth:`close` shuts it down."""
+
+    def __init__(self, registry=None, snapshot_fn=None, host: str = "",
+                 port: int = 0) -> None:
+        if (registry is None) == (snapshot_fn is None):
+            raise ValueError("pass exactly one of registry / snapshot_fn")
+        snap = snapshot_fn or registry.snapshot
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    body = to_prometheus(snap()).encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = json.dumps(snap()).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # silence per-request noise
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="obs-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,300 @@
+"""Process-local metric registry: counters, gauges, log-bucketed histograms.
+
+The reference suite's only perf signal is an end-to-end ``time.time()``
+delta (`mnist_ddp_elastic.py:210-213`, `model_parallel_ResNet50.py:258-262`);
+production TPU stacks treat per-step latency histograms and per-host
+counters as core infrastructure (Horovod's timeline, torch.distributed's
+flight recorder).  This module is the recording half of that layer:
+
+* :class:`Counter` — monotonically increasing sum (steps, tokens, bytes).
+* :class:`Gauge` — last-written value (queue depth, world size, loss).
+* :class:`Histogram` — log-bucketed distribution with p50/p90/p99
+  summaries, mergeable across hosts bucket-by-bucket
+  (:mod:`tpudist.obs.aggregate`).
+
+The load-bearing property is LAZY accumulation, the same contract as
+:class:`tpudist.utils.metrics.MetricLogger`: recorded values may be device
+arrays and are appended un-synced, so recording on the step hot path never
+blocks the async dispatch queue (no ``float()`` / ``device_get`` per
+record).  The one batched host sync happens at :meth:`MetricRegistry
+.snapshot`, which folds every metric's pending values in a single
+``jax.device_get`` — and skips jax entirely when only plain Python numbers
+were recorded, so the registry stays importable/usable without a backend.
+
+Snapshots are plain JSON-ready dicts (string bucket keys), the wire format
+the aggregator publishes through the coordination store and the exporters
+render to JSONL / Prometheus text.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "hist_quantile",
+    "summarize",
+]
+
+_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def _is_plain(v) -> bool:
+    return isinstance(v, (int, float))
+
+
+def _sync_pending(pending: dict[str, list]) -> dict[str, list]:
+    """ONE batched device->host transfer for every metric's pending list
+    (the MetricLogger discipline); pure-host recordings skip jax."""
+    if all(_is_plain(v) for vs in pending.values() for v in vs):
+        return pending
+    import jax
+
+    return jax.device_get(pending)
+
+
+class Counter:
+    """Monotonic sum.  ``inc`` accepts device scalars (or small arrays,
+    summed elementwise at fold time) and never syncs."""
+
+    def __init__(self, name: str, unit: str = "", help: str = "") -> None:  # noqa: A002
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self._total = 0.0
+        self._pending: list = []
+
+    def inc(self, n=1) -> None:
+        self._pending.append(n)
+
+    def _take_pending(self) -> list:
+        out, self._pending = self._pending, []
+        return out
+
+    def _fold(self, host_values: list) -> None:
+        import numpy as np
+
+        for v in host_values:
+            self._total += float(np.sum(np.asarray(v, dtype=np.float64)))
+
+    def value(self) -> float:
+        """Current total (syncs this counter's own pending only)."""
+        self._fold(_sync_pending({"v": self._take_pending()})["v"])
+        return self._total
+
+    def _snap(self) -> dict:
+        return {"value": self._total, "unit": self.unit}
+
+
+class Gauge:
+    """Last-written value.  ``set`` keeps the raw (possibly device) value;
+    a stacked array (the fused train loop's [n]-step metrics) folds to its
+    last element."""
+
+    def __init__(self, name: str, unit: str = "", help: str = "") -> None:  # noqa: A002
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self._value: float | None = None
+        self._pending: list = []
+
+    def set(self, v) -> None:
+        # keep only the latest raw value; older unsynced writes are dead
+        self._pending = [v]
+
+    def _take_pending(self) -> list:
+        out, self._pending = self._pending, []
+        return out
+
+    def _fold(self, host_values: list) -> None:
+        import numpy as np
+
+        for v in host_values:
+            flat = np.asarray(v, dtype=np.float64).reshape(-1)
+            if flat.size:
+                self._value = float(flat[-1])
+
+    def value(self) -> float | None:
+        self._fold(_sync_pending({"v": self._take_pending()})["v"])
+        return self._value
+
+    def _snap(self) -> dict:
+        return {"value": self._value, "unit": self.unit}
+
+
+class Histogram:
+    """Log-bucketed distribution: value ``v > 0`` lands in bucket
+    ``floor(log(v)/log(growth))`` whose lower bound is ``growth**index``
+    (so recorded values that are exact powers of ``growth`` report EXACT
+    quantiles); ``v <= 0`` lands in a dedicated zero bucket.  Buckets are
+    a sparse ``{index: count}`` map, mergeable across hosts by summing
+    counts (:func:`tpudist.obs.aggregate.merge_snapshots`).
+
+    ``record`` accepts scalars or arrays (host or device) and never syncs;
+    arrays count one observation per element (the fused train loop's
+    stacked [n]-step metrics weigh every step)."""
+
+    def __init__(self, name: str, unit: str = "", help: str = "",  # noqa: A002
+                 growth: float = 2.0) -> None:
+        if growth <= 1.0:
+            raise ValueError(f"histogram growth must be > 1, got {growth}")
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self.growth = growth
+        self._buckets: dict[int, int] = {}
+        self._zero = 0
+        self._count = 0
+        self._sum = 0.0
+        self._min: float | None = None
+        self._max: float | None = None
+        self._pending: list = []
+
+    def record(self, v) -> None:
+        self._pending.append(v)
+
+    def _take_pending(self) -> list:
+        out, self._pending = self._pending, []
+        return out
+
+    def _fold(self, host_values: list) -> None:
+        import numpy as np
+
+        for v in host_values:
+            flat = np.asarray(v, dtype=np.float64).reshape(-1)
+            if not flat.size:
+                continue
+            self._count += int(flat.size)
+            self._sum += float(flat.sum())
+            lo, hi = float(flat.min()), float(flat.max())
+            self._min = lo if self._min is None else min(self._min, lo)
+            self._max = hi if self._max is None else max(self._max, hi)
+            pos = flat[flat > 0]
+            self._zero += int(flat.size - pos.size)
+            if pos.size:
+                # +1e-9 absorbs the float error of log-ratio at exact
+                # bucket boundaries (log(8)/log(2) may be 2.999...96)
+                idx = np.floor(
+                    np.log(pos) / math.log(self.growth) + 1e-9).astype(int)
+                for i, n in zip(*np.unique(idx, return_counts=True)):
+                    self._buckets[int(i)] = (
+                        self._buckets.get(int(i), 0) + int(n))
+
+    def summary(self) -> dict:
+        """p50/p90/p99 + count/sum/mean/min/max (syncs this histogram's
+        own pending only)."""
+        self._fold(_sync_pending({"v": self._take_pending()})["v"])
+        return summarize(self._snap())
+
+    def _snap(self) -> dict:
+        return {
+            "unit": self.unit,
+            "growth": self.growth,
+            "count": self._count,
+            "sum": self._sum,
+            "min": self._min,
+            "max": self._max,
+            "zero": self._zero,
+            # string keys: the snapshot is the JSON wire format
+            "buckets": {str(i): c for i, c in sorted(self._buckets.items())},
+        }
+
+
+def hist_quantile(hist: dict, q: float) -> float:
+    """Nearest-rank quantile from a histogram SNAPSHOT dict: the lower
+    bound of the bucket holding the ceil(q*count)-th smallest observation
+    (0.0 for the zero bucket).  Exact when every recorded value sits on a
+    bucket lower bound — e.g. powers of ``growth``."""
+    count = hist["count"]
+    if count == 0:
+        return float("nan")
+    k = max(1, math.ceil(q * count))
+    cum = hist.get("zero", 0)
+    if k <= cum:
+        return 0.0
+    for idx in sorted(int(i) for i in hist["buckets"]):
+        cum += hist["buckets"][str(idx)]
+        if k <= cum:
+            return float(hist["growth"] ** idx)
+    return float(hist["max"]) if hist["max"] is not None else float("nan")
+
+
+def summarize(hist: dict) -> dict:
+    """Quantile/mean summary of a histogram snapshot dict (works on both
+    per-process and cross-host merged histograms)."""
+    count = hist["count"]
+    out = {
+        "count": count,
+        "sum": hist["sum"],
+        "mean": hist["sum"] / count if count else float("nan"),
+        "min": hist["min"],
+        "max": hist["max"],
+    }
+    for name, q in _QUANTILES:
+        out[name] = hist_quantile(hist, q)
+    return out
+
+
+class MetricRegistry:
+    """Create-once, look-up-forever registry of named metrics.
+
+    ``counter`` / ``gauge`` / ``histogram`` return the existing metric on
+    repeat calls (so instrumentation sites can call them unconditionally);
+    re-registering a name as a DIFFERENT kind raises.  :meth:`snapshot`
+    folds every metric's pending device values in one batched sync and
+    returns the JSON-ready wire dict."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    def _get(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kwargs)
+            elif type(m) is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, unit: str = "", help: str = "") -> Counter:  # noqa: A002
+        return self._get(name, Counter, unit=unit, help=help)
+
+    def gauge(self, name: str, unit: str = "", help: str = "") -> Gauge:  # noqa: A002
+        return self._get(name, Gauge, unit=unit, help=help)
+
+    def histogram(self, name: str, unit: str = "", help: str = "",  # noqa: A002
+                  growth: float = 2.0) -> Histogram:
+        return self._get(name, Histogram, unit=unit, help=help, growth=growth)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return dict(self._metrics)
+
+    def snapshot(self) -> dict:
+        """Fold all pending values (ONE batched device->host sync across
+        every metric) and return the JSON-ready snapshot."""
+        metrics = self.metrics()
+        pending = {name: m._take_pending() for name, m in metrics.items()}
+        host = _sync_pending(pending)
+        snap: dict = {"time": time.time(), "counters": {}, "gauges": {},
+                      "histograms": {}}
+        for name, m in metrics.items():
+            m._fold(host[name])
+            kind = {Counter: "counters", Gauge: "gauges",
+                    Histogram: "histograms"}[type(m)]
+            snap[kind][name] = m._snap()
+        return snap
+
+    def clear(self) -> None:
+        """Drop every metric (tests; a long-lived process keeps its
+        registry for the life of the job)."""
+        with self._lock:
+            self._metrics.clear()

@@ -1,0 +1,137 @@
+"""Span tracer — Chrome-trace-format timelines for host-side phases.
+
+``with tracer.span("train_step"): ...`` records a complete event per exit
+into an in-memory buffer; :meth:`SpanTracer.dump` / :meth:`write` render
+the catapult JSON that chrome://tracing and Perfetto load directly:
+
+    {"traceEvents": [{"name", "ph": "X", "ts", "dur", "pid", "tid",
+                      "args"}, ...], "displayTimeUnit": "ms"}
+
+with ``ts``/``dur`` in microseconds.  Nesting falls out of the format —
+the viewer stacks events on the same tid by containment, and we record a
+``depth`` arg from a per-thread stack for programmatic consumers.
+
+Two accelerator-facing hooks:
+
+* ``fence=True`` (or ``TPUDIST_OBS_FENCE=1``) calls
+  ``jax.effects_barrier()`` on span exit, so asynchronously dispatched
+  device work is attributed to the span that launched it instead of
+  whichever span happens to be open when the queue drains.  Off by
+  default: fencing serializes dispatch and is a measurement tool, not a
+  production default.
+* every span is also wrapped in ``jax.profiler.TraceAnnotation`` when a
+  profiler trace is active, so spans appear as named regions inside the
+  XProf timeline captured by :func:`tpudist.utils.metrics.maybe_profile`.
+
+Spans stay importable and functional without a jax backend: both hooks
+degrade to no-ops when jax (or the annotation API) is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from tpudist.utils.config import env_flag
+
+__all__ = ["SpanTracer"]
+
+
+def _trace_annotation(name: str):
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def _effects_barrier() -> None:
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class SpanTracer:
+    """Per-process span recorder.
+
+    ``max_events`` bounds the buffer (long serving jobs would otherwise
+    grow without limit); overflow increments :attr:`dropped` instead of
+    recording.  Thread-safe: each thread keeps its own nesting stack, the
+    event buffer is lock-guarded.
+    """
+
+    def __init__(self, max_events: int = 100_000,
+                 fence: bool | None = None) -> None:
+        self.max_events = max_events
+        # None -> env-controlled so tests/benches can fence without code
+        self.fence = env_flag("TPUDIST_OBS_FENCE") if fence is None else fence
+        self.dropped = 0
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._pid = os.getpid()
+
+    def _depth(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """Record a complete ("ph": "X") event for the enclosed block.
+        ``args`` must be JSON-serializable; they land in the event's
+        ``args`` field next to the nesting ``depth``."""
+        stack = self._depth()
+        stack.append(name)
+        start = time.perf_counter()
+        try:
+            with _trace_annotation(name):
+                yield self
+        finally:
+            if self.fence:
+                _effects_barrier()
+            dur_us = (time.perf_counter() - start) * 1e6
+            depth = len(stack) - 1
+            stack.pop()
+            event = {
+                "name": name,
+                "ph": "X",
+                # perf_counter origin is arbitrary but shared across the
+                # process, which is all the viewer needs
+                "ts": start * 1e6,
+                "dur": dur_us,
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": {"depth": depth, **args},
+            }
+            with self._lock:
+                if len(self._events) < self.max_events:
+                    self._events.append(event)
+                else:
+                    self.dropped += 1
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self) -> dict:
+        """The Chrome-trace JSON document (catapult "JSON object format")."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.dump(), f)
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0

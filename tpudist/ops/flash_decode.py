@@ -40,20 +40,23 @@ no inference path anywhere); this kernel + the TP rollout in
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpudist.utils.config import env_flag
+
 _NEG_BIG = -1e30
 
 # benchmarking/debug escape to measure the unpaired narrow-head path
-# (normally strictly slower).  Read ONCE at import: jit caches are not
-# keyed on env vars, so a mid-process flip would silently re-time the
-# cached paired executable.
-_DISABLE_PAIRING = bool(os.environ.get("TPUDIST_DISABLE_HEAD_PAIRING"))
+# (normally strictly slower).  Accepted values: 1/true/yes/on disable
+# pairing; unset/empty/0/false/no/off keep it (env_flag — the raw
+# bool(getenv) this replaced treated "=0" as disable).  Read ONCE at
+# import: jit caches are not keyed on env vars, so a mid-process flip
+# would silently re-time the cached paired executable.
+_DISABLE_PAIRING = env_flag("TPUDIST_DISABLE_HEAD_PAIRING")
 
 
 def _decode_kernel(meta_ref, q_ref, k_ref, *rest, scale: float,

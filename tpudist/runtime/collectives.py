@@ -34,6 +34,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from tpudist import obs
 from tpudist.runtime.coord import CoordClient
 
 
@@ -95,6 +96,7 @@ class HostCollectives:
     def _post(self, payload: bytes) -> int:
         op = self._op
         self._op += 1
+        obs.counter("coll/bytes_posted", unit="bytes").inc(len(payload))
         self.client.set(self._key(op, self.rank), payload)
         if op >= 2:  # every peer consumed op-2 before posting op-1
             self.client.delete(self._key(op - 2, self.rank))
@@ -126,6 +128,7 @@ class HostCollectives:
         checksum)."""
         import jax
 
+        obs.counter("coll/allreduce", unit="calls").inc()
         leaves, treedef = jax.tree.flatten(tree)
         np_leaves = [np.asarray(x) for x in leaves]
         op = self._post(_dumps(np_leaves))
@@ -157,6 +160,7 @@ class HostCollectives:
         every peer's op N-1)."""
         import jax
 
+        obs.counter("coll/broadcast", unit="calls").inc()
         leaves, treedef = jax.tree.flatten(tree)
         if self.rank == root:
             self._post(_dumps([np.asarray(x) for x in leaves]))
@@ -171,6 +175,7 @@ class HostCollectives:
 
     def barrier(self, timeout_s: float | None = None) -> None:
         """All-ranks barrier for this round (native store barrier)."""
+        obs.counter("coll/barrier", unit="calls").inc()
         op = self._op
         self._op += 1
         ok = self.client.barrier(

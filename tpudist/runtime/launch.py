@@ -35,6 +35,7 @@ import sys
 import time
 from pathlib import Path
 
+from tpudist import obs
 from tpudist.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -161,13 +162,26 @@ def launch(
                         discover_cmd, world, floor or 1, nprocs)
                 elif floor is not None and blacklist_after is None:
                     world = max(floor, world - 1)
+                obs.counter("launch/restarts").inc()
                 if blacklist_after is not None:
                     now = time.monotonic()
                     for sid, until in list(black_until.items()):
                         if until <= now:   # cooled down: eligible again
                             del black_until[sid]
                             fail_counts.pop(sid, None)
-                            roster.append(sid)
+                            # Rejoin AHEAD of synthetic replacement slots
+                            # (sids >= nprocs): the scheduled set is
+                            # roster[:world], so a tail append would park
+                            # the recovered slot behind the fresh sids
+                            # that replaced it — cooled down yet never
+                            # scheduled again.  Original slots keep their
+                            # relative order; replacements only fill
+                            # whatever room is left.
+                            insert_at = next(
+                                (i for i, s in enumerate(roster)
+                                 if s >= nprocs), len(roster))
+                            roster.insert(insert_at, sid)
+                            obs.counter("launch/blacklist_recovered").inc()
                     for sid in list(roster):
                         if fail_counts.get(sid, 0) >= blacklist_after:
                             black_until[sid] = (
@@ -175,6 +189,7 @@ def launch(
                                 if blacklist_cooldown is not None
                                 else float("inf"))
                             roster.remove(sid)
+                            obs.counter("launch/blacklisted").inc()
                             log.warning(
                                 "spawn id %d blacklisted after %d failed "
                                 "attempts%s", sid, fail_counts[sid],
@@ -218,6 +233,7 @@ def launch(
                 for sid, code in zip(ids, codes):
                     if code not in (0, -signal.SIGTERM):
                         fail_counts[sid] = fail_counts.get(sid, 0) + 1
+                        obs.counter("launch/worker_failures").inc()
             if elastic_inprocess:
                 if sum(c == 0 for c in codes) >= (floor or 1):
                     return 0

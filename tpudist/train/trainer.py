@@ -18,11 +18,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable
 
+import time
+
 import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from tpudist import obs
 from tpudist.data.loader import ShardedLoader
 from tpudist.elastic.checkpoint import restore_pytree, save_pytree
 from tpudist.ops.losses import cross_entropy
@@ -114,6 +117,17 @@ class Trainer:
         self.eval_step = make_dp_masked_eval_step(dp_predict, mesh)
         self.metrics = MetricLogger()
         self.throughput = ThroughputMeter(warmup_steps=2)
+        # obs handles cached once: the hot loop touches them by attribute,
+        # not by registry lookup.  Recording stays lazy — the loss gauge
+        # takes the device array as-is; counters take host ints; the
+        # step-time histogram takes host floats — so nothing here adds a
+        # sync to the step path (snapshot() pays the one batched sync).
+        self._obs_steps = obs.counter("train/steps", unit="steps")
+        self._obs_examples = obs.counter("train/examples", unit="examples")
+        self._obs_epochs = obs.counter("train/epochs", unit="epochs")
+        self._obs_loss = obs.gauge("train/loss")
+        self._obs_tput = obs.gauge("train/images_per_sec", unit="img/s")
+        self._obs_step_time = obs.histogram("train/step_time", unit="s")
 
     # -- snapshotting (`_save_snapshot`/`_load_snapshot` parity, with full state)
 
@@ -164,22 +178,37 @@ class Trainer:
             start_step = groups * n
             for g, batch in enumerate(
                     self.train_loader.epoch_stacked(epoch, n)):
-                self.state, metrics = self.train_loop(self.state, *batch)
+                t0 = time.perf_counter()
+                with obs.span("train_dispatch", steps=n):
+                    self.state, metrics = self.train_loop(self.state, *batch)
                 # stacked [n] metrics accumulate lazily; MetricLogger
                 # weights every optimizer step equally
                 self.metrics.update(**metrics)
                 self.throughput.step(n * self.train_loader.global_batch)
+                # the loss gauge keeps the stacked DEVICE array; its last
+                # element is folded out at snapshot time, never here
+                self._obs_loss.set(metrics["loss"])
+                self._obs_steps.inc(n)
+                self._obs_examples.inc(n * self.train_loader.global_batch)
+                self._obs_step_time.record((time.perf_counter() - t0) / n)
                 if (g * n) % self.config.log_every < n:
                     log.info("epoch %d step %d loss %.4f", epoch,
                              g * n + n - 1, float(metrics["loss"][-1]))
         for step, batch in enumerate(
                 self.train_loader.epoch(epoch, start_step=start_step),
                 start=start_step):
-            self.state, metrics = self.train_step(self.state, *batch)
+            t0 = time.perf_counter()
+            with obs.span("train_step", step=step):
+                self.state, metrics = self.train_step(self.state, *batch)
             # device scalars accumulate lazily; the host sync happens once per
             # epoch (and at log points), not per step
             self.metrics.update(**metrics)
             self.throughput.step(self.train_loader.global_batch)
+            self._obs_loss.set(metrics["loss"])
+            self._obs_steps.inc()
+            self._obs_examples.inc(self.train_loader.global_batch)
+            # dispatch time unless TPUDIST_OBS_FENCE=1 makes spans fence
+            self._obs_step_time.record(time.perf_counter() - t0)
             if step % self.config.log_every == 0:
                 log.info(
                     "epoch %d step %d loss %.4f", epoch, step, float(metrics["loss"])
@@ -193,7 +222,11 @@ class Trainer:
         for epoch in range(start_epoch, max_epochs):
             profiling = self.config.profile_dir and epoch == start_epoch
             with maybe_profile(self.config.profile_dir if profiling else None):
-                epoch_metrics = self._run_epoch(epoch)
+                # obs spans nest inside the XProf trace (TraceAnnotation)
+                with obs.span("train_epoch", epoch=epoch):
+                    epoch_metrics = self._run_epoch(epoch)
+            self._obs_epochs.inc()
+            self._obs_tput.set(self.throughput.items_per_sec)
             summary = {"epoch": epoch, **epoch_metrics}
             if self.config.eval_every_epoch and self.test_loader is not None:
                 summary["test_accuracy"] = self.test()

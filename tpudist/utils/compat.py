@@ -1,0 +1,81 @@
+"""JAX version compatibility shims.
+
+tpudist targets the current jax API surface; this module backfills the
+handful of renamed symbols so the same code runs on the older runtimes
+some images ship (observed: jax 0.4.37).  Installed once from
+``tpudist/__init__`` before any kernel or parallel module loads:
+
+* ``jax.shard_map`` — promoted from ``jax.experimental.shard_map`` in
+  newer jax; the old entry point also spells ``check_vma`` as
+  ``check_rep``, so the shim renames that kwarg.
+* ``jax.experimental.pallas.tpu.CompilerParams`` — the old name is
+  ``TPUCompilerParams`` (same dataclass fields).
+* ``jax.tree.leaves_with_path`` — old home:
+  ``jax.tree_util.tree_leaves_with_path``.
+* ``jax.lax.axis_size`` — on old jax the static mapped-axis size is what
+  ``jax.core.axis_frame(name)`` returns.
+
+Each shim is installed only when the modern name is missing, so on a
+current jax this module is a no-op.  Installation failures are swallowed:
+a partially available jax (or none at all, for host-only tools) must not
+break ``import tpudist``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["install_jax_compat"]
+
+
+def install_jax_compat() -> None:
+    try:
+        import jax
+    except Exception:
+        return
+
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            @functools.wraps(_shard_map)
+            def shard_map(f, *args, **kwargs):
+                if "check_vma" in kwargs:
+                    kwargs["check_rep"] = kwargs.pop("check_vma")
+                return _shard_map(f, *args, **kwargs)
+
+            jax.shard_map = shard_map
+        except Exception:
+            pass
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:
+        pass
+
+    if not hasattr(jax.lax, "axis_size"):
+        try:
+            from jax import core as _core
+
+            def axis_size(axis_name):
+                if isinstance(axis_name, (tuple, list)):
+                    n = 1
+                    for a in axis_name:
+                        n *= _core.axis_frame(a)
+                    return n
+                return _core.axis_frame(axis_name)
+
+            jax.lax.axis_size = axis_size
+        except Exception:
+            pass
+
+    try:
+        if not hasattr(jax.tree, "leaves_with_path"):
+            from jax import tree_util
+
+            jax.tree.leaves_with_path = tree_util.tree_leaves_with_path
+    except Exception:
+        pass

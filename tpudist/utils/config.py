@@ -10,9 +10,25 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from typing import Any, Sequence, Type, TypeVar
 
 T = TypeVar("T")
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse a boolean environment variable the way users expect: unset
+    (or empty) means ``default``; ``0`` / ``false`` / ``no`` / ``off``
+    (any case) mean False; anything else means True.  The shared parser
+    for every TPUDIST_* knob — ``bool(os.environ.get(...))`` treats
+    ``=0`` as *enabled*, which is how `TPUDIST_DISABLE_HEAD_PAIRING=0`
+    used to disable head pairing."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in _FALSY
 
 
 def config_field(default: Any, help: str = "") -> Any:  # noqa: A002 - argparse parlance
