@@ -30,16 +30,8 @@ import json
 import time
 from pathlib import Path
 
-# bf16 peak TFLOP/s per chip, by jax device_kind
-_PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,   # v5e
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,        # v5p
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,   # Trillium
-    "TPU v6e": 918.0,
-}
+# the peak-TFLOPS table lives with the live MFU gauge now; bench reads
+# the same numbers through tpudist.obs.xla instead of keeping a copy
 
 
 _EMITTED: list[dict] = []  # every metric line, re-printed in the recap
@@ -72,16 +64,15 @@ def _recap() -> None:
 
 
 def _peak_tflops() -> float | None:
-    import jax
+    from tpudist.obs.xla import peak_tflops
 
-    return _PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    return peak_tflops()
 
 
 def _mfu(tflops: float | None) -> float | None:
-    peak = _peak_tflops()
-    if peak is None or tflops is None:
-        return None
-    return round(tflops / peak, 4)
+    from tpudist.obs.xla import mfu
+
+    return mfu(tflops)
 
 
 def _best_window(run_once, n_windows: int, sync) -> float:

@@ -133,7 +133,9 @@ def main() -> None:
 
     enable_compilation_cache()
     assert jax.default_backend() == "tpu", "sweep needs the real chip"
-    peak = 197.0  # v5e bf16
+    from tpudist.obs.xla import peak_tflops
+
+    peak = peak_tflops() or 197.0  # fall back to v5e bf16 if unknown kind
     rtt = _rtt()
     print(json.dumps({"rtt_ms": round(rtt * 1e3, 1)}), flush=True)
 

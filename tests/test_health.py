@@ -1,0 +1,272 @@
+"""Health plane — straggler/stale/lost classification with hysteresis,
+the /healthz liveness endpoint, and the background watcher.
+
+The acceptance contract (ISSUE 2): an injected straggler (one rank whose
+steps sleep longer) is classified ``straggler`` within 3 publish
+intervals of the real coord/KV publish path, and recovers to ``healthy``
+through the hysteresis window once its step time normalizes."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpudist import obs
+from tpudist.obs.health import HealthMonitor, STATES
+
+
+def _coord_pair():
+    try:
+        from tpudist.runtime.coord import CoordClient, CoordServer
+
+        server = CoordServer(0)
+    except Exception as e:  # NativeUnavailable or build failure
+        pytest.skip(f"native coord store unavailable: {e}")
+    return server, CoordClient("127.0.0.1", server.port)
+
+
+def _snap(step_times, t):
+    """A minimal published snapshot: a train/step_time histogram holding
+    ``step_times`` cumulatively, stamped ``published_at=t``."""
+    reg = obs.MetricRegistry()
+    h = reg.histogram("train/step_time", unit="s")
+    if step_times:
+        h.record(list(step_times))
+    snap = reg.snapshot()
+    snap["published_at"] = t
+    return snap
+
+
+class TestClassification:
+    def test_straggler_enters_with_confirmation_and_recovers(self):
+        mon = HealthMonitor(skew_threshold=2.0, confirm_n=2, recover_n=2,
+                            registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        t0 = time.time()
+        fast = {r: [] for r in range(4)}
+        # three observation rounds: rank 3 runs 10x slower per step
+        for rnd in range(3):
+            snaps = {}
+            for r in range(4):
+                fast[r] += [0.01] * 5 if r != 3 else [0.1] * 5
+                snaps[r] = _snap(fast[r], t0 + rnd)
+            v = mon.observe(snaps, now=t0 + rnd)
+        assert v["ranks"]["3"]["state"] == "straggler"
+        assert v["stragglers"] == ["3"]
+        assert v["status"] == "degraded"
+        assert all(v["ranks"][str(r)]["state"] == "healthy"
+                   for r in range(3))
+        # skew is measured: ~10x the median
+        assert v["ranks"]["3"]["skew"] > 5
+
+        # recovery takes recover_n consecutive clean rounds — one is not
+        # enough (hysteresis), the second flips it back
+        for rnd in range(3, 5):
+            snaps = {}
+            for r in range(4):
+                fast[r] += [0.01] * 5
+                snaps[r] = _snap(fast[r], t0 + rnd)
+            v = mon.observe(snaps, now=t0 + rnd)
+            if rnd == 3:
+                assert v["ranks"]["3"]["state"] == "straggler"
+        assert v["ranks"]["3"]["state"] == "healthy"
+        assert v["status"] == "healthy"
+
+    def test_one_slow_round_does_not_flap(self):
+        mon = HealthMonitor(confirm_n=2, recover_n=1,
+                            registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        t0 = time.time()
+        hist = {0: [], 1: [], 2: []}
+        # round 0: all fast; round 1: rank 1 slow ONCE; round 2: fast
+        for rnd, slow in enumerate((False, True, False)):
+            for r in hist:
+                hist[r] += [0.1] * 3 if (slow and r == 1) else [0.01] * 3
+            v = mon.observe({r: _snap(hist[r], t0 + rnd) for r in hist},
+                            now=t0 + rnd)
+        # confirm_n=2 means the single bad round never promoted to
+        # straggler — the GC-pause case
+        assert v["ranks"]["1"]["state"] == "healthy"
+        assert mon.verdict()["status"] == "healthy"
+
+    def test_stale_and_lost_from_publish_age(self):
+        mon = HealthMonitor(stale_after_s=10.0, lost_after_s=60.0,
+                            registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        t0 = time.time()
+        fresh = _snap([0.01] * 3, t0)
+        old = _snap([0.01] * 3, t0 - 30)     # 30s old -> stale
+        dead = _snap([0.01] * 3, t0 - 120)   # 120s old -> lost
+        v = mon.observe({0: fresh, 1: old, 2: dead}, now=t0)
+        assert v["ranks"]["0"]["state"] == "healthy"
+        assert v["ranks"]["1"]["state"] == "stale"   # immediate, measured
+        assert v["ranks"]["2"]["state"] == "lost"
+        assert v["stale"] == ["1"] and v["lost"] == ["2"]
+
+    def test_vanished_rank_goes_lost(self):
+        mon = HealthMonitor(registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        t0 = time.time()
+        mon.observe({0: _snap([0.01], t0), 1: _snap([0.01], t0)}, now=t0)
+        # rank 1's key disappears from the store entirely
+        v = mon.observe({0: _snap([0.01, 0.01], t0 + 1)}, now=t0 + 1)
+        assert v["ranks"]["1"]["state"] == "lost"
+
+    def test_transitions_emit_counters_and_recorder_events(self):
+        reg = obs.MetricRegistry()
+        rec = obs.FlightRecorder()
+        mon = HealthMonitor(confirm_n=1, registry=reg, recorder=rec)
+        t0 = time.time()
+        mon.observe({r: _snap([0.01] * 3, t0) for r in range(3)}, now=t0)
+        v = mon.observe({0: _snap([0.01] * 6, t0 + 1),
+                         1: _snap([0.01] * 3 + [0.5] * 3, t0 + 1),
+                         2: _snap([0.01] * 6, t0 + 1)},
+                        now=t0 + 1)
+        assert v["transitions"] == [
+            {"rank": 1, "from": "healthy", "to": "straggler"}]
+        snap = reg.snapshot()
+        assert snap["counters"]["health/transitions"]["value"] == 1
+        assert snap["gauges"]["health/ranks_straggler"]["value"] == 1
+        assert snap["gauges"]["health/degraded"]["value"] == 1
+        kinds = [e["kind"] for e in rec.events()]
+        assert "health_transition" in kinds
+
+    def test_restarted_rank_counter_regression_no_false_positive(self):
+        mon = HealthMonitor(confirm_n=1, registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        t0 = time.time()
+        mon.observe({0: _snap([0.01] * 50, t0),
+                     1: _snap([0.01] * 50, t0)}, now=t0)
+        # rank 1 restarted: its histogram begins again from zero —
+        # deltas would be negative; the monitor uses the full new totals
+        v = mon.observe({0: _snap([0.01] * 60, t0 + 1),
+                         1: _snap([0.01] * 5, t0 + 1)}, now=t0 + 1)
+        assert v["ranks"]["1"]["state"] == "healthy"
+
+    def test_parameter_validation_and_describe(self):
+        with pytest.raises(ValueError, match="skew_threshold"):
+            HealthMonitor(skew_threshold=1.0)
+        with pytest.raises(ValueError, match="confirm_n"):
+            HealthMonitor(confirm_n=0)
+        mon = HealthMonitor(registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        assert "no observations" in mon.describe()
+        with pytest.raises(ValueError, match="coord client"):
+            mon.update()
+        t0 = time.time()
+        mon.observe({0: _snap([0.01], t0)}, now=t0)
+        assert "1 ranks healthy" in mon.describe()
+        assert set(STATES) == {"healthy", "straggler", "stale", "lost"}
+
+
+class TestOverStore:
+    def test_injected_straggler_detected_within_three_publishes(self):
+        """The acceptance path: real publishers over the real KV store,
+        one rank's steps sleep longer; classified within 3 publish
+        intervals, recovers with hysteresis after normalizing."""
+        server, client = _coord_pair()
+        try:
+            regs = {r: obs.MetricRegistry() for r in range(3)}
+            pubs = {r: obs.MetricsPublisher(client, r, regs[r])
+                    for r in range(3)}
+            mon = HealthMonitor(client=client, skew_threshold=2.0,
+                                confirm_n=2, recover_n=2,
+                                registry=obs.MetricRegistry(),
+                                recorder=obs.FlightRecorder())
+
+            def interval(slow_rank=None):
+                for r, reg in regs.items():
+                    h = reg.histogram("train/step_time", unit="s")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        time.sleep(0.03 if r == slow_rank else 0.002)
+                        h.record(time.perf_counter() - t0)
+                    pubs[r].publish()
+                return mon.update()
+
+            verdicts = [interval(slow_rank=2) for _ in range(3)]
+            assert verdicts[-1]["ranks"]["2"]["state"] == "straggler", \
+                verdicts
+            assert verdicts[-1]["status"] == "degraded"
+            # normalize rank 2; recover_n=2 clean intervals heal it
+            v = None
+            for _ in range(2):
+                v = interval(slow_rank=None)
+            assert v["ranks"]["2"]["state"] == "healthy"
+            assert v["status"] == "healthy"
+        finally:
+            client.close()
+            server.stop()
+
+    def test_health_watcher_background_updates(self):
+        server, client = _coord_pair()
+        try:
+            from tpudist.obs.health import HealthWatcher
+
+            reg = obs.MetricRegistry()
+            reg.histogram("train/step_time", unit="s").record([0.01] * 3)
+            obs.MetricsPublisher(client, 0, reg).publish()
+            watcher = HealthWatcher(f"127.0.0.1:{server.port}",
+                                    interval_s=0.05,
+                                    registry=obs.MetricRegistry(),
+                                    recorder=obs.FlightRecorder())
+            try:
+                deadline = time.monotonic() + 5.0
+                while (watcher.verdict()["status"] == "unknown"
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert watcher.verdict()["status"] == "healthy"
+                assert "healthy" in watcher.describe()
+            finally:
+                watcher.stop()
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestHealthz:
+    def test_healthz_200_healthy_503_degraded(self):
+        mon = HealthMonitor(confirm_n=1, registry=obs.MetricRegistry(),
+                            recorder=obs.FlightRecorder())
+        srv = obs.MetricsServer(registry=obs.MetricRegistry(),
+                                health_fn=mon.verdict)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            # no observations yet -> "unknown" is NOT degraded: probes
+            # must not kill a job that simply hasn't published yet
+            resp = urllib.request.urlopen(base + "/healthz")
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "unknown"
+
+            t0 = time.time()
+            mon.observe({0: _snap([0.01] * 3, t0),
+                         2: _snap([0.01] * 3, t0)}, now=t0)
+            resp = urllib.request.urlopen(base + "/healthz")
+            assert json.loads(resp.read())["status"] == "healthy"
+
+            mon.observe({0: _snap([0.01] * 6, t0 + 1),
+                         1: _snap([0.5] * 3, t0 + 1),
+                         2: _snap([0.01] * 6, t0 + 1)}, now=t0 + 1)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + "/healthz")
+            assert err.value.code == 503
+            doc = json.loads(err.value.read())
+            assert doc["status"] == "degraded"
+            assert doc["stragglers"] == ["1"]
+        finally:
+            srv.close()
+
+    def test_unknown_path_404_with_endpoint_listing(self):
+        srv = obs.MetricsServer(registry=obs.MetricRegistry())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/not-a-path")
+            assert err.value.code == 404
+            doc = json.loads(err.value.read())
+            assert "/metrics" in doc["paths"]
+            assert "/healthz" in doc["paths"]
+        finally:
+            srv.close()

@@ -1,0 +1,167 @@
+"""Flight recorder — ring bounds, bundle contents, the guard contract,
+and the acceptance path: a forced trainer crash produces a post-mortem
+bundle holding the last-N step ring, the final registry snapshot, and
+non-empty HLO text (ISSUE 2)."""
+
+import json
+
+import pytest
+
+from tpudist import obs
+from tpudist.obs.recorder import POSTMORTEM_SCHEMA, FlightRecorder
+
+
+class TestRing:
+    def test_bounded_keeps_newest_counts_dropped(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(7):
+            rec.record("tick", i=i)
+        events = rec.events()
+        assert [e["i"] for e in events] == [4, 5, 6]  # the NEWEST survive
+        assert rec.dropped == 4
+        rec.clear()
+        assert rec.events() == [] and rec.dropped == 0
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            FlightRecorder(capacity=0)
+
+    def test_note_hlo_keeps_last_nonempty(self):
+        rec = FlightRecorder()
+        rec.note_hlo("HloModule a")
+        rec.note_hlo(None)      # a failed render must not wipe the stash
+        rec.note_hlo("")
+        assert rec.last_hlo == "HloModule a"
+        rec.note_hlo("HloModule b")
+        assert rec.last_hlo == "HloModule b"
+
+
+class TestBundle:
+    def test_bundle_schema_and_exception_doc(self):
+        reg = obs.MetricRegistry()
+        reg.counter("steps").inc(5)
+        tracer = obs.SpanTracer()
+        with tracer.span("phase"):
+            pass
+        rec = FlightRecorder(capacity=4, registry=reg, tracer=tracer)
+        rec.record("tick", i=1)
+        rec.note_hlo("HloModule m")
+        try:
+            raise RuntimeError("boom with detail")
+        except RuntimeError as e:
+            doc = rec.bundle(exc=e, context={"component": "test"})
+        assert doc["schema"] == POSTMORTEM_SCHEMA
+        assert doc["exception"]["type"] == "RuntimeError"
+        assert "boom with detail" in doc["exception"]["message"]
+        assert "RuntimeError" in doc["exception"]["traceback"]
+        assert doc["context"] == {"component": "test"}
+        assert doc["events"][0]["kind"] == "tick"
+        assert doc["snapshot"]["counters"]["steps"]["value"] == 5
+        assert [s["name"] for s in doc["spans"]] == ["phase"]
+        assert doc["last_hlo"] == "HloModule m"
+        json.dumps(doc)  # the whole bundle must be JSON-serializable
+
+    def test_env_capture_is_prefix_filtered(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_TEST_KNOB", "1")
+        monkeypatch.setenv("SECRET_TOKEN", "hunter2")
+        doc = FlightRecorder().bundle()
+        assert doc["env"]["TPUDIST_TEST_KNOB"] == "1"
+        assert "SECRET_TOKEN" not in doc["env"]
+
+    def test_snapshot_degrades_when_registry_raises(self):
+        class Broken:
+            def snapshot(self):
+                raise RuntimeError("backend torn down")
+
+            def metrics(self):
+                return {}
+
+        doc = FlightRecorder(registry=Broken()).bundle()
+        assert "backend torn down" in doc["snapshot"]["degraded"]
+
+    def test_dump_writes_file_honoring_env_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDIST_POSTMORTEM_DIR", str(tmp_path / "pm"))
+        rec = FlightRecorder()
+        rec.record("tick", i=1)
+        path = rec.dump()
+        assert path.startswith(str(tmp_path / "pm"))
+        assert rec.last_dump_path == path
+        doc = json.loads(open(path).read())
+        assert doc["schema"] == POSTMORTEM_SCHEMA
+        assert doc["exception"] is None
+        assert doc["events"] == [
+            {"t": doc["events"][0]["t"], "kind": "tick", "i": 1}]
+
+
+class TestGuard:
+    def test_guard_dumps_and_reraises(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path))
+        with pytest.raises(ValueError, match="intentional"):
+            with rec.guard("unit", run="r1"):
+                rec.record("about_to_fail")
+                raise ValueError("intentional")
+        assert rec.last_dump_path is not None
+        doc = json.loads(open(rec.last_dump_path).read())
+        assert doc["exception"]["type"] == "ValueError"
+        assert doc["context"] == {"component": "unit", "run": "r1"}
+        assert [e["kind"] for e in doc["events"]] == ["about_to_fail"]
+
+    def test_guard_noop_on_success(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path))
+        with rec.guard("unit"):
+            pass
+        assert rec.last_dump_path is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_dump_failure_never_masks_original(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path / "file-not-dir"))
+        (tmp_path / "file-not-dir").write_text("occupied")
+        with pytest.raises(RuntimeError, match="the real error"):
+            with rec.guard("unit"):
+                raise RuntimeError("the real error")
+
+
+class TestTrainerCrash:
+    def test_forced_crash_dumps_ring_snapshot_and_hlo(
+            self, tmp_path, monkeypatch):
+        """The acceptance criterion: crash the trainer mid-epoch; the
+        bundle must hold the recent step ring, the final registry
+        snapshot, and non-empty HLO text from the cost probe."""
+        from test_trainer import _make_trainer
+
+        monkeypatch.setenv("TPUDIST_POSTMORTEM_DIR", str(tmp_path / "pm"))
+        trainer, _ = _make_trainer(tmp_path, epochs=1, n=512)
+        trainer.config.log_every = 1  # every completed step into the ring
+        real_step = trainer.train_step
+        calls = {"n": 0}
+
+        def flaky(state, *batch):
+            calls["n"] += 1
+            if calls["n"] > 2:  # a couple of real steps land in the ring
+                raise RuntimeError("injected mid-epoch crash")
+            return real_step(state, *batch)
+
+        flaky.lower = real_step.lower
+        trainer.train_step = flaky
+
+        obs.recorder.clear()
+        with pytest.raises(RuntimeError, match="injected"):
+            trainer.train()
+
+        bundles = list((tmp_path / "pm").glob("postmortem-*.json"))
+        assert len(bundles) == 1
+        doc = json.loads(bundles[0].read_text())
+        assert doc["schema"] == POSTMORTEM_SCHEMA
+        assert doc["exception"]["type"] == "RuntimeError"
+        assert doc["context"]["component"] == "trainer"
+        # the last-N step ring: log_every=1 put each completed step there
+        train_logs = [e for e in doc["events"] if e["kind"] == "train_log"]
+        assert len(train_logs) >= 2
+        assert all("loss" in e and "step" in e for e in train_logs)
+        # the final registry snapshot, with the steps that actually ran
+        assert doc["snapshot"]["counters"]["train/steps"]["value"] >= 2
+        assert "train/step_time" in doc["snapshot"]["histograms"]
+        # non-empty HLO text from the one-time cost probe
+        assert doc["last_hlo"] and "HloModule" in doc["last_hlo"]
+        # topology is present (jax is live in-process)
+        assert doc["topology"]["device_count"] >= 1

@@ -209,6 +209,8 @@ def run_elastic_worker(
                 continue
             obs.counter("elastic/rounds").inc()
             obs.gauge("elastic/world_size", unit="workers").set(world)
+            obs.recorder.record("elastic_round", round=round_id, rank=rank,
+                                world=world)
             monitor.resize(world)
             if rank == 0:
                 # publish forward only: a lagging splinter round must never
@@ -316,6 +318,8 @@ def run_elastic_worker(
                 return state
             except WorldChanged as e:
                 obs.counter("elastic/world_changed").inc()
+                obs.recorder.record("world_changed", round=round_id,
+                                    old=world, new=e.new_world_size)
                 rounds += 1
                 if rounds > max_rounds:
                     raise
@@ -346,6 +350,8 @@ def run_elastic_worker(
                 if not peerish:
                     raise
                 obs.counter("elastic/peer_lost").inc()
+                obs.recorder.record("peer_lost", round=round_id,
+                                    error=str(e)[:200])
                 rounds += 1
                 if rounds > max_rounds:
                     raise
@@ -367,6 +373,20 @@ def run_elastic_worker(
                 mesh = None  # the Mesh itself pins the dead world's client
                 round_id, min_world = recover(e, live)
                 data_coll = coll
+    except BaseException as e:
+        # the flight-recorder contract: an exception that escapes the
+        # elastic supervision (max_rounds exhausted, a real bug) dumps a
+        # post-mortem bundle before propagating; a failing dump must
+        # never mask the original exception
+        try:
+            path = obs.recorder.dump(exc=e, context={
+                "component": "elastic_worker", "worker": wid,
+                "round": round_id, "rounds_survived": rounds})
+            log.error("elastic worker crashed (%s: %s); post-mortem "
+                      "bundle: %s", type(e).__name__, str(e)[:200], path)
+        except Exception:  # noqa: BLE001
+            pass
+        raise
     finally:
         if ici is not None:
             try:

@@ -463,27 +463,35 @@ class ServeLoop:
                     finalize(slot, "length")
                     return
 
-        while pending or any(s is not None for s in slot_state):
-            for slot in range(self.B):
-                if slot_state[slot] is None and pending:
-                    with obs.span("serve/admit", slot=slot):
-                        slot_state[slot] = self._admit(
-                            slot, pending.popleft())
-                    # stamped here, not in _admit: benches wrap
-                    # loop._admit, and latency must cover the wrapper too
-                    slot_state[slot]["t_admit"] = time.perf_counter()
-                    self._obs_requests.inc()
-            self._obs_queue.set(len(pending))
-            # the segment splits per-step keys and returns the advanced
-            # key — no per-wave host-side split dispatch needed
-            with obs.span("serve/segment", steps=self.steps):
-                (self.cache, self._tok, self._active, self._remaining,
-                 self._key, emits) = self._segment(
-                    self.params, self.cache, self._tok, self._active,
-                    self._remaining, self._first, self._key)
-            self._obs_segments.inc()
-            emits = np.asarray(emits)       # the one host sync per segment
-            for slot in range(self.B):
-                if slot_state[slot] is not None:
-                    drain(slot, emits[slot])
+        # an unhandled exception mid-serve dumps the flight-recorder
+        # bundle (admission ring, final snapshot) before propagating
+        with obs.recorder.guard("serve_loop", num_slots=self.B,
+                                requests=len(requests)):
+            while pending or any(s is not None for s in slot_state):
+                for slot in range(self.B):
+                    if slot_state[slot] is None and pending:
+                        req = pending.popleft()
+                        with obs.span("serve/admit", slot=slot):
+                            slot_state[slot] = self._admit(slot, req)
+                        # stamped here, not in _admit: benches wrap
+                        # loop._admit, and latency must cover the wrapper
+                        slot_state[slot]["t_admit"] = time.perf_counter()
+                        self._obs_requests.inc()
+                        obs.recorder.record(
+                            "serve_admit", slot=slot,
+                            prompt_len=int(np.asarray(req.prompt).size),
+                            max_new=req.max_new_tokens)
+                self._obs_queue.set(len(pending))
+                # the segment splits per-step keys and returns the advanced
+                # key — no per-wave host-side split dispatch needed
+                with obs.span("serve/segment", steps=self.steps):
+                    (self.cache, self._tok, self._active, self._remaining,
+                     self._key, emits) = self._segment(
+                        self.params, self.cache, self._tok, self._active,
+                        self._remaining, self._first, self._key)
+                self._obs_segments.inc()
+                emits = np.asarray(emits)   # the one host sync per segment
+                for slot in range(self.B):
+                    if slot_state[slot] is not None:
+                        drain(slot, emits[slot])
         return done

@@ -1,5 +1,5 @@
 """tpudist.obs — distributed observability: metrics, spans, aggregation,
-exporters.
+exporters, and the health plane.
 
 The subsystem every layer reports through (see docs/OBSERVABILITY.md):
 
@@ -12,13 +12,21 @@ The subsystem every layer reports through (see docs/OBSERVABILITY.md):
 * :mod:`tpudist.obs.aggregate` — workers publish snapshots through the
   coord KV store; rank 0 merges them into a cluster view.
 * :mod:`tpudist.obs.export` — bench-schema JSONL, Prometheus text, and a
-  stdlib-only HTTP ``/metrics`` endpoint.
+  stdlib-only HTTP ``/metrics`` + ``/healthz`` endpoint.
+* :mod:`tpudist.obs.health` — rank-0 straggler/staleness classification
+  over the published snapshots, with hysteresis.
+* :mod:`tpudist.obs.recorder` — bounded flight-recorder ring and crash
+  post-mortem bundles (``with obs.recorder.guard("trainer"): ...``).
+* :mod:`tpudist.obs.xla` — XLA compile/memory/cost telemetry: compile
+  counts and durations, per-device HBM gauges, live MFU.
 
-Module-level conveniences bind to one process-global registry and tracer,
-so library code can just ``from tpudist import obs; obs.counter(...)``.
-Env knobs (parsed by :func:`tpudist.utils.config.env_flag`, so ``=0`` and
-``=false`` really mean off): ``TPUDIST_OBS_FENCE`` fences spans with
-``jax.effects_barrier()``.
+Module-level conveniences bind to one process-global registry, tracer and
+flight recorder, so library code can just ``from tpudist import obs;
+obs.counter(...)``.  Env knobs (parsed by
+:func:`tpudist.utils.config.env_flag`, so ``=0`` and ``=false`` really
+mean off): ``TPUDIST_OBS_FENCE`` fences spans with
+``jax.effects_barrier()``; ``TPUDIST_POSTMORTEM_DIR`` picks where crash
+bundles land.
 """
 
 from __future__ import annotations
@@ -35,6 +43,8 @@ from tpudist.obs.export import (
     snapshot_to_jsonl,
     to_prometheus,
 )
+from tpudist.obs.health import HealthMonitor, HealthWatcher
+from tpudist.obs.recorder import POSTMORTEM_SCHEMA, FlightRecorder
 from tpudist.obs.registry import (
     Counter,
     Gauge,
@@ -44,14 +54,26 @@ from tpudist.obs.registry import (
     summarize,
 )
 from tpudist.obs.spans import SpanTracer
+from tpudist.obs.xla import (
+    install_compile_telemetry,
+    mfu,
+    note_compile,
+    note_step,
+    peak_tflops,
+    update_memory_gauges,
+)
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
+    "HealthMonitor",
+    "HealthWatcher",
     "Histogram",
     "MetricRegistry",
     "MetricsPublisher",
     "MetricsServer",
+    "POSTMORTEM_SCHEMA",
     "SpanTracer",
     "collect",
     "collect_and_merge",
@@ -59,8 +81,14 @@ __all__ = [
     "gauge",
     "histogram",
     "hist_quantile",
+    "install_compile_telemetry",
     "jsonl_line",
     "merge_snapshots",
+    "mfu",
+    "note_compile",
+    "note_step",
+    "peak_tflops",
+    "recorder",
     "registry",
     "snapshot",
     "snapshot_to_jsonl",
@@ -68,12 +96,15 @@ __all__ = [
     "summarize",
     "to_prometheus",
     "tracer",
+    "update_memory_gauges",
 ]
 
-# process-global registry + tracer: instrumentation all over the stack
-# reports here, snapshot()/tracer.dump() read it out
+# process-global registry + tracer + flight recorder: instrumentation all
+# over the stack reports here; snapshot()/tracer.dump()/recorder.dump()
+# read it out
 registry = MetricRegistry()
 tracer = SpanTracer()
+recorder = FlightRecorder(registry=registry, tracer=tracer)
 
 counter = registry.counter
 gauge = registry.gauge

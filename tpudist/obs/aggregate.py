@@ -56,6 +56,10 @@ class MetricsPublisher:
     def publish(self, client=None) -> dict:
         snap = self._registry.snapshot()
         snap["rank"] = self._rank
+        # the staleness stamp: collect() ages each snapshot off this, so
+        # consumers can drop (or the health plane can flag) leftovers
+        # from ranks that died in a previous elastic round
+        snap["published_at"] = time.time()
         (client or self._client).set(
             self.key, json.dumps(snap).encode("utf-8"))
         return snap
@@ -94,16 +98,31 @@ class MetricsPublisher:
                 pass
 
 
-def collect(client, namespace: str = DEFAULT_NAMESPACE) -> dict[int, dict]:
+def collect(client, namespace: str = DEFAULT_NAMESPACE,
+            max_age_s: float | None = None) -> dict[int, dict]:
     """Fetch every published snapshot: {rank: snapshot}.  Keys listed but
-    deleted between list and get (a departing worker) are skipped."""
+    deleted between list and get (a departing worker) are skipped.
+
+    Each returned snapshot carries ``age_s`` — seconds since its
+    ``published_at`` stamp (None for pre-stamp publishers).  With
+    ``max_age_s``, snapshots older than that are DROPPED: a rank that
+    died in a previous elastic round leaves its last snapshot in the KV
+    store forever, and merging it would silently distort the cluster
+    view.  The health plane collects WITHOUT a cutoff and classifies the
+    stale ranks instead."""
     out: dict[int, dict] = {}
     prefix = namespace + "/"
+    now = time.time()
     for key in client.keys(prefix):
         raw = client.get(key)
         if raw is None:
             continue
         snap = json.loads(raw.decode("utf-8"))
+        published = snap.get("published_at")
+        age = (now - published) if published is not None else None
+        snap["age_s"] = age
+        if max_age_s is not None and age is not None and age > max_age_s:
+            continue
         out[int(key[len(prefix):])] = snap
     return out
 
@@ -131,6 +150,11 @@ def merge_snapshots(snapshots: dict[int, dict]) -> dict:
     on every metric."""
     merged: dict = {"workers": sorted(snapshots),
                     "counters": {}, "gauges": {}, "histograms": {}}
+    ages = {str(rank): snapshots[rank].get("age_s")
+            for rank in sorted(snapshots)
+            if "age_s" in snapshots[rank]}
+    if ages:
+        merged["ages"] = ages
     for rank in sorted(snapshots):
         snap = snapshots[rank]
         for kind in ("counters", "gauges"):
@@ -156,8 +180,11 @@ def merge_snapshots(snapshots: dict[int, dict]) -> dict:
     return merged
 
 
-def collect_and_merge(client, namespace: str = DEFAULT_NAMESPACE) -> dict:
-    """Rank 0's one-call cluster view."""
-    merged = merge_snapshots(collect(client, namespace))
+def collect_and_merge(client, namespace: str = DEFAULT_NAMESPACE,
+                      max_age_s: float | None = None) -> dict:
+    """Rank 0's one-call cluster view; ``max_age_s`` drops dead ranks'
+    leftover snapshots (see :func:`collect`)."""
+    merged = merge_snapshots(collect(client, namespace,
+                                     max_age_s=max_age_s))
     merged["time"] = time.time()
     return merged

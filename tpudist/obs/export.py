@@ -78,16 +78,26 @@ def to_prometheus(snapshot: dict) -> str:
     Histograms are cumulative: ``le`` edges are the log-bucket UPPER
     bounds (``growth**(idx+1)``; the zero bucket folds into the smallest
     edge since its values are <= 0 < every positive edge), closing with
-    ``+Inf``, ``_sum`` and ``_count``."""
+    ``+Inf``, ``_sum`` and ``_count``.  Merged cluster snapshots keep
+    their per-host attribution: each counter/gauge additionally emits one
+    ``{name}{{worker="r"}}`` sample per rank from its ``per_worker``
+    map."""
     out: list[str] = []
+
+    def scalar_lines(pname: str, m: dict) -> None:
+        out.append(f"{pname} {_prom_num(m['value'])}")
+        for rank in sorted(m.get("per_worker", {}), key=int):
+            out.append(f'{pname}{{worker="{rank}"}} '
+                       f"{_prom_num(m['per_worker'][rank])}")
+
     for name, m in snapshot.get("counters", {}).items():
         pname = _prom_name(name)
         out.append(f"# TYPE {pname} counter")
-        out.append(f"{pname} {_prom_num(m['value'])}")
+        scalar_lines(pname, m)
     for name, m in snapshot.get("gauges", {}).items():
         pname = _prom_name(name)
         out.append(f"# TYPE {pname} gauge")
-        out.append(f"{pname} {_prom_num(m['value'])}")
+        scalar_lines(pname, m)
     for name, h in snapshot.get("histograms", {}).items():
         pname = _prom_name(name)
         out.append(f"# TYPE {pname} histogram")
@@ -106,17 +116,25 @@ def to_prometheus(snapshot: dict) -> str:
 
 # -- HTTP /metrics ----------------------------------------------------------
 
+_KNOWN_PATHS = ("/metrics", "/metrics.json", "/healthz")
+
+
 class MetricsServer:
-    """stdlib-only metrics endpoint.
+    """stdlib-only metrics + liveness endpoint.
 
     ``MetricsServer(registry).port`` binds an ephemeral port; pass
     ``snapshot_fn`` to serve something other than the local registry
     (e.g. rank 0 serving the merged cluster view from
-    :func:`tpudist.obs.aggregate.collect_and_merge`).  Runs in a daemon
+    :func:`tpudist.obs.aggregate.collect_and_merge`).  Pass ``health_fn``
+    (conventionally ``HealthMonitor.verdict``) to activate ``/healthz``
+    as a container liveness probe: 200 while the verdict is healthy (or
+    not yet known), 503 once it is degraded — the role the reference's
+    Docker HEALTHCHECK plays, but cluster-aware.  Unknown paths get a
+    real 404 with a JSON body listing the endpoints.  Runs in a daemon
     thread; :meth:`close` shuts it down."""
 
     def __init__(self, registry=None, snapshot_fn=None, host: str = "",
-                 port: int = 0) -> None:
+                 port: int = 0, health_fn=None) -> None:
         if (registry is None) == (snapshot_fn is None):
             raise ValueError("pass exactly one of registry / snapshot_fn")
         snap = snapshot_fn or registry.snapshot
@@ -125,16 +143,27 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - http.server API
                 path = self.path.split("?")[0]
+                status = 200
                 if path == "/metrics":
                     body = to_prometheus(snap()).encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/metrics.json":
                     body = json.dumps(snap()).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/healthz":
+                    verdict = (health_fn() if health_fn is not None
+                               else {"status": "ok"})
+                    status = 503 if verdict.get("status") == "degraded" \
+                        else 200
+                    body = json.dumps(verdict).encode("utf-8")
+                    ctype = "application/json"
                 else:
-                    self.send_error(404)
-                    return
-                self.send_response(200)
+                    status = 404
+                    body = json.dumps(
+                        {"error": f"unknown path {path!r}",
+                         "paths": list(_KNOWN_PATHS)}).encode("utf-8")
+                    ctype = "application/json"
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

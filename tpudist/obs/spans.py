@@ -29,6 +29,7 @@ degrade to no-ops when jax (or the annotation API) is unavailable.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -61,10 +62,12 @@ def _effects_barrier() -> None:
 class SpanTracer:
     """Per-process span recorder.
 
-    ``max_events`` bounds the buffer (long serving jobs would otherwise
-    grow without limit); overflow increments :attr:`dropped` instead of
-    recording.  Thread-safe: each thread keeps its own nesting stack, the
-    event buffer is lock-guarded.
+    ``max_events`` bounds the buffer as a RING (long serving jobs would
+    otherwise grow without limit): overflow evicts the OLDEST span and
+    increments :attr:`dropped`, keeping the newest spans — the tail a
+    post-mortem (:mod:`tpudist.obs.recorder`) actually wants.
+    Thread-safe: each thread keeps its own nesting stack, the event
+    buffer is lock-guarded.
     """
 
     def __init__(self, max_events: int = 100_000,
@@ -73,7 +76,8 @@ class SpanTracer:
         # None -> env-controlled so tests/benches can fence without code
         self.fence = env_flag("TPUDIST_OBS_FENCE") if fence is None else fence
         self.dropped = 0
-        self._events: list[dict] = []
+        self._events: collections.deque[dict] = collections.deque(
+            maxlen=max_events)
         self._lock = threading.Lock()
         self._local = threading.local()
         self._pid = os.getpid()
@@ -113,10 +117,9 @@ class SpanTracer:
                 "args": {"depth": depth, **args},
             }
             with self._lock:
-                if len(self._events) < self.max_events:
-                    self._events.append(event)
-                else:
-                    self.dropped += 1
+                if len(self._events) == self.max_events:
+                    self.dropped += 1  # deque maxlen evicts the oldest
+                self._events.append(event)
 
     def events(self) -> list[dict]:
         with self._lock:

@@ -135,6 +135,10 @@ def make_dp_train_step(
     def train_step(state, *batch):
         return stepped(state, batch)
 
+    # the closure hides the jit; expose its lower() so the trainer's
+    # one-time cost probe can read cost_analysis()/HLO without another
+    # trace path (tpudist.obs.xla.cost_flops, recorder.note_hlo)
+    train_step.lower = lambda state, *batch: stepped.lower(state, batch)
     return train_step
 
 
@@ -168,6 +172,7 @@ def make_dp_train_loop(
     def train_loop(state, *batches):
         return stepped(state, batches)
 
+    train_loop.lower = lambda state, *batches: stepped.lower(state, batches)
     return train_loop
 
 

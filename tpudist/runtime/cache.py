@@ -31,4 +31,10 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     # Cache everything that took meaningful compile time; the default
     # threshold (1s) skips tiny programs that are cheap to rebuild.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # same motivation, same call site: a process that cares about compile
+    # cost wants the xla/compiles counter + duration histogram too (the
+    # recompile-storm detector); idempotent, no-op if jax lacks the hooks
+    from tpudist.obs.xla import install_compile_telemetry
+
+    install_compile_telemetry()
     return cache_dir

@@ -469,15 +469,24 @@ class IciCollectives:
             for leaf in jax.tree.leaves(global_tree))
         exe = self._execs.get(key)
         if exe is None:
+            from tpudist.obs.xla import compile_watch
+
             spec = jax.sharding.PartitionSpec(self.axis)
             fn = jax.jit(jax.shard_map(
                 self._tree_pmean, mesh=self.mesh,
                 in_specs=spec, out_specs=spec))
-            exe = fn.lower(global_tree).compile()
+            with compile_watch("ici"):
+                exe = fn.lower(global_tree).compile()
             self._execs[key] = exe
             # rendered once per compile (the text is identical for a
             # cache hit and re-rendering a large module every step isn't)
             self.last_hlo = exe.as_text()
+            try:
+                from tpudist import obs
+
+                obs.recorder.note_hlo(self.last_hlo)
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                pass
         return exe
 
     def allreduce_mean(self, tree: Any) -> Any:

@@ -120,6 +120,7 @@ def launch(
         raise ValueError(
             f"blacklist_after must be >= 1, got {blacklist_after}")
     server = None
+    watcher = None
     base_env = dict(os.environ)
     # Workers must resolve the same tpudist the launcher runs from, however
     # the launcher itself was put on sys.path (pytest rootdir, pip -e, ...).
@@ -135,6 +136,15 @@ def launch(
 
             server = CoordServer(0)
             base_env["TPUDIST_COORD_ADDR"] = f"127.0.0.1:{server.port}"
+            # the health plane rides the same store the workers publish
+            # metrics through: the watcher classifies stragglers/stale
+            # ranks so supervision decisions below can cite evidence
+            try:
+                from tpudist.obs.health import HealthWatcher
+
+                watcher = HealthWatcher(base_env["TPUDIST_COORD_ADDR"])
+            except Exception as e:  # noqa: BLE001 - health is advisory
+                log.warning("health watcher unavailable (%s); continuing", e)
         except Exception as e:  # noqa: BLE001 - control plane is optional
             log.warning("coordination server unavailable (%s); continuing", e)
 
@@ -192,9 +202,10 @@ def launch(
                             obs.counter("launch/blacklisted").inc()
                             log.warning(
                                 "spawn id %d blacklisted after %d failed "
-                                "attempts%s", sid, fail_counts[sid],
+                                "attempts%s%s", sid, fail_counts[sid],
                                 "" if blacklist_cooldown is None else
-                                f" (cooldown until +{black_until[sid] - now:.1f}s)")
+                                f" (cooldown until +{black_until[sid] - now:.1f}s)",
+                                f" [{watcher.describe()}]" if watcher else "")
                     while len(roster) < world:
                         roster.append(next_sid)   # fresh replacement slot
                         next_sid += 1
@@ -240,8 +251,9 @@ def launch(
             elif all(c == 0 for c in codes):
                 return 0
             log.warning(
-                "gang attempt %d failed (exit codes %s)%s", attempt, codes,
+                "gang attempt %d failed (exit codes %s)%s%s", attempt, codes,
                 "; restarting" if attempt < max_restarts else "",
+                f" [{watcher.describe()}]" if watcher else "",
             )
         # Survivors torn down by _supervise exit with the termination
         # signal; report the code of the worker that actually failed.
@@ -249,6 +261,11 @@ def launch(
                    if c not in (0, -signal.SIGTERM, -signal.SIGKILL)]
         return failing[0] if failing else next(c for c in codes if c != 0)
     finally:
+        if watcher is not None:
+            try:
+                watcher.stop()
+            except Exception:  # noqa: BLE001 - advisory plane
+                pass
         if server is not None:
             server.stop()
 

@@ -26,6 +26,7 @@ import optax
 from jax.sharding import Mesh
 
 from tpudist import obs
+from tpudist.obs import xla as obs_xla
 from tpudist.data.loader import ShardedLoader
 from tpudist.elastic.checkpoint import restore_pytree, save_pytree
 from tpudist.ops.losses import cross_entropy
@@ -128,6 +129,34 @@ class Trainer:
         self._obs_loss = obs.gauge("train/loss")
         self._obs_tput = obs.gauge("train/images_per_sec", unit="img/s")
         self._obs_step_time = obs.histogram("train/step_time", unit="s")
+        # per-optimizer-step program FLOPs, filled by the one-time cost
+        # probe on the first dispatch; feeds the live MFU gauge
+        self._step_flops: float | None = None
+        self._cost_probed = False
+
+    def _probe_cost(self, fn, steps_per_dispatch: int, *args) -> None:
+        """One-time lower() of the step program: cost_analysis() FLOPs for
+        the live ``xla/mfu`` gauge, HLO text for the flight recorder's
+        post-mortem bundle.  Pure analysis — no compile, no dispatch."""
+        if self._cost_probed:
+            return
+        self._cost_probed = True
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return
+        try:
+            with obs.span("cost_probe"):
+                lowered = lower(self.state, *args)
+            flops = obs_xla.cost_flops(lowered)
+            if flops is not None:
+                self._step_flops = flops / steps_per_dispatch
+            try:
+                hlo = lowered.as_text(dialect="hlo")
+            except Exception:  # noqa: BLE001 - dialect arg may vanish
+                hlo = lowered.as_text()
+            obs.recorder.note_hlo(hlo)
+        except Exception as e:  # noqa: BLE001 - telemetry must not stop training
+            log.debug("cost probe failed: %s", e)
 
     # -- snapshotting (`_save_snapshot`/`_load_snapshot` parity, with full state)
 
@@ -178,6 +207,7 @@ class Trainer:
             start_step = groups * n
             for g, batch in enumerate(
                     self.train_loader.epoch_stacked(epoch, n)):
+                self._probe_cost(self.train_loop, n, *batch)
                 t0 = time.perf_counter()
                 with obs.span("train_dispatch", steps=n):
                     self.state, metrics = self.train_loop(self.state, *batch)
@@ -192,11 +222,17 @@ class Trainer:
                 self._obs_examples.inc(n * self.train_loader.global_batch)
                 self._obs_step_time.record((time.perf_counter() - t0) / n)
                 if (g * n) % self.config.log_every < n:
+                    loss = float(metrics["loss"][-1])
                     log.info("epoch %d step %d loss %.4f", epoch,
-                             g * n + n - 1, float(metrics["loss"][-1]))
+                             g * n + n - 1, loss)
+                    # flight-recorder breadcrumb at log granularity: the
+                    # loss is already on host here, so this adds no sync
+                    obs.recorder.record("train_log", epoch=epoch,
+                                        step=g * n + n - 1, loss=loss)
         for step, batch in enumerate(
                 self.train_loader.epoch(epoch, start_step=start_step),
                 start=start_step):
+            self._probe_cost(self.train_step, 1, *batch)
             t0 = time.perf_counter()
             with obs.span("train_step", step=step):
                 self.state, metrics = self.train_step(self.state, *batch)
@@ -210,12 +246,19 @@ class Trainer:
             # dispatch time unless TPUDIST_OBS_FENCE=1 makes spans fence
             self._obs_step_time.record(time.perf_counter() - t0)
             if step % self.config.log_every == 0:
-                log.info(
-                    "epoch %d step %d loss %.4f", epoch, step, float(metrics["loss"])
-                )
+                loss = float(metrics["loss"])
+                log.info("epoch %d step %d loss %.4f", epoch, step, loss)
+                obs.recorder.record("train_log", epoch=epoch, step=step,
+                                    loss=loss)
         return self.metrics.reset()
 
     def train(self, max_epochs: int | None = None) -> dict:
+        # any unhandled exception dumps a post-mortem bundle (event ring,
+        # final snapshot, HLO) before propagating
+        with obs.recorder.guard("trainer", epochs_run=self.epochs_run):
+            return self._train(max_epochs)
+
+    def _train(self, max_epochs: int | None = None) -> dict:
         max_epochs = max_epochs or self.config.total_epochs
         summary: dict = {}
         start_epoch = self.epochs_run
@@ -227,7 +270,17 @@ class Trainer:
                     epoch_metrics = self._run_epoch(epoch)
             self._obs_epochs.inc()
             self._obs_tput.set(self.throughput.items_per_sec)
+            # live efficiency gauges, refreshed per epoch: MFU from the
+            # cost probe's FLOPs over the measured mean step time, and
+            # the per-device HBM stats (no-ops off-TPU)
+            obs_xla.note_step(self.throughput.mean_step_time,
+                              self._step_flops)
+            obs_xla.update_memory_gauges()
             summary = {"epoch": epoch, **epoch_metrics}
+            obs.recorder.record(
+                "epoch_end", epoch=epoch,
+                loss=epoch_metrics.get("loss"),
+                images_per_sec=round(self.throughput.items_per_sec, 2))
             if self.config.eval_every_epoch and self.test_loader is not None:
                 summary["test_accuracy"] = self.test()
                 log.info(

@@ -4,6 +4,13 @@ Strictly more than the reference's perf signal (end-to-end ``time.time()``
 deltas, `mnist_ddp_elastic.py:210-213`, `model_parallel_ResNet50.py:258-262`):
 per-step wall clock with warmup exclusion, images/sec, and an optional
 ``jax.profiler`` trace hook (SURVEY.md §5 "Tracing / profiling").
+
+These helpers predate :mod:`tpudist.obs`; rather than keep two metric
+systems, they now also report into the process-global obs registry
+(:class:`ThroughputMeter` maintains ``throughput/items_per_sec`` and
+``throughput/steps`` gauges; :class:`Stopwatch` can record laps into an
+obs histogram via ``obs_name``).  The local Python API is unchanged —
+callers that never touch obs see identical behavior.
 """
 
 from __future__ import annotations
@@ -16,12 +23,27 @@ import jax
 import numpy as np
 
 
+def _obs_registry():
+    # lazy: utils must stay importable while obs is mid-import, and the
+    # meters must keep working even if obs ever breaks
+    try:
+        from tpudist import obs
+
+        return obs.registry
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class Stopwatch:
     """Wall-clock timer; ``block=True`` syncs outstanding device work first
-    (async dispatch otherwise makes step timings meaningless)."""
+    (async dispatch otherwise makes step timings meaningless).
 
-    def __init__(self) -> None:
+    ``obs_name`` (e.g. ``"data/load_seconds"``) additionally records every
+    :meth:`elapsed` reading into that obs histogram."""
+
+    def __init__(self, obs_name: str | None = None) -> None:
         self._t0 = time.perf_counter()
+        self._obs_name = obs_name
 
     def reset(self, block: bool = False) -> None:
         if block:
@@ -31,11 +53,21 @@ class Stopwatch:
     def elapsed(self, block: bool = False) -> float:
         if block:
             jax.effects_barrier()
-        return time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0
+        if self._obs_name is not None:
+            reg = _obs_registry()
+            if reg is not None:
+                reg.histogram(self._obs_name, unit="s").record(dt)
+        return dt
 
 
 class ThroughputMeter:
-    """Images/sec (or items/sec) with warmup-step exclusion."""
+    """Images/sec (or items/sec) with warmup-step exclusion.
+
+    Post-warmup steps also refresh the obs gauges
+    ``throughput/items_per_sec`` and ``throughput/steps``, so the cluster
+    view (and ``/metrics``) carries throughput without every call site
+    exporting it by hand."""
 
     def __init__(self, warmup_steps: int = 1) -> None:
         self.warmup_steps = warmup_steps
@@ -43,6 +75,8 @@ class ThroughputMeter:
         self._items = 0
         self._elapsed = 0.0
         self._last: float | None = None
+        self._rate_gauge = None
+        self._steps_gauge = None
 
     def start(self) -> None:
         self._last = time.perf_counter()
@@ -56,6 +90,16 @@ class ThroughputMeter:
         if self._steps > self.warmup_steps:
             self._items += n_items
             self._elapsed += now - self._last
+            if self._rate_gauge is None:
+                reg = _obs_registry()
+                if reg is not None:
+                    self._rate_gauge = reg.gauge(
+                        "throughput/items_per_sec", unit="items/s")
+                    self._steps_gauge = reg.gauge(
+                        "throughput/steps", unit="steps")
+            if self._rate_gauge is not None:
+                self._rate_gauge.set(self.items_per_sec)
+                self._steps_gauge.set(float(self._steps))
         self._last = now
 
     @property
