@@ -859,12 +859,13 @@ def bench_serve_loop(on_tpu: bool) -> None:
 
     # Admission is dispatch-only since round 5 (the prefill rides the
     # device queue under the decode segments; the first token resolves at
-    # the next segment sync) — so the instrumented quantities are:
+    # the next segment sync) and the fetch itself is pipelined since this
+    # round — so the instrumented quantities are:
     # * admit host stall (pure dispatch time; target < one segment),
-    # * per-segment host syncs (each pays one tunnel RTT; at the dev
-    #   tunnel's 1–130 ms RTT that dominates wall clock, a local chip
-    #   pays ~0.1 ms — the rtt-corrected rate is the hardware-honest
-    #   number, the raw one is what THIS tunnel delivers),
+    # * measured HOST WAIT (the serve/host_wait histogram: time run()
+    #   actually blocked on segment fetches — the synchronous loop pays
+    #   ~one tunnel RTT per segment, the pipelined loop only the tail the
+    #   next segment's compute did not cover),
     # * prefill DEVICE time, estimated per distinct shape afterwards and
     #   deducted (the fixed-batch baseline excludes its prefill too).
     admit_s = {"t": 0.0, "max": 0.0, "n": 0}
@@ -884,14 +885,39 @@ def bench_serve_loop(on_tpu: bool) -> None:
         syncs["n"] += 1
         return orig_segment(*a)
 
+    def host_wait_sum() -> float:
+        from tpudist import obs as _obs
+
+        snap = _obs.snapshot()["histograms"].get("serve/host_wait")
+        return float(snap["sum"]) if snap else 0.0
+
     loop._admit, loop._segment = timed_admit, counted_segment
-    t0 = _t.perf_counter()
-    comps = loop.run(reqs)
-    wall = _t.perf_counter() - t0
+
+    def serve(depth: int) -> dict:
+        """One full mixed-workload run at the given pipeline depth on the
+        SAME instance (shared executables: no recompiles between arms)."""
+        loop.pipeline_depth = depth
+        admit_s.update(t=0.0, max=0.0, n=0)
+        syncs["n"] = 0
+        hw0 = host_wait_sum()
+        t0 = _t.perf_counter()
+        comps = loop.run(reqs)
+        wall = _t.perf_counter() - t0
+        return {"comps": comps, "wall": wall,
+                "host_wait": host_wait_sum() - hw0,
+                "admit": dict(admit_s), "segments": syncs["n"]}
+
+    sync_run = serve(1)       # the pre-pipeline loop: fetch every segment
+    pipe_run = serve(2)       # two-deep: fetch k overlaps k+1's compute
     loop._admit, loop._segment = orig_admit, orig_segment
+    # the staleness contract must not cost a single token: identical
+    # completions (tokens, finish reasons, finish order) at both depths
+    sig = lambda r: [(c.rid, c.tokens.tolist(), c.reason)  # noqa: E731
+                     for c in r["comps"]]
+    exact = sig(sync_run) == sig(pipe_run)
     # each request's FIRST token is generated during (deducted) admission
     # prefill — count len-1 per request, matching fixed-batch's (gen - 1)
-    total_tokens = sum(len(c.tokens) - 1 for c in comps)
+    total_tokens = sum(len(c.tokens) - 1 for c in pipe_run["comps"])
     # estimate the prefill device time the run's admissions enqueued:
     # time each distinct padded shape with CHAINED dispatches and one
     # sync (a single timed call is max(RTT, device) on the tunnel, which
@@ -917,28 +943,125 @@ def bench_serve_loop(on_tpu: bool) -> None:
         burst()
         shape_cost[L] = max(_t.perf_counter() - t1 - _RTT, 0.0) / n_chain
     prefill_est = sum(shape_cost[int(n)] for n in lens)
-    decode_s = max(wall - prefill_est - admit_s["t"], 1e-9)
-    decode_net = max(decode_s - syncs["n"] * _RTT, 1e-9)
-    serve_slot_tps = total_tokens / decode_s / slots
-    net_slot_tps = total_tokens / decode_net / slots
-    seg_s = decode_net / max(syncs["n"], 1)
-    _emit("serve_loop_tokens_per_slot", round(net_slot_tps, 1),
-          "tokens/sec/slot", round(net_slot_tps / fb_slot_tps, 3),
-          # the RTT subtraction becomes unreliable once the corrected
-          # window shrinks toward the subtracted amount — read the raw
-          # ratio (and the in-graph step decomposition) when this flags
-          rtt_correction_reliable=bool(decode_net > syncs["n"] * _RTT),
+
+    def rates(run: dict) -> tuple[float, float, float]:
+        decode = max(run["wall"] - prefill_est - run["admit"]["t"], 1e-9)
+        net = max(decode - run["host_wait"], 1e-9)
+        return decode, total_tokens / decode / slots, total_tokens / net / slots
+
+    decode_sync, raw_sync_tps, _ = rates(sync_run)
+    decode_pipe, raw_pipe_tps, net_pipe_tps = rates(pipe_run)
+    seg_s = decode_pipe / max(pipe_run["segments"], 1)
+    _emit("serve_loop_tokens_per_slot", round(net_pipe_tps, 1),
+          "tokens/sec/slot", round(net_pipe_tps / fb_slot_tps, 3),
+          # the host-wait subtraction becomes unreliable once the
+          # corrected window shrinks toward the subtracted amount — read
+          # the raw ratio when this flags
+          rtt_correction_reliable=bool(decode_pipe > pipe_run["host_wait"]),
           context=cfg.max_seq_len, slots=slots, requests=len(reqs),
           mixed_prompt_lens=sorted(set(lens)),
+          pipeline_depth=2, exact_match=bool(exact),
           fixed_batch_tokens_per_slot=round(fb_slot_tps, 1),
-          raw_tokens_per_slot=round(serve_slot_tps, 1),
-          raw_vs_fixed_batch=round(serve_slot_tps / fb_slot_tps, 3),
-          segments=syncs["n"],
-          admission_host_s=round(admit_s["t"], 3),
+          raw_tokens_per_slot=round(raw_pipe_tps, 1),
+          raw_vs_fixed_batch=round(raw_pipe_tps / fb_slot_tps, 3),
+          sync_tokens_per_slot=round(raw_sync_tps, 1),
+          raw_vs_sync=round(raw_pipe_tps / max(raw_sync_tps, 1e-9), 3),
+          host_wait_s=round(pipe_run["host_wait"], 4),
+          sync_host_wait_s=round(sync_run["host_wait"], 4),
+          host_wait_vs_sync=round(
+              pipe_run["host_wait"] / max(sync_run["host_wait"], 1e-9), 3),
+          segments=pipe_run["segments"],
+          sync_segments=sync_run["segments"],
+          admission_host_s=round(pipe_run["admit"]["t"], 3),
           admission_stall_max_segments=round(
-              admit_s["max"] / max(seg_s, 1e-9), 2),
-          prefill_device_est_s=round(prefill_est, 2),
-          decode_s=round(decode_s, 2),
+              pipe_run["admit"]["max"] / max(seg_s, 1e-9), 2),
+          prefill_device_est_s=round(prefill_est, 4),
+          decode_s=round(decode_pipe, 4),
+          sync_decode_s=round(decode_sync, 4),
+          rtt_ms=round(_RTT * 1e3, 1))
+
+
+def bench_input_pipeline(on_tpu: bool) -> None:
+    """Train-side dispatch pipelining: (1) the DevicePrefetch iterator
+    keeps N batches' host→device transfers in flight ahead of the step —
+    epoch wall clock and measured input stall vs synchronous pulls over
+    the SAME ShardedLoader stream; (2) the Checkpointer's async save
+    blocks the caller for copy INITIATION only — blocked time vs the
+    synchronous d2h+serialize+write it replaces, with a byte-equality
+    check between both saved archives."""
+    import tempfile
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.data import ShardedLoader, device_prefetch
+    from tpudist.elastic.checkpoint import Checkpointer, restore_pytree
+
+    rng = np.random.default_rng(0)
+    n, bs = (8192, 256) if on_tpu else (1024, 64)
+    imgs = rng.normal(size=(n, 16, 16)).astype(np.float32)
+    labels = rng.integers(0, 10, (n,)).astype(np.int32)
+    loader = ShardedLoader([imgs, labels], global_batch=bs)
+    w = jax.device_put(rng.normal(size=(16, 16)).astype(np.float32))
+    step = jax.jit(lambda x, w: jax.numpy.tanh(x @ w).sum())
+
+    def put(batch):
+        return tuple(jax.device_put(a) for a in batch)
+
+    def hist_sum(name: str) -> float:
+        snap = obs.snapshot()["histograms"].get(name)
+        return float(snap["sum"]) if snap else 0.0
+
+    def run_epoch(depth: int) -> tuple[float, float]:
+        src = loader.epoch(0)
+        src = (device_prefetch(src, depth=depth, put=put)
+               if depth else (put(b) for b in src))
+        s0 = hist_sum("data/input_stall_s")
+        out = None
+        t0 = _t.perf_counter()
+        for x, _y in src:
+            out = step(x, w)
+        float(out)
+        wall = _t.perf_counter() - t0
+        return wall, hist_sum("data/input_stall_s") - s0
+
+    run_epoch(2)  # warm the step executable + transfer path
+    wall_sync, _ = run_epoch(0)
+    wall_pre, stall_s = run_epoch(2)
+    _emit("input_pipeline_stall", round(stall_s, 4), "s",
+          round(wall_sync / max(wall_pre, 1e-9), 3),
+          depth=2, batches=loader.steps_per_epoch,
+          wall_sync_s=round(wall_sync, 4),
+          wall_prefetch_s=round(wall_pre, 4),
+          input_stall_gauge_live=bool(
+              obs.snapshot()["gauges"].get("data/input_stall") is not None),
+          rtt_ms=round(_RTT * 1e3, 1))
+
+    # (2) snapshot saves: async initiation vs synchronous write
+    leaf = rng.normal(size=(512, 512)).astype(np.float32)
+    tree = {f"w{i}": jax.device_put(leaf + i) for i in range(4)}
+    with tempfile.TemporaryDirectory() as td:
+        sync_ck = Checkpointer(f"{td}/sync.npz", async_save=False,
+                               layout="flat")
+        async_ck = Checkpointer(f"{td}/async.npz", async_save=True,
+                                layout="flat")
+        t0 = _t.perf_counter()
+        sync_ck.save(0, tree, meta={"step": 0})
+        t_sync = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        async_ck.save(0, tree, meta={"step": 0})
+        t_blocked = _t.perf_counter() - t0
+        async_ck.wait()
+        a, _ = restore_pytree(f"{td}/async.npz", tree)
+        s, _ = restore_pytree(f"{td}/sync.npz", tree)
+        save_equal = all(
+            np.array_equal(np.asarray(a[k]), np.asarray(s[k])) for k in tree)
+    _emit("ckpt_async_save_blocked", round(t_blocked, 4), "s",
+          round(t_blocked / max(t_sync, 1e-9), 3),
+          sync_save_s=round(t_sync, 4), save_equal=bool(save_equal),
+          tree_bytes=int(sum(np.asarray(v).nbytes for v in tree.values())),
           rtt_ms=round(_RTT * 1e3, 1))
 
 
@@ -1472,15 +1595,30 @@ def main() -> None:
                bench_resnet50_pipeline,
                bench_flash_attention, bench_window_speedup, bench_decode,
                bench_moe, bench_flash_decode_bandwidth,
-               bench_serve_loop, bench_serve_capacity,
+               bench_serve_loop, bench_input_pipeline, bench_serve_capacity,
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode]
-    # optional name filters: `python bench.py serve_loop moe` runs only
-    # the benches whose function name contains a given substring (dev
-    # iteration aid; the driver runs the full suite with no args)
+    # optional name filters: `python bench.py serve_loop moe` (positional
+    # substrings) or `python bench.py --only serve_loop,input_pipeline`
+    # (comma-separated; the CI smoke job's spelling) run only the benches
+    # whose function name contains a given substring; the driver runs the
+    # full suite with no args
     import sys as _sys
-    if len(_sys.argv) > 1:
-        pats = _sys.argv[1:]
+    argv = _sys.argv[1:]
+    pats: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--only":
+            i += 1
+            if i < len(argv):
+                pats += [p for p in argv[i].split(",") if p]
+        elif a.startswith("--only="):
+            pats += [p for p in a[len("--only="):].split(",") if p]
+        else:
+            pats.append(a)
+        i += 1
+    if pats:
         benches = [b for b in benches
                    if any(p in b.__name__ for p in pats)]
     for bench in benches:

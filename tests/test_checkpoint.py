@@ -72,3 +72,61 @@ def test_async_save(tmp_path):
 
 def test_restore_latest_empty(tmp_path):
     assert Checkpointer(tmp_path / "nope").restore_latest(_tree()) is None
+
+
+def test_invalid_layout_rejected(tmp_path):
+    with pytest.raises(ValueError, match="layout"):
+        Checkpointer(tmp_path, layout="nested")
+
+
+def test_flat_layout_roundtrip_and_replace(tmp_path):
+    """layout='flat': the target IS one .npz file every save replaces —
+    the Trainer's rolling snapshot contract on the shared save path."""
+    ckpt = Checkpointer(tmp_path / "snap.npz", layout="flat")
+    ckpt.save(4, _tree(), meta={"step": 4})
+    assert (tmp_path / "snap.npz").exists()
+    assert not any(p.name.startswith("step_") for p in tmp_path.iterdir())
+    step, tree, meta = ckpt.restore_latest(_tree(1))
+    assert step == 4 and meta["step"] == 4
+    np.testing.assert_array_equal(tree["params"]["w"], _tree()["params"]["w"])
+    ckpt.save(9, _tree(9), meta={"step": 9})
+    step, tree, _ = ckpt.restore_latest(_tree(1))
+    assert step == 9
+    np.testing.assert_array_equal(tree["params"]["w"], _tree(9)["params"]["w"])
+
+
+def test_flat_layout_empty(tmp_path):
+    none = Checkpointer(tmp_path / "no.npz", layout="flat")
+    assert none.restore_latest(_tree()) is None
+
+
+def test_async_save_device_tree_survives_donation(tmp_path):
+    """Async saves stage an ON-DEVICE copy before returning, so a
+    donating dispatch immediately after save() cannot clobber the
+    checkpoint (the donation-vs-async-fetch rule, docs/DESIGN.md), and
+    device-scalar meta values resolve to JSON on the writer thread."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(1024.0, dtype=jnp.float32)
+    want = np.asarray(x)
+    ckpt = Checkpointer(tmp_path, async_save=True)
+    ckpt.save(1, {"x": x}, meta={"step": jnp.int32(1), "tag": "e2e"})
+    bump = jax.jit(lambda v: v + 1.0, donate_argnums=0)
+    x = bump(x)            # donates the buffer save() was handed
+    float(x[0])            # force the donating dispatch to complete
+    ckpt.wait()
+    _, restored, meta = ckpt.restore_latest({"x": want})
+    np.testing.assert_array_equal(restored["x"], want)
+    assert meta["step"] == 1 and meta["tag"] == "e2e"
+
+
+def test_async_flat_save_records_blocked_time(tmp_path):
+    from tpudist import obs
+
+    before = obs.snapshot()["histograms"].get(
+        "ckpt/save_blocked", {}).get("count", 0)
+    ckpt = Checkpointer(tmp_path / "s.npz", async_save=True, layout="flat")
+    ckpt.save(0, _tree())
+    ckpt.wait()
+    assert obs.snapshot()["histograms"]["ckpt/save_blocked"]["count"] > before

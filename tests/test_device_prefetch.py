@@ -1,0 +1,119 @@
+"""Device-input pipelining: DevicePrefetch identity / teardown / error
+semantics, its stall instrumentation, and the ShardedLoader prefetch
+fallback when the native gather pool is unavailable (satellite of the
+dispatch-pipeline round; see docs/DESIGN.md)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpudist import obs
+from tpudist.data import ShardedLoader
+from tpudist.data.device_prefetch import DevicePrefetch, device_prefetch
+
+
+def test_identity_and_order():
+    items = [np.full((4,), i) for i in range(10)]
+    out = list(device_prefetch(iter(items), depth=3))
+    assert len(out) == 10
+    for i, a in enumerate(out):
+        np.testing.assert_array_equal(a, items[i])
+
+
+def test_depth_zero_is_synchronous_passthrough():
+    pf = DevicePrefetch(iter([1, 2, 3]), depth=0)
+    assert list(pf) == [1, 2, 3]
+
+
+def test_negative_depth_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetch(iter([]), depth=-1)
+
+
+def test_put_runs_on_the_worker_thread():
+    main = threading.get_ident()
+    tids = []
+
+    def put(x):
+        tids.append(threading.get_ident())
+        return x * 2
+
+    assert list(device_prefetch(iter([1, 2, 3]), depth=2, put=put)) == [2, 4, 6]
+    assert tids and all(t != main for t in tids)
+
+
+def test_source_exception_propagates_in_order():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom")
+
+    it = device_prefetch(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_early_break_closes_the_source():
+    """Abandoning the iterator mid-epoch must close the wrapped generator
+    (so a ShardedLoader epoch's ``finally`` reaps its pool jobs)."""
+    closed = []
+
+    def gen():
+        try:
+            for i in range(100):
+                yield i
+        finally:
+            closed.append(True)
+
+    for i, _ in enumerate(device_prefetch(gen(), depth=2)):
+        if i == 3:
+            break
+    assert closed == [True]
+
+
+def test_stall_metrics_live():
+    list(device_prefetch(iter([np.zeros(2)] * 4), depth=2))
+    snap = obs.snapshot()
+    assert "data/input_stall" in snap["gauges"]
+    assert snap["histograms"]["data/input_stall_s"]["count"] >= 4
+    assert snap["gauges"]["data/prefetch_depth"]["value"] == 2
+
+
+def test_loader_prefetch_honored_without_native(monkeypatch):
+    """Regression for the silent degradation: ``prefetch > 0`` with no
+    native pool must keep ``self.prefetch`` at the configured value and
+    honor it (Python-thread fallback), with plain ``iter(loader)``
+    yielding byte-identical batches to a synchronous loader."""
+    from tpudist.data import native as dnative
+
+    monkeypatch.setattr(dnative, "available", lambda: False)
+    rng = np.random.default_rng(0)
+    arrays = [rng.normal(size=(64, 3)).astype(np.float32),
+              rng.integers(0, 9, (64,)).astype(np.int32)]
+    pre = ShardedLoader(arrays, global_batch=8, shuffle=True, prefetch=3)
+    assert pre._pool is None and pre.prefetch == 3
+    ref = ShardedLoader(arrays, global_batch=8, shuffle=True, prefetch=0)
+    got = list(iter(pre))      # __iter__ honors the configured prefetch
+    want = list(iter(ref))
+    assert len(got) == len(want) == 8
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loader_stacked_fallback_matches(monkeypatch):
+    from tpudist.data import native as dnative
+
+    monkeypatch.setattr(dnative, "available", lambda: False)
+    rng = np.random.default_rng(1)
+    arrays = [rng.normal(size=(48, 2)).astype(np.float32)]
+    pre = ShardedLoader(arrays, global_batch=8, shuffle=True, prefetch=2)
+    ref = ShardedLoader(arrays, global_batch=8, shuffle=True, prefetch=0)
+    got = list(pre.epoch_stacked(0, 2))
+    want = list(ref.epoch_stacked(0, 2))
+    assert len(got) == len(want) == 3
+    for (g,), (w,) in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))

@@ -153,3 +153,54 @@ class TestPadCapRegression:
         # the loop is still usable and state is clean
         [c] = loop.run([Request(_prompt(1, 4), 8, rid="good")])
         np.testing.assert_array_equal(c.tokens, _want(params, c.prompt, 8))
+
+
+class TestPipelinedDispatch:
+    def test_depth_validation(self, params):
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            ServeLoop(CFG, params, num_slots=1, pipeline_depth=0)
+
+    def test_pipelined_exact_match_mixed_workload(self, params):
+        """The staleness contract must not cost a token: the pipelined
+        loop (depths 2 and 3) is byte-identical — tokens, finish reasons,
+        finish ORDER — to the synchronous loop (depth 1) on a mixed
+        prompt-length / stop-token workload with queueing and mid-flight
+        slot reuse.  Same instance across depths: shared executables, so
+        any divergence is host-scheduling, not numerics."""
+        reqs = [Request(_prompt(10 + i, 3 + 5 * i), 25, rid=i)
+                for i in range(6)]
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         decode_attention="flash", prefill_chunk=8,
+                         stop_tokens=(7, 13))
+
+        def sig(comps):
+            return [(c.rid, tuple(c.tokens.tolist()), c.reason)
+                    for c in comps]
+
+        loop.pipeline_depth = 1
+        sync = sig(loop.run(reqs))
+        # the workload exercises BOTH finish paths under pipelining
+        assert {r for _, _, r in sync} == {"stop", "length"}
+        assert sorted(r for r, _, _ in sync) == list(range(6))
+        for depth in (2, 3):
+            loop.pipeline_depth = depth
+            assert sig(loop.run(reqs)) == sync, f"depth {depth} diverged"
+
+    def test_default_depth_is_pipelined(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1)
+        assert loop.pipeline_depth == 2
+
+    def test_host_wait_recorded(self, params):
+        """serve/host_wait must tick on a pipelined run (the fetch time
+        the loop actually paid) and serve/pipeline_depth must be live."""
+        from tpudist import obs
+
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8)
+        before = obs.snapshot()["histograms"].get(
+            "serve/host_wait", {}).get("count", 0)
+        loop.run([Request(_prompt(60, 5), 9, rid=0),
+                  Request(_prompt(61, 8), 6, rid=1)])
+        snap = obs.snapshot()
+        assert snap["histograms"]["serve/host_wait"]["count"] > before
+        assert "serve/pipeline_depth" in snap["gauges"]

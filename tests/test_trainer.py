@@ -123,3 +123,42 @@ def test_trainer_fused_dispatch_matches_stepwise(tmp_path):
         lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)),
         trainer_a.state.params, trainer_b.state.params)
+
+
+def test_trainer_device_prefetch_loss_parity(tmp_path):
+    """Device-input pipelining is a scheduling change only: the same
+    per-step numerics — epoch-mean loss and trained params bitwise —
+    with and without the prefetch iterator."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    trainer_a, _ = _make_trainer(tmp_path / "a", epochs=2, n=256,
+                                 device_prefetch=0)
+    summary_a = trainer_a.train()
+    trainer_b, _ = _make_trainer(tmp_path / "b", epochs=2, n=256,
+                                 device_prefetch=3)
+    summary_b = trainer_b.train()
+    np.testing.assert_array_equal(summary_a["loss"], summary_b["loss"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        trainer_a.state.params, trainer_b.state.params)
+
+
+def test_trainer_async_snapshot_durable_with_meta(tmp_path):
+    """train() returning implies the (async) snapshot is on disk, with
+    the device step scalar resolved to a JSON int on the writer thread."""
+    import json
+
+    trainer, _ = _make_trainer(tmp_path, epochs=1)
+    assert trainer.config.async_snapshot  # the default path IS async
+    trainer.train()
+    assert (tmp_path / "snapshot.npz").exists()
+    meta = json.loads((tmp_path / "snapshot.meta.json").read_text())
+    assert meta["epochs_run"] == 1
+    assert meta["step"] == int(jax.device_get(trainer.state.step))
+
+
+def test_trainer_sync_snapshot_opt_out(tmp_path):
+    trainer, _ = _make_trainer(tmp_path, epochs=1, async_snapshot=False)
+    trainer.train()
+    assert (tmp_path / "snapshot.npz").exists()

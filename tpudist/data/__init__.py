@@ -6,6 +6,7 @@ epoch-seeded sharding sampler with DistributedSampler-compatible semantics,
 and batch iterators that land data directly in the right device sharding.
 """
 
+from tpudist.data.device_prefetch import DevicePrefetch, device_prefetch
 from tpudist.data.loader import ShardedLoader
 from tpudist.data.mnist import MNIST_MEAN, MNIST_STD, Dataset, load_mnist
 from tpudist.data.sampler import ShardedSampler
@@ -13,10 +14,12 @@ from tpudist.data.synthetic import ragged_embedding_batches, synthetic_images
 
 __all__ = [
     "Dataset",
+    "DevicePrefetch",
     "MNIST_MEAN",
     "MNIST_STD",
     "ShardedLoader",
     "ShardedSampler",
+    "device_prefetch",
     "load_mnist",
     "ragged_embedding_batches",
     "synthetic_images",

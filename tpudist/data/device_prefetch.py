@@ -1,0 +1,167 @@
+"""Device-input pipelining — keep N batches' transfers in flight ahead
+of the step.
+
+The device-side twin of the native gather pool (:mod:`tpudist.data.native`):
+the pool overlaps HOST batch assembly with device compute, this module
+overlaps the *pull* — a background thread drives the wrapped iterator
+(whose ``jax.device_put`` calls are async dispatches) so that by the time
+the training loop asks for batch ``k``, batches ``k..k+depth-1`` have
+already had their host→device copies initiated and the step dispatch
+never waits on input.  This is the DataLoader-worker + pin_memory role
+(`mnist_ddp_elastic.py:185-189`) folded into one iterator.
+
+Instrumentation (see docs/OBSERVABILITY.md):
+
+* ``data/input_stall`` gauge — cumulative seconds the consumer has been
+  blocked waiting for input (the time the accelerator would have idled
+  on the host; near-zero when the pipeline keeps up);
+* ``data/input_stall_s`` histogram — per-fetch stall distribution;
+* ``data/prefetch_depth`` gauge — the configured look-ahead.
+
+Thread discipline: exceptions raised by the wrapped iterator propagate
+to the consumer at the corresponding ``__next__``; abandoning the
+iterator early (``break`` / exception) stops the worker and closes the
+underlying generator so prefetch-pool jobs and buffers are reaped
+(:meth:`ShardedLoader.epoch` has the matching ``finally``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+from tpudist import obs
+
+__all__ = ["DevicePrefetch", "device_prefetch"]
+
+_ITEM, _ERR, _END = 0, 1, 2
+
+
+class DevicePrefetch:
+    """Iterator that materializes ``depth`` items of ``iterable`` ahead
+    of the consumer on a background thread.
+
+    Args:
+      iterable: source of batches.  When it is a :class:`ShardedLoader`
+        epoch generator, each ``next`` already lands the batch in the
+        mesh sharding via ``jax.device_put`` — pulling ahead therefore
+        keeps ``depth`` transfers in flight.
+      depth: batches to keep ready (0 disables prefetch: the iterator
+        degrades to plain synchronous iteration).
+      put: optional transform applied to every item ON THE WORKER
+        THREAD (e.g. a ``jax.device_put`` for host-only sources).
+    """
+
+    def __init__(self, iterable: Iterable, depth: int = 2,
+                 put: Callable[[Any], Any] | None = None) -> None:
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self.depth = depth
+        self._it = iter(iterable)
+        self._put = put
+        self._done = False
+        self._stall = obs.gauge("data/input_stall", unit="s")
+        self._stall_hist = obs.histogram("data/input_stall_s", unit="s")
+        self._depth_gauge = obs.gauge("data/prefetch_depth")
+        self._depth_gauge.set(depth)
+        self._stalled = 0.0
+        self._thread: threading.Thread | None = None
+        if depth > 0:
+            self._stop = threading.Event()
+            self._q: queue.Queue = queue.Queue(maxsize=depth)
+            self._thread = threading.Thread(
+                target=self._worker, name="tpudist-device-prefetch",
+                daemon=True)
+            self._thread.start()
+
+    # -- worker side -------------------------------------------------------
+
+    def _push(self, msg: tuple) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def _worker(self) -> None:
+        try:
+            for item in self._it:
+                if self._stop.is_set():
+                    break
+                if self._put is not None:
+                    item = self._put(item)
+                self._push((_ITEM, item))
+        except BaseException as e:  # noqa: BLE001 - relayed to the consumer
+            self._push((_ERR, e))
+        finally:
+            # close the source in the thread that iterated it, so an
+            # abandoned ShardedLoader epoch reaps its in-flight pool jobs
+            close = getattr(self._it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self._push((_END, None))
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._thread is None:  # depth == 0: synchronous passthrough
+            item = next(self._it)
+            return self._put(item) if self._put is not None else item
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        kind, val = self._q.get()
+        stall = time.perf_counter() - t0
+        self._stalled += stall
+        self._stall.set(self._stalled)
+        self._stall_hist.record(stall)
+        if kind == _END:
+            self._done = True
+            self._thread.join()
+            raise StopIteration
+        if kind == _ERR:
+            self._done = True
+            self._thread.join()
+            raise val
+        return val
+
+    def close(self) -> None:
+        """Stop the worker and release the source (idempotent)."""
+        if self._thread is None or self._done:
+            self._done = True
+            return
+        self._done = True
+        self._stop.set()
+        # unblock a worker stuck on a full queue, then reap it
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                self._thread.join(timeout=0.1)
+        self._thread.join()
+
+    def __del__(self) -> None:  # best-effort; device_prefetch() is preferred
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def device_prefetch(iterable: Iterable, depth: int = 2,
+                    put: Callable[[Any], Any] | None = None) -> Iterator:
+    """Generator wrapper around :class:`DevicePrefetch` whose ``finally``
+    guarantees worker shutdown when the consumer stops early."""
+    pf = DevicePrefetch(iterable, depth=depth, put=put)
+    try:
+        yield from pf
+    finally:
+        pf.close()

@@ -30,11 +30,15 @@ class ShardedLoader:
         loader yields host numpy arrays (useful for tests and host-only eval).
       shuffle / seed / drop_last: sampler behavior (DistributedSampler
         semantics, see :mod:`tpudist.data.sampler`).
-      prefetch: batches to materialize ahead on the native (C++) gather pool
-        (:mod:`tpudist.data.native`), overlapping host batch assembly with
-        device compute — the DataLoader-worker/pin-memory role
-        (`mnist_ddp_elastic.py:185-189`). 0 = synchronous numpy gather;
-        silently degrades to 0 when the native library is unavailable.
+      prefetch: batches to materialize ahead, overlapping host batch
+        assembly with device compute — the DataLoader-worker/pin-memory
+        role (`mnist_ddp_elastic.py:185-189`). 0 = synchronous numpy
+        gather.  When the native (C++) gather pool
+        (:mod:`tpudist.data.native`) is available, gathers ride it;
+        otherwise the configured depth is honored by a Python-thread
+        fallback (:mod:`tpudist.data.device_prefetch`) that drives the
+        same generator ahead of the consumer — ``self.prefetch`` always
+        reflects the configured value, never a silent 0.
     """
 
     def __init__(
@@ -76,7 +80,7 @@ class ShardedLoader:
                 self._pool = _dnative.GatherPool()
                 # The C++ gather computes offsets from shape, not strides.
                 self.arrays = [np.ascontiguousarray(a) for a in self.arrays]
-        self.prefetch = prefetch if self._pool is not None else 0
+        self.prefetch = prefetch
         self._shardings = None
         if mesh is not None:
             self._shardings = [
@@ -122,7 +126,20 @@ class ShardedLoader:
         """Yield one epoch of batches; ``epoch`` seeds the shuffle
         (the ``sampler.set_epoch`` contract, `mnist_ddp_elastic.py:84`).
         ``start_step`` skips the first batches (resume / tail-after-stacked
-        iteration)."""
+        iteration).
+
+        ``prefetch > 0`` without the native pool wraps the stream in the
+        Python-thread :func:`device_prefetch` fallback, so the configured
+        look-ahead (including the ``jax.device_put`` per batch) is honored
+        either way."""
+        it = self._epoch_impl(epoch, start_step)
+        if self._pool is None and self.prefetch > 0:
+            from tpudist.data.device_prefetch import device_prefetch
+
+            return device_prefetch(it, depth=self.prefetch)
+        return it
+
+    def _epoch_impl(self, epoch: int, start_step: int) -> Iterator[tuple]:
         per_shard = [s.indices(epoch) for s in self.samplers]
 
         def batch_idx(step: int) -> np.ndarray:
@@ -183,8 +200,17 @@ class ShardedLoader:
         Yields :meth:`stacked_groups` groups; drive the remaining batches
         (including any ``drop_last=False`` partial one) with
         ``epoch(epoch, start_step=stacked_groups(n) * n)``.  Group gathers
-        ride the native prefetch pool when the loader has one.
+        ride the native prefetch pool when the loader has one, and the
+        Python-thread :func:`device_prefetch` fallback otherwise.
         """
+        it = self._epoch_stacked_impl(epoch, n_steps)
+        if self._pool is None and self.prefetch > 0:
+            from tpudist.data.device_prefetch import device_prefetch
+
+            return device_prefetch(it, depth=self.prefetch)
+        return it
+
+    def _epoch_stacked_impl(self, epoch: int, n_steps: int) -> Iterator[tuple]:
         per_shard = [s.indices(epoch) for s in self.samplers]
         groups = self.stacked_groups(n_steps)
         shardings = None
@@ -239,4 +265,9 @@ class ShardedLoader:
                     pass
 
     def __iter__(self) -> Iterator[tuple]:
+        """Plain iteration == :meth:`epoch` 0: the shuffle is seeded with
+        epoch 0 and the configured ``prefetch`` is honored (native pool or
+        Python-thread fallback alike).  Multi-epoch training should call
+        :meth:`epoch` explicitly so each epoch reseeds; the native gather
+        pool is owned by the loader and reused across epochs."""
         return self.epoch(0)

@@ -10,9 +10,19 @@ readable by any numpy, and restores are validated leaf-by-leaf against the
 template's shapes.
 
 ``Checkpointer`` adds step-numbered directories, retention, and optional
-async (background-thread) saves — the device→host copy happens synchronously
-(cheap) and the disk write overlaps the next steps, which is what makes
-frequent elastic commits affordable.
+async (background-thread) saves.  An async save blocks the caller only to
+INITIATE the copies: every device leaf is first copied ON DEVICE (breaking
+any donation alias — the caller may donate its buffers to the very next
+step) and its device→host transfer started asynchronously; the background
+thread then waits for the transfers and writes to disk, overlapping both
+with subsequent compute (the CheckFreq-style snapshot/persist split).
+``ckpt/save_blocked`` in :mod:`tpudist.obs` records exactly the initiation
+time the caller paid.
+
+Two layouts: ``"steps"`` (the default ``<dir>/step_<N>/`` scheme below) and
+``"flat"`` — the target path IS one ``.npz`` file, no retention — which is
+how ``Trainer`` keeps its single rolling ``snapshot.npz`` on the same save
+path the elastic runtime uses.
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ import os
 import re
 import shutil
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
@@ -30,6 +41,50 @@ import numpy as np
 from tpudist.utils.trees import flatten_with_names, tree_to_numpy, unflatten_like
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _stage_to_host_async(tree: Any) -> Any:
+    """Start (without finishing) a defensive copy of every leaf: device
+    leaves get an ON-DEVICE copy — which breaks any alias a later donating
+    dispatch could reuse, because the copy is ordered on the device stream
+    before it — with their device→host transfer kicked off async; host
+    leaves are copied eagerly (cheap).  ``tree_to_numpy`` on the result
+    (from the background writer thread) blocks only on transfers that have
+    been in flight since initiation."""
+    import jax
+    import jax.numpy as jnp
+
+    def stage(leaf):
+        if isinstance(leaf, jax.Array):
+            dev_copy = jnp.array(leaf, copy=True)
+            try:
+                dev_copy.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - fetch still happens at write
+                pass
+            return dev_copy
+        return np.array(leaf)
+
+    return jax.tree.map(stage, tree)
+
+
+def _meta_jsonable(meta: dict | None) -> dict | None:
+    """Resolve device / numpy scalars in ``meta`` to plain JSON values, so
+    callers can pass UNSYNCED device scalars (e.g. the live step counter)
+    and the fetch lands here — on the background thread for async saves —
+    instead of stalling the caller."""
+    if meta is None:
+        return None
+    out = {}
+    for k, v in meta.items():
+        if v is None or isinstance(v, (str, bool, int, float)):
+            out[k] = v
+            continue
+        try:
+            arr = np.asarray(v)
+            out[k] = arr.item() if arr.ndim == 0 else arr.tolist()
+        except Exception:  # noqa: BLE001 - keep the save alive
+            out[k] = str(v)
+    return out
 
 
 def save_pytree(path: str | os.PathLike, tree: Any, meta: dict | None = None) -> None:
@@ -76,18 +131,31 @@ def latest_step(directory: str | os.PathLike) -> int | None:
 
 
 class Checkpointer:
-    """Step-numbered checkpoint directory manager.
+    """Checkpoint save-path manager (one instance per save target).
 
-    Layout: ``<dir>/step_<N>/state.npz`` (+ meta) with a ``COMMITTED``
-    marker written last — readers only trust marked checkpoints, making the
-    save atomic at the directory level too.
+    ``layout="steps"`` (default): ``<dir>/step_<N>/state.npz`` (+ meta)
+    with a ``COMMITTED`` marker written last — readers only trust marked
+    checkpoints, making the save atomic at the directory level too — and
+    keep-N retention.
+
+    ``layout="flat"``: ``directory`` names one ``.npz`` FILE that every
+    save atomically replaces (``save_pytree`` semantics; ``step`` is
+    recorded in the meta sidecar, retention does not apply) — the rolling
+    single-snapshot contract ``Trainer`` exposes as ``snapshot_path``.
+
+    With ``async_save=True``, :meth:`save` returns after copy INITIATION
+    only (see the module docstring); :meth:`wait` joins the in-flight
+    write, and every save/restore joins the previous write first.
     """
 
     def __init__(self, directory: str | os.PathLike, keep: int = 3,
-                 async_save: bool = False) -> None:
+                 async_save: bool = False, layout: str = "steps") -> None:
+        if layout not in ("steps", "flat"):
+            raise ValueError(f"layout must be 'steps' or 'flat', got {layout!r}")
         self.directory = Path(directory)
         self.keep = keep
         self.async_save = async_save
+        self.layout = layout
         self._thread: threading.Thread | None = None
 
     def wait(self) -> None:
@@ -96,19 +164,40 @@ class Checkpointer:
             self._thread = None
 
     def save(self, step: int, tree: Any, meta: dict | None = None) -> None:
-        # Snapshot to host synchronously: the caller may mutate/donate the
-        # device buffers immediately after we return.
-        host_tree = tree_to_numpy(tree)
-        self.wait()
+        t0 = time.perf_counter()
         if self.async_save:
+            # Initiate the defensive copies (device-side, so a donating
+            # dispatch right after we return cannot clobber them), then
+            # hand the transfer-wait AND the disk write to the thread.
+            staged = _stage_to_host_async(tree)
+            self.wait()
             self._thread = threading.Thread(
-                target=self._write, args=(step, host_tree, meta), daemon=True
-            )
+                target=self._finish_async, args=(step, staged, meta),
+                daemon=True)
             self._thread.start()
         else:
-            self._write(step, host_tree, meta)
+            # Synchronous: full device→host copy before returning — the
+            # caller may mutate/donate the device buffers immediately.
+            host_tree = tree_to_numpy(tree)
+            self.wait()
+            self._write(step, host_tree, _meta_jsonable(meta))
+        try:
+            from tpudist import obs
+
+            obs.histogram("ckpt/save_blocked", unit="s").record(
+                time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001 - metrics never fail a save
+            pass
+
+    def _finish_async(self, step: int, staged: Any, meta: dict | None) -> None:
+        # blocks on the in-flight d2h transfers HERE, not in the caller
+        host_tree = tree_to_numpy(staged)
+        self._write(step, host_tree, _meta_jsonable(meta))
 
     def _write(self, step: int, host_tree: Any, meta: dict | None) -> None:
+        if self.layout == "flat":
+            save_pytree(self.directory, host_tree, meta)
+            return
         step_dir = self.directory / f"step_{step}"
         save_pytree(step_dir / "state.npz", host_tree, meta)
         (step_dir / "COMMITTED").touch()
@@ -125,8 +214,13 @@ class Checkpointer:
 
     def restore_latest(self, template: Any) -> tuple[int, Any, dict] | None:
         """Return ``(step, tree, meta)`` for the newest complete checkpoint,
-        or None when the directory holds none (fresh start)."""
+        or None when the target holds none (fresh start)."""
         self.wait()
+        if self.layout == "flat":
+            if not self.directory.exists():
+                return None
+            tree, meta = restore_pytree(self.directory, template)
+            return int(meta.get("step", 0)), tree, meta
         step = latest_step(self.directory)
         if step is None:
             return None

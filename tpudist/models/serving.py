@@ -115,6 +115,19 @@ class ServeLoop:
         prefill executables.
       stop_tokens / pad_token: EOS semantics as in ``greedy_generate``.
       temperature / top_k / top_p: sampling controls (0 = greedy).
+      pipeline_depth: compiled segments in flight before the host blocks
+        on a fetch.  2 (the default) dispatches segment ``k+1`` as soon
+        as ``k`` returns — the carry chains on device — and then fetches
+        ``k``'s emits (whose device→host copy was started async at
+        dispatch time) overlapped with ``k+1``'s compute, so the device
+        never waits on the host round trip in steady state.  The cost is
+        BOUNDED STALENESS: the host learns stop/budget events one
+        segment later, so admissions and finalizations shift one segment
+        — the same trade the segment design already accepts at
+        ``steps_per_sync`` granularity — while the drain path stays
+        token-identical (frozen rows emit pads in-graph; stale columns
+        are dropped by the same rules as the synchronous loop).  1
+        restores the fully synchronous loop.
     """
 
     def __init__(
@@ -133,12 +146,16 @@ class ServeLoop:
         top_p: Optional[float] = None,
         key: jax.Array | None = None,
         auto_unstack: bool = True,
+        pipeline_depth: int = 2,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if steps_per_sync < 1:
             raise ValueError(
                 f"steps_per_sync must be >= 1, got {steps_per_sync}")
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}")
         if auto_unstack:
             cfg, params = serving_layout(cfg, params)
         if cfg.scan_layers:
@@ -149,6 +166,9 @@ class ServeLoop:
         self.params = params
         self.B = num_slots
         self.steps = steps_per_sync
+        # mutable on purpose: benches flip the SAME instance between
+        # synchronous (1) and pipelined runs, so both share executables
+        self.pipeline_depth = pipeline_depth
         self.prefill_chunk = prefill_chunk
         self.pad_token = int(pad_token)
         self._stop = _stop_array(stop_tokens)
@@ -203,6 +223,11 @@ class ServeLoop:
         self._obs_segments = obs.counter("serve/segments", unit="segments")
         self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
         self._obs_latency = obs.histogram("serve/request_latency", unit="s")
+        # host_wait = time run() actually BLOCKS on a segment fetch (the
+        # np.asarray tail not hidden by later segments' compute); depth
+        # is the live in-flight segment count
+        self._obs_host_wait = obs.histogram("serve/host_wait", unit="s")
+        self._obs_depth = obs.gauge("serve/pipeline_depth", unit="segments")
         # donate every rebound carry: cache, tok, active, remaining, key
         # (argnums 2-4 and 6) mirror _admit_dev — their inputs are dead
         # the moment the segment returns replacements.  `first` (argnum 5)
@@ -425,12 +450,27 @@ class ServeLoop:
 
     def run(self, requests: Sequence[Request]) -> list[Completion]:
         """Serve every request to completion; returns completions in
-        FINISH order (slot events), each with its generated tokens."""
+        FINISH order (slot events), each with its generated tokens.
+
+        The loop keeps up to ``pipeline_depth`` compiled segments in
+        flight: each dispatch chains the device carry immediately and
+        starts an async device→host copy of its emits; the host fetch
+        (and the admission/finalization decisions it feeds) happens
+        while the NEXT segment computes.  A per-slot ``seq`` stamp — the
+        index of the first segment whose emits can carry the slot's
+        tokens — gates draining, so a lane re-admitted while an older
+        segment's emits are still in flight never has stale rows
+        misread as the new request's output.  The drain itself applies
+        the same stop/budget rules as the synchronous loop, so output
+        is token-identical at any depth (greedy selection ignores the
+        RNG key; sampled runs see a shifted key chain across depths)."""
         for req in requests:  # fail BEFORE any slot is touched, not mid-run
             self._validate(req)
         pending = deque(requests)
         slot_state: list[dict | None] = [None] * self.B
         done: list[Completion] = []
+        inflight: deque[tuple[int, jax.Array]] = deque()
+        seq = 0   # segments dispatched so far == index of the next one
 
         def finalize(slot: int, reason: str) -> None:
             st = slot_state[slot]
@@ -463,35 +503,80 @@ class ServeLoop:
                     finalize(slot, "length")
                     return
 
+        def admit_free() -> None:
+            """Fill free lanes from the queue; a new admission's tokens
+            first surface in the NEXT dispatched segment (index ``seq``),
+            so its drain is gated on that stamp."""
+            for slot in range(self.B):
+                if slot_state[slot] is None and pending:
+                    req = pending.popleft()
+                    with obs.span("serve/admit", slot=slot):
+                        slot_state[slot] = self._admit(slot, req)
+                    # stamped here, not in _admit: benches wrap
+                    # loop._admit, and latency must cover the wrapper
+                    slot_state[slot]["t_admit"] = time.perf_counter()
+                    slot_state[slot]["seq"] = seq
+                    self._obs_requests.inc()
+                    obs.recorder.record(
+                        "serve_admit", slot=slot, seq=seq,
+                        prompt_len=int(np.asarray(req.prompt).size),
+                        max_new=req.max_new_tokens)
+            self._obs_queue.set(len(pending))
+
+        def dispatch() -> None:
+            """Chain one more segment on device and start its emits'
+            async device→host copy — no host block."""
+            nonlocal seq
+            # the segment splits per-step keys and returns the advanced
+            # key — no per-wave host-side split dispatch needed
+            with obs.span("serve/segment", steps=self.steps, seq=seq):
+                (self.cache, self._tok, self._active, self._remaining,
+                 self._key, emits) = self._segment(
+                    self.params, self.cache, self._tok, self._active,
+                    self._remaining, self._first, self._key)
+            self._obs_segments.inc()
+            try:
+                emits.copy_to_host_async()
+            except AttributeError:  # non-jax array (test doubles)
+                pass
+            inflight.append((seq, emits))
+            seq += 1
+            self._obs_depth.set(len(inflight))
+
+        def drain_oldest() -> None:
+            """Resolve the oldest in-flight segment: block on its fetch
+            (usually already landed — the copy overlapped later compute),
+            then feed every lane whose stamp says this segment carries
+            its tokens."""
+            s_idx, emits_dev = inflight.popleft()
+            self._obs_depth.set(len(inflight))
+            if not any(st is not None and st["seq"] <= s_idx
+                       for st in slot_state):
+                return  # nothing mapped to this segment — skip the fetch
+            t0 = time.perf_counter()
+            emits = np.asarray(emits_dev)
+            self._obs_host_wait.record(time.perf_counter() - t0)
+            for slot in range(self.B):
+                st = slot_state[slot]
+                if st is not None and st["seq"] <= s_idx:
+                    drain(slot, emits[slot])
+
         # an unhandled exception mid-serve dumps the flight-recorder
         # bundle (admission ring, final snapshot) before propagating
         with obs.recorder.guard("serve_loop", num_slots=self.B,
-                                requests=len(requests)):
-            while pending or any(s is not None for s in slot_state):
-                for slot in range(self.B):
-                    if slot_state[slot] is None and pending:
-                        req = pending.popleft()
-                        with obs.span("serve/admit", slot=slot):
-                            slot_state[slot] = self._admit(slot, req)
-                        # stamped here, not in _admit: benches wrap
-                        # loop._admit, and latency must cover the wrapper
-                        slot_state[slot]["t_admit"] = time.perf_counter()
-                        self._obs_requests.inc()
-                        obs.recorder.record(
-                            "serve_admit", slot=slot,
-                            prompt_len=int(np.asarray(req.prompt).size),
-                            max_new=req.max_new_tokens)
-                self._obs_queue.set(len(pending))
-                # the segment splits per-step keys and returns the advanced
-                # key — no per-wave host-side split dispatch needed
-                with obs.span("serve/segment", steps=self.steps):
-                    (self.cache, self._tok, self._active, self._remaining,
-                     self._key, emits) = self._segment(
-                        self.params, self.cache, self._tok, self._active,
-                        self._remaining, self._first, self._key)
-                self._obs_segments.inc()
-                emits = np.asarray(emits)   # the one host sync per segment
-                for slot in range(self.B):
-                    if slot_state[slot] is not None:
-                        drain(slot, emits[slot])
+                                requests=len(requests),
+                                pipeline_depth=self.pipeline_depth):
+            admit_free()
+            while pending or inflight or any(
+                    s is not None for s in slot_state):
+                if pending or any(s is not None for s in slot_state):
+                    dispatch()
+                # fetch when the pipeline is full — or when there is
+                # nothing left to dispatch and only fetches remain
+                while inflight and (
+                        len(inflight) >= self.pipeline_depth
+                        or not (pending or any(
+                            s is not None for s in slot_state))):
+                    drain_oldest()
+                    admit_free()
         return done
