@@ -1035,8 +1035,8 @@ def bench_input_pipeline(on_tpu: bool) -> None:
           depth=2, batches=loader.steps_per_epoch,
           wall_sync_s=round(wall_sync, 4),
           wall_prefetch_s=round(wall_pre, 4),
-          input_stall_gauge_live=bool(
-              obs.snapshot()["gauges"].get("data/input_stall") is not None),
+          input_stall_metric_live=bool(
+              obs.snapshot()["counters"].get("data/input_stall") is not None),
           rtt_ms=round(_RTT * 1e3, 1))
 
     # (2) snapshot saves: async initiation vs synchronous write

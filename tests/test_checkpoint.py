@@ -121,6 +121,74 @@ def test_async_save_device_tree_survives_donation(tmp_path):
     assert meta["step"] == 1 and meta["tag"] == "e2e"
 
 
+def test_async_meta_scalar_survives_deletion(tmp_path, monkeypatch):
+    """Regression for the meta donation race: the caller's next donating
+    dispatch deletes the live device scalar passed in ``meta`` BEFORE the
+    writer thread resolves it.  Meta must be staged (on-device copy) at
+    save() initiation; a bare reference would resolve to garbage or kill
+    the writer.  The writer is gated so the deletion deterministically
+    happens first."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from tpudist.elastic import checkpoint as ck
+
+    gate = threading.Event()
+    real = ck.tree_to_numpy
+
+    def gated(tree):
+        gate.wait(timeout=10)
+        return real(tree)
+
+    monkeypatch.setattr(ck, "tree_to_numpy", gated)
+    step = jnp.int32(7)
+    ckpt = Checkpointer(tmp_path / "s.npz", async_save=True, layout="flat")
+    ckpt.save(7, {"x": jnp.zeros(8)}, meta={"step": step, "epochs_run": 3})
+    step.delete()  # what a donating dispatch does to the live buffer
+    gate.set()
+    ckpt.wait()    # raises if the writer died on the deleted array
+    _, _, meta = ckpt.restore_latest({"x": np.zeros(8, np.float32)})
+    assert meta["step"] == 7 and meta["epochs_run"] == 3
+
+
+def test_async_save_failure_raises_from_wait(tmp_path, monkeypatch):
+    """A failed background write must surface, not be swallowed: wait()
+    re-raises the captured exception (once), so callers joining before
+    declaring the snapshot durable see the same failure the sync path
+    would have raised."""
+    from tpudist.elastic import checkpoint as ck
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ck, "save_pytree", boom)
+    ckpt = Checkpointer(tmp_path / "s.npz", async_save=True, layout="flat")
+    ckpt.save(0, _tree())
+    with pytest.raises(OSError, match="disk full"):
+        ckpt.wait()
+    ckpt.wait()  # raised once, then cleared
+
+
+def test_async_save_failure_raises_from_next_save(tmp_path, monkeypatch):
+    from tpudist.elastic import checkpoint as ck
+
+    calls = {"n": 0}
+    real = ck.save_pytree
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("boom")
+        return real(*a, **k)
+
+    monkeypatch.setattr(ck, "save_pytree", flaky)
+    ckpt = Checkpointer(tmp_path / "s.npz", async_save=True, layout="flat")
+    ckpt.save(0, _tree())
+    with pytest.raises(OSError, match="boom"):
+        ckpt.save(1, _tree(1))
+
+
 def test_async_flat_save_records_blocked_time(tmp_path):
     from tpudist import obs
 

@@ -77,9 +77,28 @@ def test_early_break_closes_the_source():
 def test_stall_metrics_live():
     list(device_prefetch(iter([np.zeros(2)] * 4), depth=2))
     snap = obs.snapshot()
-    assert "data/input_stall" in snap["gauges"]
+    assert "data/input_stall" in snap["counters"]
     assert snap["histograms"]["data/input_stall_s"]["count"] >= 4
     assert snap["gauges"]["data/prefetch_depth"]["value"] == 2
+
+
+def test_stall_counter_monotonic_across_instances():
+    """A fresh wrapper is created per epoch; ``data/input_stall`` is a
+    shared COUNTER so the series never saw-tooths back to zero when a
+    new instance starts (regression: it was a gauge of an instance-local
+    total)."""
+    def slow():
+        import time
+        for i in range(3):
+            time.sleep(0.01)
+            yield i
+
+    list(device_prefetch(slow(), depth=1))
+    first = obs.snapshot()["counters"]["data/input_stall"]["value"]
+    assert first > 0
+    list(device_prefetch(slow(), depth=1))
+    second = obs.snapshot()["counters"]["data/input_stall"]["value"]
+    assert second >= first
 
 
 def test_loader_prefetch_honored_without_native(monkeypatch):
@@ -102,6 +121,37 @@ def test_loader_prefetch_honored_without_native(monkeypatch):
     for g, w in zip(got, want):
         for a, b in zip(g, w):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainer_feed_single_prefetch_layer(monkeypatch):
+    """Regression for the double wrap: when the loader's Python-thread
+    fallback already prefetches the stream (``thread_prefetch``),
+    ``Trainer._feed`` must pass it through untouched — two DevicePrefetch
+    layers would spawn two workers, double the buffered batches, and
+    double-feed the stall metrics."""
+    from types import SimpleNamespace
+
+    from tpudist.data import native as dnative
+    from tpudist.train.trainer import Trainer
+
+    monkeypatch.setattr(dnative, "available", lambda: False)
+    arrays = [np.zeros((32, 2), np.float32)]
+    pre = ShardedLoader(arrays, global_batch=8, prefetch=2)
+    plain = ShardedLoader(arrays, global_batch=8, prefetch=0)
+    assert pre.thread_prefetch and not plain.thread_prefetch
+
+    def feed(loader):
+        shim = SimpleNamespace(config=SimpleNamespace(device_prefetch=2),
+                               train_loader=loader)
+        stream = loader.epoch(0)
+        return stream, Trainer._feed(shim, stream)
+
+    stream, out = feed(pre)
+    assert out is stream          # already prefetched: passthrough
+    assert len(list(out)) == 4
+    stream, out = feed(plain)
+    assert out is not stream      # unprefetched: the trainer wraps
+    assert len(list(out)) == 4
 
 
 def test_loader_stacked_fallback_matches(monkeypatch):

@@ -12,9 +12,12 @@ never waits on input.  This is the DataLoader-worker + pin_memory role
 
 Instrumentation (see docs/OBSERVABILITY.md):
 
-* ``data/input_stall`` gauge — cumulative seconds the consumer has been
-  blocked waiting for input (the time the accelerator would have idled
-  on the host; near-zero when the pipeline keeps up);
+* ``data/input_stall`` counter — cumulative seconds the consumer has
+  been blocked waiting for input (the time the accelerator would have
+  idled on the host; near-zero when the pipeline keeps up).  A counter,
+  not a gauge: a fresh wrapper is created per epoch, and the shared
+  counter keeps the series monotonic across instances (rate() works;
+  no per-epoch saw-tooth back to zero);
 * ``data/input_stall_s`` histogram — per-fetch stall distribution;
 * ``data/prefetch_depth`` gauge — the configured look-ahead.
 
@@ -62,11 +65,10 @@ class DevicePrefetch:
         self._it = iter(iterable)
         self._put = put
         self._done = False
-        self._stall = obs.gauge("data/input_stall", unit="s")
+        self._stall = obs.counter("data/input_stall", unit="s")
         self._stall_hist = obs.histogram("data/input_stall_s", unit="s")
         self._depth_gauge = obs.gauge("data/prefetch_depth")
         self._depth_gauge.set(depth)
-        self._stalled = 0.0
         self._thread: threading.Thread | None = None
         if depth > 0:
             self._stop = threading.Event()
@@ -121,8 +123,7 @@ class DevicePrefetch:
         t0 = time.perf_counter()
         kind, val = self._q.get()
         stall = time.perf_counter() - t0
-        self._stalled += stall
-        self._stall.set(self._stalled)
+        self._stall.inc(stall)
         self._stall_hist.record(stall)
         if kind == _END:
             self._done = True

@@ -92,6 +92,18 @@ class ShardedLoader:
         return self.steps_per_epoch
 
     @property
+    def thread_prefetch(self) -> bool:
+        """True when :meth:`epoch` / :meth:`epoch_stacked` already wrap
+        their stream in the Python-thread :func:`device_prefetch` fallback
+        (``prefetch > 0``, native pool unavailable).  Callers that layer
+        their own device prefetch (``Trainer._feed``) must check this and
+        not wrap a second time: a double wrap spawns two worker threads,
+        doubles the batches buffered in host memory, and has both
+        instances feeding the same ``data/input_stall`` /
+        ``data/prefetch_depth`` metrics."""
+        return self._pool is None and self.prefetch > 0
+
+    @property
     def steps_per_epoch(self) -> int:
         shard_len = self.samplers[0].shard_size
         if self.drop_last:
@@ -133,7 +145,7 @@ class ShardedLoader:
         look-ahead (including the ``jax.device_put`` per batch) is honored
         either way."""
         it = self._epoch_impl(epoch, start_step)
-        if self._pool is None and self.prefetch > 0:
+        if self.thread_prefetch:
             from tpudist.data.device_prefetch import device_prefetch
 
             return device_prefetch(it, depth=self.prefetch)
@@ -204,7 +216,7 @@ class ShardedLoader:
         Python-thread :func:`device_prefetch` fallback otherwise.
         """
         it = self._epoch_stacked_impl(epoch, n_steps)
-        if self._pool is None and self.prefetch > 0:
+        if self.thread_prefetch:
             from tpudist.data.device_prefetch import device_prefetch
 
             return device_prefetch(it, depth=self.prefetch)

@@ -11,11 +11,13 @@ template's shapes.
 
 ``Checkpointer`` adds step-numbered directories, retention, and optional
 async (background-thread) saves.  An async save blocks the caller only to
-INITIATE the copies: every device leaf is first copied ON DEVICE (breaking
-any donation alias — the caller may donate its buffers to the very next
-step) and its device→host transfer started asynchronously; the background
-thread then waits for the transfers and writes to disk, overlapping both
-with subsequent compute (the CheckFreq-style snapshot/persist split).
+INITIATE the copies: every device leaf — tree leaves AND device scalars in
+``meta`` — is first copied ON DEVICE (breaking any donation alias — the
+caller may donate its buffers to the very next step) and its device→host
+transfer started asynchronously; the background thread then waits for the
+transfers and writes to disk, overlapping both with subsequent compute
+(the CheckFreq-style snapshot/persist split).  A background-write failure
+is re-raised from the next ``wait()``/``save()``, never swallowed.
 ``ckpt/save_blocked`` in :mod:`tpudist.obs` records exactly the initiation
 time the caller paid.
 
@@ -71,7 +73,10 @@ def _meta_jsonable(meta: dict | None) -> dict | None:
     """Resolve device / numpy scalars in ``meta`` to plain JSON values, so
     callers can pass UNSYNCED device scalars (e.g. the live step counter)
     and the fetch lands here — on the background thread for async saves —
-    instead of stalling the caller."""
+    instead of stalling the caller.  Async saves run ``meta`` through
+    :func:`_stage_to_host_async` first, so by the time this resolves, every
+    device scalar is a staged COPY the caller's donating dispatches cannot
+    have deleted."""
     if meta is None:
         return None
     out = {}
@@ -83,7 +88,10 @@ def _meta_jsonable(meta: dict | None) -> dict | None:
             arr = np.asarray(v)
             out[k] = arr.item() if arr.ndim == 0 else arr.tolist()
         except Exception:  # noqa: BLE001 - keep the save alive
-            out[k] = str(v)
+            try:
+                out[k] = str(v)
+            except Exception:  # noqa: BLE001 - repr itself may raise
+                out[k] = f"<unserializable {type(v).__name__}>"
     return out
 
 
@@ -145,7 +153,11 @@ class Checkpointer:
 
     With ``async_save=True``, :meth:`save` returns after copy INITIATION
     only (see the module docstring); :meth:`wait` joins the in-flight
-    write, and every save/restore joins the previous write first.
+    write, and every save/restore joins the previous write first.  A
+    failed background write is NOT silent: its exception is captured and
+    re-raised (once) from the next :meth:`wait` / :meth:`save` /
+    :meth:`restore_latest`, so a caller that joins before declaring the
+    snapshot durable gets the same failure the sync path would have raised.
     """
 
     def __init__(self, directory: str | os.PathLike, keep: int = 3,
@@ -157,11 +169,18 @@ class Checkpointer:
         self.async_save = async_save
         self.layout = layout
         self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
 
     def wait(self) -> None:
+        """Join the in-flight async write; re-raises the exception a failed
+        background write captured (then clears it), so returning normally
+        means the last save is durable on disk."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
     def save(self, step: int, tree: Any, meta: dict | None = None) -> None:
         t0 = time.perf_counter()
@@ -169,10 +188,15 @@ class Checkpointer:
             # Initiate the defensive copies (device-side, so a donating
             # dispatch right after we return cannot clobber them), then
             # hand the transfer-wait AND the disk write to the thread.
+            # Meta rides the same staging: its values may be live device
+            # scalars (the trainer's step counter) that the caller's next
+            # donating dispatch would delete before the writer resolves
+            # them — the on-device copy breaks that alias too.
             staged = _stage_to_host_async(tree)
+            staged_meta = _stage_to_host_async(meta) if meta is not None else None
             self.wait()
             self._thread = threading.Thread(
-                target=self._finish_async, args=(step, staged, meta),
+                target=self._finish_async, args=(step, staged, staged_meta),
                 daemon=True)
             self._thread.start()
         else:
@@ -190,9 +214,12 @@ class Checkpointer:
             pass
 
     def _finish_async(self, step: int, staged: Any, meta: dict | None) -> None:
-        # blocks on the in-flight d2h transfers HERE, not in the caller
-        host_tree = tree_to_numpy(staged)
-        self._write(step, host_tree, _meta_jsonable(meta))
+        try:
+            # blocks on the in-flight d2h transfers HERE, not in the caller
+            host_tree = tree_to_numpy(staged)
+            self._write(step, host_tree, _meta_jsonable(meta))
+        except BaseException as e:  # noqa: BLE001 - surfaced from wait()
+            self._error = e
 
     def _write(self, step: int, host_tree: Any, meta: dict | None) -> None:
         if self.layout == "flat":

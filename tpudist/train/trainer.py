@@ -203,8 +203,10 @@ class Trainer:
     def _save_snapshot(self, epoch: int) -> None:
         if jax.process_index() != 0:
             return
-        # step stays a DEVICE scalar: Checkpointer resolves meta values on
-        # the writer thread, so the epoch boundary never syncs on it
+        # step stays a DEVICE scalar: Checkpointer stages an on-device
+        # copy at initiation (so the next epoch's donating dispatch can't
+        # delete it) and resolves it on the writer thread — the epoch
+        # boundary never syncs on it
         with obs.span("snapshot_save", epoch=epoch):
             self._ckpt.save(
                 epoch,
@@ -223,9 +225,14 @@ class Trainer:
     def _feed(self, batches):
         """Device-input pipelining for the hot loop: keep
         ``config.device_prefetch`` batches' transfers in flight ahead of
-        the step (0 = plain synchronous pull).  Consumer stalls surface
-        as the ``data/input_stall`` gauge."""
-        if self.config.device_prefetch > 0:
+        the step (0 = plain synchronous pull).  Skipped when the loader's
+        Python-thread fallback already drives the stream ahead
+        (``ShardedLoader.thread_prefetch``): one prefetch layer only —
+        wrapping twice would double the buffered batches and the worker
+        threads.  Consumer stalls surface as the ``data/input_stall``
+        counter."""
+        if (self.config.device_prefetch > 0
+                and not self.train_loader.thread_prefetch):
             return device_prefetch(batches, depth=self.config.device_prefetch)
         return batches
 
