@@ -1582,6 +1582,152 @@ def bench_speculative_decode(on_tpu: bool) -> None:
               exact_match=match_k, rtt_ms=round(_RTT * 1e3, 1))
 
 
+def bench_host_allreduce(on_tpu: bool) -> None:
+    """The host-collective cost model, measured: {flat, ring, ring+bf16}
+    × {small, large tree} × world sizes over the real coordination store
+    (threads sharing one server — same wire protocol as the multi-process
+    elastic gang).  Emits per-rank wire bytes (``wire_bytes_per_rank`` =
+    FETCHED bytes, the flat path's O(world × size) term the ISSUE names)
+    and wall time, plus a ``bitwise_match`` flag over the replicas — the
+    determinism contract under measurement, not just under test.
+
+    A second section measures async overlap: microbatch gradient
+    accumulation through ``OverlappedGradSync`` vs the same sync loop,
+    reporting blocked-in-allreduce time for both and bitwise equality of
+    the final accumulated gradient."""
+    import threading
+
+    import numpy as np
+
+    from tpudist.elastic.worker import OverlappedGradSync
+    from tpudist.runtime.collectives import CollectiveConfig, HostCollectives
+    from tpudist.runtime.coord import CoordClient, CoordServer
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_host_allreduce", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    def run_world(world, fn):
+        results, errors = [None] * world, []
+
+        def work(rank):
+            try:
+                with CoordClient(port=server.port) as client:
+                    results[rank] = fn(rank, client)
+            except Exception as e:  # noqa: BLE001
+                errors.append((rank, repr(e)))
+
+        threads = [threading.Thread(target=work, args=(r,))
+                   for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        if errors:
+            raise RuntimeError(f"allreduce bench workers failed: {errors}")
+        return results
+
+    rng = np.random.default_rng(0)
+    trees = {
+        "small": rng.standard_normal(1024).astype(np.float32),     # 4 KiB
+        "large": rng.standard_normal(512 * 1024).astype(np.float32),  # 2 MiB
+    }
+    algos = [("flat", "none"), ("ring", "none"), ("ring_bf16", "bf16")]
+    rid = 100
+    for world in (2, 4):
+        for tree_name, data in trees.items():
+            for algo_name, compress in algos:
+                algo = "ring" if algo_name.startswith("ring") else "flat"
+                cfg = CollectiveConfig(algorithm=algo, compress=compress,
+                                       bucket_bytes=256 << 10)
+                rid += 1
+                this_rid = rid
+
+                def fn(rank, client):
+                    coll = HostCollectives(
+                        client, rank, world, round_id=this_rid,
+                        timeout_s=60.0, config=cfg)
+                    tree = {"g": data * (rank + 1)}
+                    coll.allreduce_sum(tree)  # warm connections/threads
+                    coll.bytes_posted = coll.bytes_fetched = 0
+                    t0 = time.perf_counter()
+                    out = coll.allreduce_sum(tree)
+                    dt = time.perf_counter() - t0
+                    fetched, posted = coll.bytes_fetched, coll.bytes_posted
+                    coll.close()
+                    return out["g"].tobytes(), dt, fetched, posted
+
+                outs = run_world(world, fn)
+                blobs = {o[0] for o in outs}
+                _emit("host_allreduce",
+                      round(max(o[1] for o in outs), 5), "s", None,
+                      algo=algo_name, world=world, tree=tree_name,
+                      size_bytes=int(data.nbytes),
+                      wire_bytes_per_rank=max(o[2] for o in outs),
+                      bytes_posted_per_rank=max(o[3] for o in outs),
+                      bitwise_match=len(blobs) == 1)
+
+    # -- async overlap: microbatch accumulation vs the sync loop ----------
+    world, microbatches = 2, 6
+    grad = rng.standard_normal(256 * 1024).astype(np.float32)
+    compute = np.full((160, 160), 1.0 / 160, np.float32)  # norm-1: no overflow
+
+    def host_compute():
+        # the per-microbatch forward/backward stand-in the overlap hides;
+        # sized to a few ms so it is comparable to the allreduce's wire
+        # time (numpy matmul releases the GIL, like a real jax dispatch)
+        x = compute
+        for _ in range(60):
+            x = x @ compute
+        return x
+
+    def fn_overlap(rank, client):
+        coll = HostCollectives(
+            client, rank, world, round_id=300, timeout_s=60.0,
+            config=CollectiveConfig(algorithm="ring", compress="none",
+                                    bucket_bytes=256 << 10))
+        tree = {"g": grad * (rank + 1)}
+        coll.allreduce_sum(tree)  # warm
+        # sync: compute, then block in allreduce, per microbatch
+        sync_wait = 0.0
+        total_sync = None
+        for _ in range(microbatches):
+            host_compute()
+            t0 = time.perf_counter()
+            out = coll.allreduce_sum(tree)
+            sync_wait += time.perf_counter() - t0
+            total_sync = (out if total_sync is None else
+                          {"g": total_sync["g"] + out["g"]})
+        # async: submit, overlap the next microbatch's compute, wait at
+        # the end (in submission order — bitwise-identical accumulation)
+        sync_obj = OverlappedGradSync(coll)
+        async_wait = 0.0
+        for _ in range(microbatches):
+            t0 = time.perf_counter()
+            sync_obj.push(tree)
+            async_wait += time.perf_counter() - t0
+            host_compute()
+        t0 = time.perf_counter()
+        total_async = sync_obj.reduce()
+        async_wait += time.perf_counter() - t0
+        equal = total_sync["g"].tobytes() == total_async["g"].tobytes()
+        coll.close()
+        return sync_wait, async_wait, equal
+
+    outs = run_world(world, fn_overlap)
+    sync_wait = max(o[0] for o in outs)
+    async_wait = max(o[1] for o in outs)
+    _emit("host_allreduce_overlap", round(async_wait, 5), "s",
+          round(async_wait / max(sync_wait, 1e-9), 3),
+          world=world, microbatches=microbatches,
+          sync_wait_s=round(sync_wait, 5),
+          state_equal=all(o[2] for o in outs))
+    server.stop()
+
+
 def main() -> None:
     import jax
 
@@ -1597,7 +1743,7 @@ def main() -> None:
                bench_moe, bench_flash_decode_bandwidth,
                bench_serve_loop, bench_input_pipeline, bench_serve_capacity,
                bench_pipeline_spans, bench_tp_flash_decode,
-               bench_speculative_decode]
+               bench_speculative_decode, bench_host_allreduce]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

@@ -51,6 +51,11 @@ def main(argv=None) -> float:
              "--min-nprocs 2 --elastic-inprocess -- "
              "examples/horovod_mnist_elastic_tpu.py --elastic ttl` and "
              "kill -9 a worker to watch survivors re-rendezvous")
+    parser.add_argument(
+        "--overlap", action="store_true",
+        help="ttl mode: submit gradient allreduce async and prepare the "
+             "next batch during the wire time (hvd.DistributedOptimizer "
+             "overlap; identical numerics, lower step latency)")
     args = parser.parse_args(argv)
 
     if args.elastic == "ttl":
@@ -222,8 +227,19 @@ def _ttl_main(args) -> float:
                 loss, grads = grads_fn(
                     es.state.params, train_ds.images[sel],
                     train_ds.labels[sel], rng)
-                grads, gloss = ctx.collectives.allreduce_mean(
-                    (grads, np.asarray(float(loss))))
+                payload = (grads, np.asarray(float(loss)))
+                if args.overlap:
+                    # async submit: the next batch's index selection and
+                    # host-side staging ride the allreduce's wire time;
+                    # wait() returns the identical tree the sync call would
+                    handle = ctx.collectives.allreduce_mean_async(payload)
+                    if b + 1 < steps:
+                        np.ascontiguousarray(train_ds.images[
+                            idx[(b + 1) * args.batch_size:
+                                (b + 2) * args.batch_size]])
+                    grads, gloss = handle.wait()
+                else:
+                    grads, gloss = ctx.collectives.allreduce_mean(payload)
                 es.state = es.state.apply_gradients(grads)
                 es.host.epoch, es.host.batch = epoch, b + 1
                 if (b + 1) % args.commit_every == 0:

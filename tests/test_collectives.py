@@ -8,11 +8,17 @@ across real process boundaries.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
 
-from tpudist.runtime.collectives import HostCollectives, PeerLost
+from tpudist.runtime import collectives as C
+from tpudist.runtime.collectives import (
+    CollectiveConfig,
+    HostCollectives,
+    PeerLost,
+)
 from tpudist.runtime.coord import CoordClient, CoordServer, Rendezvous
 
 
@@ -149,6 +155,358 @@ def test_on_wait_hook_can_abort(server):
         return True
 
     assert _run_world(server, 1, fn)[0] is True
+
+
+def _tree_bytes(tree: dict) -> bytes:
+    return b"".join(np.ascontiguousarray(v).tobytes()
+                    for _, v in sorted(tree.items()))
+
+
+def _ring_cfg(**kw) -> CollectiveConfig:
+    base = dict(algorithm="ring", bucket_bytes=2048, compress="none")
+    base.update(kw)
+    return CollectiveConfig(**base)
+
+
+class TestRingAllreduce:
+    """The bandwidth-optimal path: chunked ring reduce-scatter + star
+    all-gather over the store, with and without bf16 wire compression."""
+
+    @pytest.mark.parametrize("compress", ["none", "bf16"])
+    def test_replicas_bitwise_identical(self, server, compress):
+        """The elastic-grow checksum invariant: every rank's result is
+        BITWISE the same tree, across multiple fused buckets and a
+        non-divisible chunk split, compression on or off."""
+        world = 4
+        rid = 20 if compress == "none" else 21
+        rng = np.random.default_rng(7)
+        trees = [{"w": rng.standard_normal(2000 + 13).astype(np.float32) * (r + 1),
+                  "b": rng.standard_normal(5).astype(np.float32) + r}
+                 for r in range(world)]
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, world, round_id=rid,
+                                   config=_ring_cfg(compress=compress))
+            out = coll.allreduce_sum(trees[rank])
+            coll.close()
+            return out
+
+        results = _run_world(server, world, fn)
+        blobs = {_tree_bytes(out) for out in results}
+        assert len(blobs) == 1, "replicas diverged"
+        assert results[0]["w"].dtype == np.float32
+
+    def test_flat_vs_ring_numerics(self, server):
+        """Same data through both algorithms: results agree to float32
+        tolerance (addition order differs, values must not)."""
+        world = 3
+        rng = np.random.default_rng(11)
+        trees = [{"g": rng.standard_normal(999).astype(np.float32)}
+                 for _ in range(world)]
+
+        def run(rid, algo):
+            def fn(rank, client):
+                coll = HostCollectives(
+                    client, rank, world, round_id=rid,
+                    config=CollectiveConfig(algorithm=algo,
+                                            bucket_bytes=1024,
+                                            compress="none"))
+                out = coll.allreduce_sum(trees[rank])
+                coll.close()
+                return out
+
+            return _run_world(server, world, fn)
+
+        flat = run(22, "flat")
+        ring = run(23, "ring")
+        # addition order differs between the algorithms; values must agree
+        # to f32 reorder tolerance (ULPs on near-zero sums)
+        np.testing.assert_allclose(flat[0]["g"], ring[0]["g"],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bf16_compression_accuracy_and_ratio(self, server):
+        """bf16 wire + fp32 accumulation: result within a few percent of
+        the f64 reference, and the wire carries about half the bytes."""
+        world = 4
+        rng = np.random.default_rng(3)
+        trees = [{"g": rng.standard_normal(4096).astype(np.float32)}
+                 for _ in range(world)]
+        ref = sum(t["g"].astype(np.float64) for t in trees)
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, world, round_id=24,
+                                   config=_ring_cfg(compress="bf16"))
+            out = coll.allreduce_sum(trees[rank])
+            posted = coll.bytes_posted
+            coll.close()
+            return out, posted
+
+        results = _run_world(server, world, fn)
+        out, posted = results[0]
+        # normalized L2: bf16 carries ~8 mantissa bits (rel ~4e-3/step);
+        # a few wire hops stay well under 5%
+        err = (np.linalg.norm(out["g"] - ref)
+               / np.linalg.norm(ref))
+        assert err < 0.05, f"bf16 error too large: {err}"
+        native = trees[0]["g"].nbytes
+        # ring posts ~1x the WIRE size per rank; bf16 halves it
+        assert posted < 0.6 * native, (posted, native)
+        from tpudist import obs
+
+        assert "coll/compress_ratio" in obs.snapshot()["gauges"]
+
+    def test_mixed_dtypes_stay_exact_under_compression(self, server):
+        """Compression applies to float32 only: int64 / f64 / bool groups
+        ride the wire raw and reduce exactly (the root-election score and
+        the legacy allreduce contracts depend on this)."""
+        world = 3
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, world, round_id=25,
+                                   config=_ring_cfg(compress="bf16",
+                                                    bucket_bytes=256))
+            out = coll.allreduce_sum({
+                "i": np.arange(100, dtype=np.int64) * (rank + 1),
+                "d": np.linspace(0.0, 1.0, 77) * (rank + 1),
+            })
+            coll.close()
+            return out
+
+        results = _run_world(server, world, fn)
+        np.testing.assert_array_equal(
+            results[0]["i"], np.arange(100, dtype=np.int64) * 6)
+        assert results[0]["i"].dtype == np.int64
+        assert results[0]["d"].dtype == np.float64
+        blobs = {_tree_bytes(out) for out in results}
+        assert len(blobs) == 1
+
+    def test_grow_and_shrink_rounds_on_ring(self, server):
+        """The elastic resize pattern on the ring path: round N at world
+        4, shrink to 3, grow back to 4 — fresh HostCollectives per round
+        (as the elastic worker builds them), replicas bitwise identical
+        in every round."""
+        rng = np.random.default_rng(5)
+        data = rng.standard_normal(1500).astype(np.float32)
+
+        def run_round(rid, world):
+            def fn(rank, client):
+                coll = HostCollectives(client, rank, world, round_id=rid,
+                                       config=_ring_cfg())
+                out = coll.allreduce_sum({"g": data * (rank + 1)})
+                coll.close()  # close_round would race peers' AG fetches
+                return out
+
+            results = _run_world(server, world, fn)
+            assert len({_tree_bytes(o) for o in results}) == 1
+            scale = sum(range(1, world + 1))
+            np.testing.assert_allclose(results[0]["g"], data * scale,
+                                       rtol=1e-4)
+
+        run_round(26, 4)
+        run_round(27, 3)  # shrink
+        run_round(28, 4)  # grow
+
+    def test_ring_keys_bounded_and_cleaned(self, server):
+        """The op-2 GC holds for the ring's multi-key ops: repeated
+        allreduces leave a bounded key set, close_round clears it."""
+        world = 3
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, world, round_id=29,
+                                   config=_ring_cfg(bucket_bytes=512))
+            for _ in range(3):
+                coll.allreduce_sum({"x": np.ones(600, np.float32) * rank})
+            return coll
+
+        colls = _run_world(server, world, fn)
+        with CoordClient(port=server.port) as probe:
+            after3 = len(probe.keys("coll/29/"))
+            # 3 more ops: steady state, the key count must not grow
+            def fn2(rank, client):
+                coll = colls[rank]
+                coll.client = client
+                for _ in range(3):
+                    coll.allreduce_sum({"x": np.ones(600, np.float32)})
+                return True
+
+            _run_world(server, world, fn2)
+            assert len(probe.keys("coll/29/")) <= after3
+            colls[0].client = probe
+            colls[0].close_round()
+            assert probe.keys("coll/29/") == []
+
+
+class TestSharedDeadline:
+    def test_peer_dying_mid_ring_fails_within_one_timeout(self, server):
+        """Regression (per-chunk deadlines): a peer that posts its first
+        reduce-scatter chunk then stops must surface PeerLost after ~one
+        timeout_s, not once per remaining chunk/bucket."""
+        world, rid, timeout = 3, 30, 1.2
+        cfg = CollectiveConfig(algorithm="ring", bucket_bytes=256,
+                               compress="none")
+        n = 2000  # 8000B f32 -> ~32 buckets of 256B: many pending fetches
+
+        def fn(rank, client):
+            tree = {"x": np.full(n, float(rank), np.float32)}
+            if rank == 2:
+                # the half-dead peer: post step-0 chunks in the real wire
+                # format, then stop (a kill -9 between chunk posts)
+                import jax
+
+                leaves = [np.asarray(v) for v in jax.tree.leaves(tree)]
+                buckets, _ = C._fuse(leaves, cfg)
+                for bi, b in enumerate(buckets):
+                    lo, hi = C._chunk_bounds(len(b.data), world)[rank]
+                    client.set(f"coll/{rid}/0/rs/{bi}/0/{rank}",
+                               C._encode(b.data[lo:hi], b.wire))
+                return None
+            coll = HostCollectives(client, rank, world, round_id=rid,
+                                   timeout_s=timeout, config=cfg)
+            t0 = time.monotonic()
+            with pytest.raises(PeerLost):
+                coll.allreduce_sum(tree)
+            elapsed = time.monotonic() - t0
+            coll.close()
+            return elapsed
+
+        results = _run_world(server, world, fn)
+        for rank in (0, 1):
+            assert results[rank] < 2.5 * timeout, (
+                f"rank {rank} took {results[rank]:.1f}s — deadline not "
+                f"shared across chunks")
+
+
+class TestFetchOrder:
+    def test_flat_fetch_starts_at_right_neighbor(self, server):
+        """Anti-hot-spot stagger: each rank's FIRST peer fetch targets
+        (rank+1) % world, not rank 0 (reduction order stays rank-ordered
+        for bitwise agreement — only the fetch sequence rotates)."""
+        world, rid = 3, 31
+
+        class RecordingClient(CoordClient):
+            def __init__(self, port):
+                super().__init__(port=port)
+                self.fetched: list[str] = []
+
+            def get(self, key):
+                val = super().get(key)
+                if val is not None and key.startswith(f"coll/{rid}/"):
+                    self.fetched.append(key)
+                return val
+
+        def fn(rank, client):
+            rec = RecordingClient(server.port)
+            try:
+                coll = HostCollectives(
+                    rec, rank, world, round_id=rid,
+                    config=CollectiveConfig(algorithm="flat"))
+                coll.allreduce_sum({"x": np.ones(4, np.float32) * rank})
+                first_peer = int(rec.fetched[0].rsplit("/", 1)[1])
+                return rank, first_peer
+            finally:
+                rec.close()
+
+        for rank, first_peer in _run_world(server, world, fn):
+            assert first_peer == (rank + 1) % world, (rank, first_peer)
+
+
+class TestAsyncHandles:
+    def test_async_matches_sync_bitwise(self, server):
+        """wait() returns exactly the tree the sync call would have, for
+        a queue of overlapping submissions, and a sync op after async
+        ones drains them (op ids stay agreed)."""
+        world, rid = 3, 32
+        rng = np.random.default_rng(9)
+        payloads = [rng.standard_normal(700).astype(np.float32)
+                    for _ in range(4)]
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, world, round_id=rid,
+                                   config=_ring_cfg(bucket_bytes=1024))
+            handles = [coll.allreduce_sum_async({"g": p * (rank + 1)})
+                       for p in payloads]
+            outs = [h.wait(30) for h in handles]
+            tail = coll.allreduce_sum({"t": np.ones(3, np.float32) * rank})
+            coll.close()
+            return outs, tail
+
+        results = _run_world(server, world, fn)
+        scale = sum(range(1, world + 1))
+        for i, p in enumerate(payloads):
+            blobs = {results[r][0][i]["g"].tobytes() for r in range(world)}
+            assert len(blobs) == 1
+            np.testing.assert_allclose(results[0][0][i]["g"], p * scale,
+                                       rtol=1e-4)
+        np.testing.assert_array_equal(
+            results[0][1]["t"], np.full(3, sum(range(world)), np.float32))
+
+    def test_worker_thread_error_reraises_from_wait(self, server):
+        """A PeerLost hit on the background worker must surface from
+        wait() on the caller's thread, not vanish."""
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, 2, round_id=33,
+                                   timeout_s=1.0, config=_ring_cfg())
+            if rank == 1:
+                return True  # never participates
+            h = coll.allreduce_sum_async(
+                {"x": np.ones(50_000, np.float32)})
+            with pytest.raises(PeerLost):
+                h.wait(15)
+            coll.close()
+            return True
+
+        assert all(_run_world(server, 2, fn))
+
+    def test_on_wait_hook_raises_through_async_wait(self, server):
+        """The elastic WorldChanged path: on_wait raising on the worker
+        thread re-raises from Handle.wait()."""
+
+        class Boom(RuntimeError):
+            pass
+
+        def raiser():
+            raise Boom()
+
+        def fn(rank, client):
+            coll = HostCollectives(client, rank, 2, round_id=34,
+                                   timeout_s=20.0, on_wait=raiser,
+                                   config=_ring_cfg())
+            h = coll.allreduce_sum_async({"x": np.ones(9000, np.float32)})
+            with pytest.raises(Boom):
+                h.wait(15)
+            coll.close()
+            return True
+
+        assert _run_world(server, 1, fn)[0] is True
+
+
+class TestCollectiveConfig:
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_COLL_ALGO", "ring")
+        monkeypatch.setenv("TPUDIST_COLL_BUCKET_BYTES", "8192")
+        monkeypatch.setenv("TPUDIST_COLL_COMPRESS", "fp16")
+        monkeypatch.setenv("TPUDIST_COLL_FLAT_MAX_BYTES", "128")
+        cfg = CollectiveConfig.from_env()
+        assert cfg == CollectiveConfig(algorithm="ring", bucket_bytes=8192,
+                                       compress="fp16", flat_max_bytes=128)
+
+    def test_defaults(self):
+        cfg = CollectiveConfig()
+        assert cfg.algorithm == "auto"
+        assert cfg.compress == "bf16"
+
+    def test_rejects_unknown_values(self):
+        with pytest.raises(ValueError):
+            CollectiveConfig(algorithm="tree")
+        with pytest.raises(ValueError):
+            CollectiveConfig(compress="zstd")
+
+    def test_auto_picks_flat_for_small_and_ring_for_large(self):
+        cfg = CollectiveConfig()
+        # mirrors _run_allreduce's switch: world<=2 or small payload -> flat
+        assert 100 <= cfg.flat_max_bytes
+        assert cfg.flat_max_bytes < 4 << 20
 
 
 class TestJoinLive:

@@ -138,6 +138,49 @@ def test_double_kill_shrinks_to_one(tmp_path):
     assert done["lr"] == pytest.approx(0.1 * (2 / 3) * (1 / 2))
 
 
+def test_kill9_ring_path_with_overlap(tmp_path):
+    """The shrink lifecycle on the BANDWIDTH-OPTIMAL path: ring reduce-
+    scatter allreduce (small buckets so every step runs multiple fused
+    buckets), bf16 default compression, and async-overlap gradient sync
+    (WORKER_OVERLAP submits the allreduce and prepares the next batch
+    during the wire time).  Every invariant of the flat sync test must
+    hold unchanged — same resume point, same lr rescale, and BITWISE-
+    identical survivor checksums: the ring's fixed per-chunk reduction
+    order and the handles' in-order waits preserve replica agreement."""
+    rc = launch(
+        [sys.executable, WORKER], nprocs=3, min_nprocs=2,
+        elastic_inprocess=True,
+        env={"WORKER_OUT_DIR": str(tmp_path),
+             "WORKER_KILL_SPAWN_ID": "2",
+             "WORKER_KILL_AT_STEP": "13",
+             "WORKER_OVERLAP": "1",
+             "TPUDIST_COLL_ALGO": "ring",
+             "TPUDIST_COLL_BUCKET_BYTES": "1024"},
+    )
+    assert rc == 0
+
+    victim = _events(tmp_path, 2)
+    assert victim[-1] == {"event": "suicide", "step": 13}
+
+    for sid in (0, 1):
+        ev = _events(tmp_path, sid)
+        rounds = [e for e in ev if e["event"] == "round"]
+        assert rounds[0]["world"] == 3 and rounds[0]["resume_batch"] == 0
+        assert rounds[-1]["world"] == 2
+        assert rounds[-1]["resume_batch"] == 10
+        resets = [e for e in ev if e["event"] == "reset"]
+        assert resets[-1]["old_world"] == 3
+        assert resets[-1]["new_world"] == 2
+        done = [e for e in ev if e["event"] == "done"]
+        assert done[-1]["steps"] == 30 and done[-1]["world"] == 2
+        assert done[-1]["lr"] == pytest.approx(0.1 * 2 / 3)
+
+    d0 = _events(tmp_path, 0)[-1]
+    d1 = _events(tmp_path, 1)[-1]
+    assert d0["checksum"] == d1["checksum"]
+    assert d0["loss"] == d1["loss"]
+
+
 def test_full_gang_loss_resumes_from_durable_commit(tmp_path):
     """ALL workers die (kill -9) mid-training — no survivor holds the state
     in memory, so the in-memory broadcast path cannot recover it.  The

@@ -121,6 +121,26 @@ class TestIciCollectivesSingleProcess:
         assert coll.local_rows == jax.local_device_count()
         assert coll.num_processes == jax.process_count()
 
+    def test_async_handle_matches_sync(self):
+        coll = IciCollectives(self._mesh())
+        grads = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        sync = coll.allreduce_mean(grads)
+        h = coll.allreduce_mean_async(grads)
+        out = h.wait()
+        assert h.done()
+        np.testing.assert_array_equal(out["w"], sync["w"])
+        hs = coll.allreduce_sum_async(grads)
+        np.testing.assert_allclose(
+            hs.wait()["w"], sync["w"] * jax.process_count())
+
+    def test_async_handles_overlap_in_flight(self):
+        # several submissions may be in flight at once; waits in any order
+        coll = IciCollectives(self._mesh())
+        handles = [coll.allreduce_mean_async(
+            {"x": np.full(8, float(i), np.float32)}) for i in range(3)]
+        for i, h in reversed(list(enumerate(handles))):
+            np.testing.assert_allclose(h.wait()["x"], np.full(8, float(i)))
+
 
 class TestElasticContextDefaults:
     def test_host_plane_defaults(self):

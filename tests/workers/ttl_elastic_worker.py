@@ -58,6 +58,10 @@ OUT = os.environ["WORKER_OUT_DIR"]
 # per-round jax.distributed world) — the train_fn below is IDENTICAL for
 # both: ctx.collectives carries the same allreduce_mean API either way
 DATA_PLANE = os.environ.get("WORKER_DATA_PLANE", "host")
+# overlap mode: submit the allreduce async and prepare the next batch
+# while the wire time elapses — same collective, same result, so every
+# checksum assertion of the sync tests must keep holding
+OVERLAP = os.environ.get("WORKER_OVERLAP", "") not in ("", "0", "false")
 
 
 def emit(event: str, **fields) -> None:
@@ -125,8 +129,19 @@ def main() -> int:
                 state.state.params, gx[lo:lo + shard], gy[lo:lo + shard])
             # one fused allreduce syncs grads AND the scalar loss (the
             # XLA-fusion analog on the control plane: one payload)
-            grads, gloss = ctx.collectives.allreduce_mean(
-                (grads, np.asarray(float(loss), np.float32)))
+            payload = (grads, np.asarray(float(loss), np.float32))
+            if OVERLAP:
+                # async submit; the next step's batch generation (host
+                # work) rides the allreduce's wire time.  wait() returns
+                # the identical tree the sync call would — errors
+                # (PeerLost/WorldChanged) re-raise here, where the
+                # elastic loop's handlers expect them
+                handle = ctx.collectives.allreduce_mean_async(payload)
+                if step + 1 < TOTAL_STEPS:
+                    global_batch(step + 1)
+                grads, gloss = handle.wait()
+            else:
+                grads, gloss = ctx.collectives.allreduce_mean(payload)
             if ctx.data_plane == "ici" and not hlo_emitted:
                 # the proof the verdict asked for: this round's gradient
                 # sync is a compiled XLA all-reduce, not store traffic

@@ -42,7 +42,9 @@ import numpy as np
 from tpudist import obs
 from tpudist.elastic.loop import WorldChanged
 from tpudist.elastic.state import ElasticState
-from tpudist.runtime.collectives import HostCollectives, PeerLost
+from tpudist.runtime.collectives import (
+    CollectiveConfig, Handle, HostCollectives, PeerLost,
+)
 from tpudist.runtime.coord import CoordClient, ElasticMonitor, Rendezvous
 from tpudist.runtime.ici import host_snapshot
 from tpudist.utils.logging import get_logger
@@ -80,6 +82,59 @@ class ElasticContext:
 # state.commit() + ctx.check() at its commit points and
 # ctx.collectives.allreduce_mean(...) for gradient sync.
 TrainFn = Callable[[ElasticState, ElasticContext], None]
+
+
+class OverlappedGradSync:
+    """Microbatch gradient sync that overlaps wire time with compute —
+    the ``hvd.DistributedOptimizer`` pattern (`mnist_horovod.py:53`:
+    allreduce of microbatch ``m`` rides the background worker while the
+    caller computes microbatch ``m+1``), with the final state BITWISE
+    identical to the synchronous path.
+
+    Usage inside a train function::
+
+        sync = OverlappedGradSync(ctx.collectives)
+        for mb in microbatches:
+            sync.push(grad_fn(params, mb))   # starts the allreduce
+            # ... next microbatch's forward/backward overlaps it ...
+        total = sync.reduce()                # waits, sums in push order
+
+    Determinism: handles are waited in submission order and summed in
+    that same fixed order, so the result equals summing the synchronous
+    allreduce outputs — no stale-gradient pipelining, no reordering.
+    Falls back to synchronous allreduce when the plane has no async API
+    (:class:`~tpudist.runtime.ici.IciCollectives` before PR 4, custom
+    planes)."""
+
+    def __init__(self, collectives: Any) -> None:
+        self._coll = collectives
+        self._async = getattr(collectives, "allreduce_sum_async", None)
+        self._handles: list[tuple[Handle | Any, bool]] = []
+
+    def push(self, tree: Any) -> None:
+        """Submit one microbatch's gradient tree for summing across ranks."""
+        if self._async is not None:
+            self._handles.append((self._async(tree), True))
+        else:
+            self._handles.append((self._coll.allreduce_sum(tree), False))
+
+    def reduce(self, mean: bool = False) -> Any:
+        """Wait for every pushed allreduce (in push order) and return the
+        elementwise sum; ``mean=True`` divides by ``pushes × world``.
+        Worker-thread errors (``PeerLost`` / ``WorldChanged``) re-raise
+        here, exactly where the synchronous path would have raised."""
+        if not self._handles:
+            raise ValueError("reduce() with no pushed gradients")
+        handles, self._handles = self._handles, []
+        total = None
+        for h, is_handle in handles:
+            out = h.wait() if is_handle else h
+            total = out if total is None else jax.tree.map(
+                np.add, total, out)
+        if mean:
+            scale = len(handles) * getattr(self._coll, "world", 1)
+            total = jax.tree.map(lambda x: x / scale, total)
+        return total
 
 
 def _next_round(client: CoordClient, round_id: int) -> int:
@@ -140,6 +195,7 @@ def run_elastic_worker(
     max_rounds: int = 10,
     rendezvous_timeout_s: float = 60.0,
     data_plane: str = "host",
+    coll_config: CollectiveConfig | None = None,
 ) -> ElasticState:
     """Run ``train_fn`` under TTL-heartbeat elastic supervision.
 
@@ -161,6 +217,12 @@ def run_elastic_worker(
       A peer dying mid-collective surfaces as a catchable runtime error
       (see :mod:`tpudist.runtime.ici`) and is handled exactly like
       :class:`PeerLost` on the host plane.
+
+    ``coll_config`` tunes the host collectives (algorithm / bucket_bytes /
+    compression — see :class:`~tpudist.runtime.collectives
+    .CollectiveConfig`); ``None`` reads the ``TPUDIST_COLL_*``
+    environment, so launcher-spawned gangs agree on a plan without
+    plumbing.
     """
     if data_plane not in ("host", "ici"):
         raise ValueError(f"unknown data_plane {data_plane!r}")
@@ -223,7 +285,8 @@ def run_elastic_worker(
                 if raw is None or int(raw) < round_id:
                     client.set("elastic/round", str(round_id))
             coll = HostCollectives(client, rank, world, round_id,
-                                   on_wait=monitor.check)
+                                   on_wait=monitor.check,
+                                   config=coll_config)
             try:
                 mesh = None
                 data_coll: Any = coll
@@ -315,6 +378,7 @@ def run_elastic_worker(
                         # at the final barrier) — the recovery handlers
                         # must never see a None state tree
                         state.state = restore()
+                coll.close()  # stop async worker/prefetcher threads
                 return state
             except WorldChanged as e:
                 obs.counter("elastic/world_changed").inc()

@@ -18,17 +18,59 @@ smaller and the next round's collectives simply have fewer participants.
 No compiled program needs to change, because these collectives live outside
 XLA.
 
-Wire format: each participant posts its payload under
-``{ns}/{round}/{op}/{rank}`` and reads every peer's key.  Values are npz
-bytes (dtype/shape-preserving) of the flattened pytree leaves.  A
-participant deletes its own ``op - 2`` key when posting ``op`` — by then
-every peer has consumed it (they must have completed ``op - 1`` to be
-posting/reading ``op``), so the store stays O(2 · world) keys per round.
+Allreduce is BANDWIDTH-OPTIMAL, not naive (the Horovod-core trio the
+reference gets for free from ``hvd.DistributedOptimizer``,
+`mnist_horovod.py:53`):
+
+* **bucket fusion** — the pytree is flattened into fused flat buffers of
+  at most ``bucket_bytes`` (leaves grouped by dtype, concatenated in a
+  deterministic order), so wire cost is per-bucket, not per-leaf;
+* **ring reduce-scatter** — each fused bucket is split into ``world``
+  chunks; chunk ``c`` travels the ring ``c → c+1 → … → c-1``, each hop
+  adding its own contribution, so every rank uploads/downloads
+  ``(world-1)/world`` of the payload instead of ``world×`` of it.  The
+  all-gather half uses the store's star topology directly: the rank that
+  finishes chunk ``c`` posts it ONCE and every peer fetches it — ring
+  forwarding would double store traffic for nothing.  Net wire bytes per
+  rank: ``2·(world−1)/world × size`` fetched (vs ``(world−1) × size``
+  flat), ``~1 × size`` posted;
+* **wire compression** — float32 payloads optionally travel as bf16
+  (default) or fp16 with float32 accumulation at every hop
+  (``coll/compress_ratio`` reports the saving); every other dtype rides
+  raw;
+* **async overlap** — :meth:`HostCollectives.allreduce_sum_async` returns
+  a :class:`Handle` and runs post/fetch/reduce on a background worker, so
+  the caller's next microbatch overlaps the previous one's wire time
+  (``hvd.DistributedOptimizer`` semantics — see
+  :class:`tpudist.elastic.worker.OverlappedGradSync`).
+
+DETERMINISM CONTRACT: after any allreduce, every participant holds a
+bitwise-identical result.  Ring: each chunk is reduced exactly once, by one
+rank per hop in fixed ring order, and the finished chunk's *encoded bytes*
+are what every rank decodes — no rank re-does a reduction another rank
+already did.  Flat: every rank reduces in rank order over the *posted*
+(wire-encoded) payloads, including its own, so compression rounding is
+identical everywhere.  The two algorithms may differ from each other in
+ULPs (different addition order); replicas never differ from each other.
+
+Wire format: flat posts one fused blob per rank under
+``{ns}/{round}/{op}/{rank}``; ring posts chunk partials under
+``{ns}/{round}/{op}/rs/{bucket}/{step}/{rank}`` and finished chunks under
+``{ns}/{round}/{op}/ag/{bucket}/{chunk}``.  Chunk payloads are raw bytes of
+the wire dtype — both sides derive shapes/offsets from the (identical)
+fusion plan, so no per-message header is needed.  Key GC: a participant
+deletes every key it posted for ``op - 2`` when starting ``op`` — by then
+every peer has consumed them (finishing ``op`` requires the whole ring to
+have finished ``op - 1``), so the store stays O(ring keys × 2) per round.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import io
+import os
+import queue
+import threading
 import time
 from typing import Any, Callable
 
@@ -40,6 +82,172 @@ from tpudist.runtime.coord import CoordClient
 
 class PeerLost(RuntimeError):
     """A collective wait exceeded its deadline; membership likely changed."""
+
+
+# ---------------------------------------------------------------------------
+# configuration
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveConfig:
+    """Knobs for the host allreduce (Horovod's fusion-buffer/compression
+    trio, `HOROVOD_FUSION_THRESHOLD` analog).
+
+    * ``algorithm`` — ``auto`` (flat for tiny payloads or ``world <= 2``,
+      ring otherwise), or force ``flat`` / ``ring``.
+    * ``bucket_bytes`` — fused-buffer cap; one ring runs per bucket, so
+      smaller buckets start their wire time earlier but cost more store
+      round-trips.
+    * ``compress`` — ``bf16`` (default) / ``fp16`` / ``none``; applies to
+      float32 payloads only, accumulation stays float32.
+    * ``flat_max_bytes`` — ``auto`` switches to ring above this payload
+      size (the flat gather's one-post/one-fetch-per-peer latency beats
+      the ring's ``2·world`` round-trips for small trees).
+
+    Every field has a ``TPUDIST_COLL_*`` environment override (read by
+    :meth:`from_env`, the default for :class:`HostCollectives`), so the
+    elastic worker and the launcher-spawned gang pick the same plan
+    without plumbing.
+    """
+
+    algorithm: str = "auto"
+    bucket_bytes: int = 4 << 20
+    compress: str = "bf16"
+    flat_max_bytes: int = 64 << 10
+
+    def __post_init__(self) -> None:
+        if self.algorithm not in ("auto", "flat", "ring"):
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.compress not in ("none", "bf16", "fp16"):
+            raise ValueError(f"unknown compress {self.compress!r}")
+        if self.bucket_bytes < 64:
+            raise ValueError(f"bucket_bytes too small: {self.bucket_bytes}")
+
+    @classmethod
+    def from_env(cls) -> "CollectiveConfig":
+        return cls(
+            algorithm=os.environ.get("TPUDIST_COLL_ALGO", cls.algorithm),
+            bucket_bytes=int(os.environ.get("TPUDIST_COLL_BUCKET_BYTES",
+                                            cls.bucket_bytes)),
+            compress=os.environ.get("TPUDIST_COLL_COMPRESS", cls.compress),
+            flat_max_bytes=int(os.environ.get("TPUDIST_COLL_FLAT_MAX_BYTES",
+                                              cls.flat_max_bytes)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# wire codecs + bucket fusion
+
+
+def _bf16() -> np.dtype:
+    import ml_dtypes  # jax dependency, always present with jax
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _wire_dtype(native: np.dtype, compress: str) -> np.dtype:
+    """The dtype a group's bytes travel as: float32 compresses to
+    bf16/fp16 when asked; everything else (ints, bool, f64, and already-
+    half floats) rides raw."""
+    if native == np.float32 and compress != "none":
+        return _bf16() if compress == "bf16" else np.dtype(np.float16)
+    return native
+
+
+def _accum_dtype(native: np.dtype) -> np.dtype:
+    """float32 accumulation for <= 16-bit floats (the fp32-master-copy
+    rule of mixed-precision allreduce); everything else accumulates in
+    its own dtype (ints must stay exact, f64 must not narrow)."""
+    if native == np.float16 or native == _bf16():
+        return np.dtype(np.float32)
+    return native
+
+
+def _encode(arr: np.ndarray, wire: np.dtype) -> bytes:
+    return np.ascontiguousarray(arr.astype(wire, copy=False)).tobytes()
+
+
+def _decode(raw: bytes, wire: np.dtype, accum: np.dtype) -> np.ndarray:
+    # frombuffer is zero-copy (read-only); the astype to the accumulation
+    # dtype copies exactly when it has to
+    return np.frombuffer(raw, dtype=wire).astype(accum, copy=False)
+
+
+@dataclasses.dataclass
+class _Bucket:
+    group: str            # dtype token of the owning group
+    data: np.ndarray      # 1-D accum-dtype slice of the group's fused vector
+    wire: np.dtype
+    accum: np.dtype
+
+    @property
+    def wire_nbytes(self) -> int:
+        return len(self.data) * self.wire.itemsize
+
+
+def _fuse(np_leaves: list[np.ndarray],
+          cfg: CollectiveConfig) -> tuple[list[_Bucket], dict]:
+    """Horovod-style tensor fusion: group leaves by dtype (sorted dtype
+    token, leaf order within a group — deterministic on every rank),
+    concatenate each group into one flat accumulation-dtype vector, and
+    slice it into buckets of at most ``bucket_bytes`` wire bytes.
+
+    Returns ``(buckets, plan)`` where ``plan`` maps group token ->
+    ``(leaf_indices, native_dtype, group_vectors)`` for :func:`_defuse`.
+    """
+    groups: dict[str, list[int]] = {}
+    for i, leaf in enumerate(np_leaves):
+        groups.setdefault(leaf.dtype.str, []).append(i)
+    buckets: list[_Bucket] = []
+    plan: dict[str, tuple] = {}
+    for token in sorted(groups):
+        idxs = groups[token]
+        native = np.dtype(token)
+        accum = _accum_dtype(native)
+        wire = _wire_dtype(native, cfg.compress)
+        parts = [np_leaves[i].ravel() for i in idxs]
+        fused = (np.concatenate(parts) if len(parts) > 1
+                 else parts[0]).astype(accum, copy=False)
+        per_bucket = max(1, cfg.bucket_bytes // wire.itemsize)
+        group_buckets = [
+            _Bucket(token, fused[lo:lo + per_bucket], wire, accum)
+            for lo in range(0, len(fused), per_bucket)
+        ] or ([_Bucket(token, fused, wire, accum)] if fused.size == 0 else [])
+        buckets.extend(b for b in group_buckets if b.data.size)
+        plan[token] = (idxs, native)
+    return buckets, plan
+
+
+def _defuse(reduced: dict[str, list[np.ndarray]], plan: dict,
+            np_leaves: list[np.ndarray]) -> list[np.ndarray]:
+    """Inverse of :func:`_fuse`: concatenate each group's reduced bucket
+    vectors, cast back to the native dtype, and split into leaf shapes."""
+    out: list[np.ndarray | None] = [None] * len(np_leaves)
+    for token, (idxs, native) in plan.items():
+        vecs = reduced.get(token, [])
+        vec = (np.concatenate(vecs) if len(vecs) > 1
+               else vecs[0] if vecs
+               else np.empty(0, _accum_dtype(native)))
+        vec = vec.astype(native, copy=False)
+        off = 0
+        for i in idxs:
+            n = np_leaves[i].size
+            out[i] = vec[off:off + n].reshape(np_leaves[i].shape)
+            off += n
+    return out  # type: ignore[return-value]
+
+
+def _chunk_bounds(n: int, world: int) -> list[tuple[int, int]]:
+    """Ring chunk boundaries: ``world`` near-equal slices of ``[0, n)``
+    (first ``n % world`` chunks one element larger) — identical on every
+    rank, tolerating ``n < world`` via empty chunks."""
+    base, rem = divmod(n, world)
+    bounds, lo = [], 0
+    for c in range(world):
+        hi = lo + base + (1 if c < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
 
 
 def _dumps(leaves: list[np.ndarray]) -> bytes:
@@ -55,20 +263,148 @@ def _loads(raw: bytes) -> list[np.ndarray]:
         return [z[f"arr_{i}"] for i in range(len(z.files))]
 
 
+# ---------------------------------------------------------------------------
+# async plumbing
+
+
+class Handle:
+    """Result of an async collective: :meth:`wait` blocks until the
+    background worker finishes and returns the reduced tree — or re-raises
+    whatever the worker thread raised (``PeerLost``, ``WorldChanged`` from
+    the elastic ``on_wait`` probe, a store ``ConnectionError``), so the
+    caller's recovery path sees exactly what the synchronous call would
+    have thrown."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exc: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout_s: float | None = None) -> Any:
+        if not self._event.wait(timeout_s):
+            raise PeerLost(f"async collective not done within {timeout_s}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _finish(self, result: Any = None,
+                exc: BaseException | None = None) -> None:
+        self._result, self._exc = result, exc
+        self._event.set()
+
+
+class _Prefetcher:
+    """Background fetcher over its own store connection: the ring's
+    next-chunk wait overlaps the current chunk's local reduction (and the
+    all-gather's world-1 fetches stream while earlier chunks are being
+    placed).  ``submit`` enqueues a key; the owning collective picks the
+    bytes up with ``take`` on ITS thread — the elastic ``on_wait`` probe
+    (which may raise ``WorldChanged``) always runs on the collective's
+    thread, never here."""
+
+    def __init__(self, base_client: CoordClient,
+                 abort: threading.Event) -> None:
+        self._client = base_client.clone()
+        self._q: queue.Queue = queue.Queue()
+        self._res: dict[str, bytes | BaseException] = {}
+        self._cond = threading.Condition()
+        self._abort = abort
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpudist-coll-prefetch")
+        self._thread.start()
+
+    def submit(self, key: str, deadline: float) -> None:
+        self._q.put((key, deadline))
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                key, deadline = item
+                try:
+                    val: bytes | BaseException = self._fetch(key, deadline)
+                except BaseException as e:  # noqa: BLE001 - delivered at take()
+                    val = e
+                with self._cond:
+                    self._res[key] = val
+                    self._cond.notify_all()
+        finally:
+            self._client.close()
+
+    def _fetch(self, key: str, deadline: float) -> bytes:
+        while True:
+            raw = self._client.get(key)
+            if raw is not None:
+                return raw
+            if self._abort.is_set():
+                raise PeerLost(f"collective aborted waiting for {key}")
+            if time.monotonic() > deadline:
+                raise PeerLost(
+                    f"peer never posted {key} within the collective's "
+                    f"shared deadline")
+            self._client.wait(key, timeout_s=0.2)
+
+    def take(self, key: str, deadline: float,
+             on_wait: Callable[[], None] | None) -> bytes:
+        with self._cond:
+            while key not in self._res:
+                if on_wait is not None:
+                    on_wait()  # may raise WorldChanged — caller's thread
+                if self._abort.is_set():
+                    raise PeerLost(f"collective aborted waiting for {key}")
+                if time.monotonic() > deadline:
+                    raise PeerLost(
+                        f"peer never posted {key} within the collective's "
+                        f"shared deadline")
+                self._cond.wait(0.05)
+            val = self._res.pop(key)
+        if isinstance(val, BaseException):
+            raise val
+        return val
+
+    def close(self) -> None:
+        self._q.put(None)
+
+
+# ---------------------------------------------------------------------------
+
+
 class HostCollectives:
     """Fixed-membership collectives for one rendezvous round.
 
     Args:
-      client: store connection (one in-flight request per connection; do
-        not share with a concurrently-beating monitor — it clones its own).
+      client: store connection (the caller's thread uses it; background
+        workers clone their own — do not share with a concurrently-beating
+        monitor, it clones its own too).
       rank / world: this participant's dense rank and the round's size
         (from :meth:`tpudist.runtime.coord.Rendezvous.join_live`).
       round_id: rendezvous round; namespaces all keys so a new round never
         sees a dead round's leftovers.
       on_wait: optional callback invoked between wait polls — the elastic
         hook: pass ``ElasticMonitor.check`` so a TTL-expired peer turns a
-        hung allreduce into ``WorldChanged`` instead of a timeout.
-      timeout_s: per-collective deadline before :class:`PeerLost`.
+        hung allreduce into ``WorldChanged`` instead of a timeout.  Always
+        invoked on the thread running the collective (the caller for sync
+        ops, the async worker for ``*_async`` ops — re-raised from
+        :meth:`Handle.wait`).
+      timeout_s: per-collective deadline before :class:`PeerLost`.  The
+        deadline is SHARED by every chunk of one collective: a peer dying
+        mid-ring surfaces once, after ``timeout_s``, not once per
+        remaining chunk.
+      config: algorithm/fusion/compression knobs; defaults to
+        :meth:`CollectiveConfig.from_env`.
+
+    Threading contract: collectives must be issued from one thread (SPMD
+    programs issue them in lockstep anyway).  ``*_async`` submissions are
+    executed in submission order on one background worker; a synchronous
+    collective first drains the async queue, so operation ids stay agreed
+    across ranks.
     """
 
     def __init__(
@@ -80,6 +416,7 @@ class HostCollectives:
         namespace: str = "coll",
         on_wait: Callable[[], None] | None = None,
         timeout_s: float = 60.0,
+        config: CollectiveConfig | None = None,
     ) -> None:
         self.client = client
         self.rank = rank
@@ -88,60 +425,99 @@ class HostCollectives:
         self.ns = namespace
         self.on_wait = on_wait
         self.timeout_s = timeout_s
+        self.config = config if config is not None \
+            else CollectiveConfig.from_env()
         self._op = 0
+        self._posted: dict[int, list[str]] = {}  # op -> keys (for GC)
+        self.bytes_posted = 0     # per-instance wire accounting (bench/tests
+        self.bytes_fetched = 0    # read these; obs counters are global)
+        self._abort = threading.Event()
+        self._io: _Prefetcher | None = None        # sync-path prefetcher
+        self._async_io: _Prefetcher | None = None  # worker-path prefetcher
+        self._async_client: CoordClient | None = None
+        self._async_q: queue.Queue | None = None
+        self._async_thread: threading.Thread | None = None
+        self._pending: list[Handle] = []
+        self._closed = False
+
+    # -- keys, GC, raw post/fetch ------------------------------------------
 
     def _key(self, op: int, rank: int) -> str:
         return f"{self.ns}/{self.round_id}/{op}/{rank}"
 
-    def _post(self, payload: bytes) -> int:
+    def _ring_key(self, op: int, phase: str, bucket: int,
+                  idx: int, rank: int | None = None) -> str:
+        tail = f"/{rank}" if rank is not None else ""
+        return f"{self.ns}/{self.round_id}/{op}/{phase}/{bucket}/{idx}{tail}"
+
+    def _begin_op(self, client: CoordClient) -> int:
+        """Allocate the next operation id and GC everything this rank
+        posted for ``op - 2`` (every peer consumed those before posting
+        ``op - 1`` — see the module docstring's induction)."""
         op = self._op
         self._op += 1
-        obs.counter("coll/bytes_posted", unit="bytes").inc(len(payload))
-        self.client.set(self._key(op, self.rank), payload)
-        if op >= 2:  # every peer consumed op-2 before posting op-1
-            self.client.delete(self._key(op - 2, self.rank))
+        for key in self._posted.pop(op - 2, ()):
+            client.delete(key)
         return op
 
-    def _fetch(self, op: int, rank: int) -> bytes:
-        deadline = time.monotonic() + self.timeout_s
-        key = self._key(op, rank)
+    def _post(self, client: CoordClient, op: int, key: str,
+              payload: bytes) -> None:
+        obs.counter("coll/bytes_posted", unit="bytes").inc(len(payload))
+        self.bytes_posted += len(payload)
+        client.set(key, payload)
+        self._posted.setdefault(op, []).append(key)
+
+    def _account_fetch(self, raw: bytes, waited_s: float) -> bytes:
+        obs.counter("coll/bytes_fetched", unit="bytes").inc(len(raw))
+        obs.histogram("coll/fetch_wait_s", unit="s").record(waited_s)
+        self.bytes_fetched += len(raw)
+        return raw
+
+    def _fetch(self, client: CoordClient, key: str, deadline: float,
+               on_wait: Callable[[], None] | None) -> bytes:
+        """Inline blocking fetch (flat + broadcast paths)."""
+        t0 = time.perf_counter()
         while True:
-            raw = self.client.get(key)
+            raw = client.get(key)
             if raw is not None:
-                return raw
-            if self.on_wait is not None:
-                self.on_wait()
+                return self._account_fetch(raw, time.perf_counter() - t0)
+            if on_wait is not None:
+                on_wait()
+            if self._abort.is_set():
+                raise PeerLost(f"collective aborted waiting for {key}")
             if time.monotonic() > deadline:
                 raise PeerLost(
-                    f"rank {rank} never posted {key} within "
-                    f"{self.timeout_s}s")
-            self.client.wait(key, timeout_s=0.2)
+                    f"peer never posted {key} within the collective's "
+                    f"shared deadline ({self.timeout_s}s)")
+            client.wait(key, timeout_s=0.2)
+
+    def _take(self, io: _Prefetcher, key: str, deadline: float,
+              on_wait: Callable[[], None] | None) -> bytes:
+        t0 = time.perf_counter()
+        raw = io.take(key, deadline, on_wait)
+        return self._account_fetch(raw, time.perf_counter() - t0)
+
+    def _peer_order(self) -> list[int]:
+        """Every rank's fetch sequence starts at its RIGHT neighbor and
+        wraps — rank-identical sequences would hot-spot rank 0's keys on
+        the store with ``world`` simultaneous reads (the reduction order
+        stays rank-/ring-fixed regardless; only the FETCH order
+        staggers)."""
+        return [(self.rank + i) % self.world for i in range(1, self.world)]
+
+    # -- allreduce ----------------------------------------------------------
 
     def allreduce_sum(self, tree: Any) -> Any:
-        """Sum a pytree of arrays across all ranks (all-gather + local
-        reduce; payloads ride the store, O(world) per rank).
+        """Sum a pytree of arrays across all ranks.
 
-        The reduction runs in RANK ORDER on every participant — float
-        addition is non-associative, so a per-rank order (e.g. own shard
-        first) would leave replicas differing in ULPs and silently
-        diverging over steps (caught by the elastic grow test's bitwise
-        checksum)."""
-        import jax
-
-        obs.counter("coll/allreduce", unit="calls").inc()
-        leaves, treedef = jax.tree.flatten(tree)
-        np_leaves = [np.asarray(x) for x in leaves]
-        op = self._post(_dumps(np_leaves))
-        acc: list[np.ndarray] | None = None
-        for r in range(self.world):
-            contrib = (np_leaves if r == self.rank
-                       else _loads(self._fetch(op, r)))
-            if acc is None:
-                acc = [np.array(c, copy=True) for c in contrib]
-            else:
-                for a, b in zip(acc, contrib):
-                    a += b
-        return jax.tree.unflatten(treedef, acc)
+        Dispatches on payload size and ``config.algorithm``: a flat
+        post-everything/fetch-everyone gather for tiny trees, the chunked
+        ring for everything else.  Either way every rank returns a
+        bitwise-identical tree (see the module determinism contract) —
+        the invariant the elastic grow test's checksum relies on."""
+        self._drain_async()
+        return self._run_allreduce(
+            tree, self.client, on_wait=self.on_wait)
 
     def allreduce_mean(self, tree: Any) -> Any:
         import jax
@@ -149,9 +525,229 @@ class HostCollectives:
         summed = self.allreduce_sum(tree)
         return jax.tree.map(lambda x: x / self.world, summed)
 
+    def allreduce_sum_async(self, tree: Any) -> Handle:
+        """Start an allreduce on the background worker; returns a
+        :class:`Handle` whose :meth:`~Handle.wait` yields exactly what
+        :meth:`allreduce_sum` would have returned (or re-raises the
+        worker-side error).  Submissions run in order; a subsequent sync
+        collective drains them first, so op ids stay SPMD-agreed."""
+        return self._submit("sum", tree)
+
+    def allreduce_mean_async(self, tree: Any) -> Handle:
+        return self._submit("mean", tree)
+
+    def _submit(self, kind: str, tree: Any) -> Handle:
+        if self._closed:
+            raise PeerLost("collectives closed (round over)")
+        obs.counter("coll/allreduce_async", unit="calls").inc()
+        self._ensure_async_worker()
+        handle = Handle()
+        self._pending.append(handle)
+        assert self._async_q is not None
+        self._async_q.put((kind, tree, handle))
+        return handle
+
+    def _ensure_async_worker(self) -> None:
+        if self._async_thread is None:
+            # clone on the caller's thread so connection failures surface
+            # here, not silently inside the worker
+            self._async_client = self.client.clone()
+            self._async_q = queue.Queue()
+            self._async_thread = threading.Thread(
+                target=self._async_loop, daemon=True,
+                name="tpudist-coll-async")
+            self._async_thread.start()
+
+    def _async_loop(self) -> None:
+        assert self._async_client is not None and self._async_q is not None
+        try:
+            while True:
+                item = self._async_q.get()
+                if item is None:
+                    return
+                kind, tree, handle = item
+                try:
+                    out = self._run_allreduce(
+                        tree, self._async_client, on_wait=self.on_wait,
+                        async_path=True)
+                    if kind == "mean":
+                        import jax
+
+                        out = jax.tree.map(lambda x: x / self.world, out)
+                    handle._finish(result=out)
+                except BaseException as e:  # noqa: BLE001 - re-raised at wait()
+                    handle._finish(exc=e)
+        finally:
+            self._async_client.close()
+
+    def _drain_async(self) -> None:
+        """Wait for every outstanding async collective to complete (their
+        errors stay with their handles).  Keeps sync and async ops
+        totally ordered, so operation ids agree across ranks."""
+        pending, self._pending = self._pending, []
+        for h in pending:
+            h._event.wait()
+
+    # -- the allreduce engine ----------------------------------------------
+
+    def _run_allreduce(self, tree: Any, client: CoordClient,
+                       on_wait: Callable[[], None] | None,
+                       async_path: bool = False) -> Any:
+        import jax
+
+        obs.counter("coll/allreduce", unit="calls").inc()
+        t_start = time.perf_counter()
+        leaves, treedef = jax.tree.flatten(tree)
+        np_leaves = [np.asarray(x) for x in leaves]
+        total_bytes = sum(l.nbytes for l in np_leaves)
+        if self.world == 1 or not np_leaves or total_bytes == 0:
+            return jax.tree.unflatten(
+                treedef, [np.array(l, copy=True) for l in np_leaves])
+        buckets, plan = _fuse(np_leaves, self.config)
+        wire_bytes = sum(b.wire_nbytes for b in buckets)
+        if wire_bytes:
+            obs.gauge("coll/compress_ratio").set(total_bytes / wire_bytes)
+        algo = self.config.algorithm
+        if algo == "auto":
+            algo = ("flat" if self.world <= 2
+                    or total_bytes <= self.config.flat_max_bytes else "ring")
+        op = self._begin_op(client)
+        deadline = time.monotonic() + self.timeout_s
+        io: _Prefetcher | None = None
+        if algo == "ring":
+            io = self._prefetcher(async_path)
+        reducer = self._ring if algo == "ring" else self._flat
+        reduced_buckets = reducer(buckets, op, client, io, deadline, on_wait)
+        reduced: dict[str, list[np.ndarray]] = {}
+        for b, vec in zip(buckets, reduced_buckets):
+            reduced.setdefault(b.group, []).append(vec)
+        out = _defuse(reduced, plan, np_leaves)
+        obs.histogram("coll/allreduce_s", unit="s").record(
+            time.perf_counter() - t_start)
+        return jax.tree.unflatten(treedef, out)
+
+    def _prefetcher(self, async_path: bool) -> _Prefetcher:
+        if async_path:
+            if self._async_io is None:
+                assert self._async_client is not None
+                self._async_io = _Prefetcher(self._async_client, self._abort)
+            return self._async_io
+        if self._io is None:
+            self._io = _Prefetcher(self.client, self._abort)
+        return self._io
+
+    def _flat(self, buckets: list[_Bucket], op: int, client: CoordClient,
+              io: _Prefetcher | None, deadline: float,
+              on_wait: Callable[[], None] | None) -> list[np.ndarray]:
+        """All-gather + rank-ordered local reduce: one posted blob, one
+        fetch per peer (staggered start — see :meth:`_peer_order`).  Best
+        for tiny trees where ring round-trips dominate; O(world × size)
+        fetch bytes otherwise."""
+        payload = b"".join(_encode(b.data, b.wire) for b in buckets)
+        self._post(client, op, self._key(op, self.rank), payload)
+        raws: dict[int, bytes] = {self.rank: payload}
+        for r in self._peer_order():
+            raws[r] = self._fetch(client, self._key(op, r), deadline, on_wait)
+        out: list[np.ndarray] = []
+        off = 0
+        for b in buckets:
+            blen = b.wire_nbytes
+            acc: np.ndarray | None = None
+            # reduction in RANK ORDER on every participant, over the
+            # POSTED (wire-encoded) payloads — own contribution included,
+            # so compression rounding is identical on every rank and
+            # float non-associativity cannot diverge replicas
+            for r in range(self.world):
+                contrib = _decode(raws[r][off:off + blen], b.wire, b.accum)
+                if acc is None:
+                    acc = np.array(contrib, copy=True)
+                else:
+                    acc += contrib
+            out.append(acc if acc is not None
+                       else np.empty(0, b.accum))
+            off += blen
+        return out
+
+    def _ring(self, buckets: list[_Bucket], op: int, client: CoordClient,
+              io: _Prefetcher | None, deadline: float,
+              on_wait: Callable[[], None] | None) -> list[np.ndarray]:
+        """Chunked ring reduce-scatter + star all-gather (see module
+        docstring).  The prefetcher keeps the NEXT hop's store wait in
+        flight while this hop's chunk is being reduced."""
+        assert io is not None
+        world, rank = self.world, self.rank
+        left = (rank - 1) % world
+        own_final = (rank + 1) % world  # chunk this rank finishes
+        bounds = [_chunk_bounds(len(b.data), world) for b in buckets]
+        # post every bucket's step-0 chunk up front: peers' prefetchers
+        # find their first hop immediately, and bucket k+1's ring can
+        # absorb store latency while bucket k reduces
+        for bi, b in enumerate(buckets):
+            lo, hi = bounds[bi][rank]
+            self._post(client, op, self._ring_key(op, "rs", bi, 0, rank),
+                       _encode(b.data[lo:hi], b.wire))
+        out: list[np.ndarray] = []
+        for bi, b in enumerate(buckets):
+            io.submit(self._ring_key(op, "rs", bi, 0, left), deadline)
+            final_enc: bytes | None = None
+            acc: np.ndarray | None = None
+            for s in range(world - 1):
+                if s + 1 < world - 1:
+                    # pipeline: next hop's fetch rides the prefetcher
+                    # while this hop decodes + reduces
+                    io.submit(self._ring_key(op, "rs", bi, s + 1, left),
+                              deadline)
+                t0 = time.perf_counter()
+                raw = self._take(
+                    io, self._ring_key(op, "rs", bi, s, left), deadline,
+                    on_wait)
+                c = (rank - 1 - s) % world
+                lo, hi = bounds[bi][c]
+                # fp32 (accum-dtype) add of the decoded partial and this
+                # rank's own chunk; exactly ONE rank performs each hop,
+                # so the per-chunk reduction order is ring-fixed
+                acc = _decode(raw, b.wire, b.accum) + b.data[lo:hi]
+                if s + 1 < world - 1:
+                    self._post(
+                        client, op,
+                        self._ring_key(op, "rs", bi, s + 1, rank),
+                        _encode(acc, b.wire))
+                else:
+                    final_enc = _encode(acc, b.wire)
+                obs.histogram("coll/ring_chunk_s", unit="s").record(
+                    time.perf_counter() - t0)
+            # all-gather over the store's star topology: post the finished
+            # chunk ONCE; every peer fetches the owner's single post (ring
+            # forwarding would re-upload each chunk world-2 more times)
+            assert final_enc is not None
+            self._post(client, op, self._ring_key(op, "ag", bi, own_final),
+                       final_enc)
+            order = [(own_final + i) % world for i in range(1, world)]
+            for c in order:
+                io.submit(self._ring_key(op, "ag", bi, c), deadline)
+            pieces: dict[int, np.ndarray] = {
+                # decode own ENCODED bytes, not the raw accumulator: with
+                # compression on, peers decode the posted bf16 — bitwise
+                # agreement requires this rank to do the same
+                own_final: _decode(final_enc, b.wire, b.accum)}
+            for c in order:
+                raw = self._take(io, self._ring_key(op, "ag", bi, c),
+                                 deadline, on_wait)
+                pieces[c] = _decode(raw, b.wire, b.accum)
+            vec = np.empty(len(b.data), b.accum)
+            for c in range(world):
+                lo, hi = bounds[bi][c]
+                vec[lo:hi] = pieces[c]
+            out.append(vec)
+        return out
+
+    # -- broadcast / barrier ------------------------------------------------
+
     def broadcast(self, tree: Any, root: int = 0) -> Any:
         """Every rank returns root's pytree (``hvd.broadcast_parameters``
         role, `mnist_horovod.py:56` — state agreement after a resize).
+        Payload rides uncompressed npz: state agreement must be EXACT,
+        unlike gradient sync there is no accumulation to absorb rounding.
 
         Synchronizing: a trailing barrier guarantees every peer consumed
         the payload before anyone proceeds — without it, the root's op-2
@@ -160,34 +756,57 @@ class HostCollectives:
         every peer's op N-1)."""
         import jax
 
+        self._drain_async()
         obs.counter("coll/broadcast", unit="calls").inc()
         leaves, treedef = jax.tree.flatten(tree)
+        op = self._begin_op(self.client)
         if self.rank == root:
-            self._post(_dumps([np.asarray(x) for x in leaves]))
+            self._post(self.client, op, self._key(op, root),
+                       _dumps([np.asarray(x) for x in leaves]))
             out_tree = tree
         else:
-            op = self._op
-            self._op += 1
+            deadline = time.monotonic() + self.timeout_s
             out_tree = jax.tree.unflatten(
-                treedef, _loads(self._fetch(op, root)))
+                treedef, _loads(self._fetch(
+                    self.client, self._key(op, root), deadline,
+                    self.on_wait)))
         self.barrier()
         return out_tree
 
     def barrier(self, timeout_s: float | None = None) -> None:
         """All-ranks barrier for this round (native store barrier)."""
+        self._drain_async()
         obs.counter("coll/barrier", unit="calls").inc()
-        op = self._op
-        self._op += 1
+        op = self._begin_op(self.client)
         ok = self.client.barrier(
             f"{self.ns}/{self.round_id}/bar/{op}", self.world,
             timeout_s or self.timeout_s)
         if not ok:
             raise PeerLost(f"barrier {op} timed out at world {self.world}")
 
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop background workers (async executor + prefetchers) and
+        abort any in-flight waits with :class:`PeerLost`.  Idempotent;
+        does NOT close the caller-owned ``client``."""
+        if self._closed:
+            return
+        self._closed = True
+        self._abort.set()
+        if self._async_q is not None:
+            self._async_q.put(None)
+        for io in (self._io, self._async_io):
+            if io is not None:
+                io.close()
+
     def close_round(self) -> None:
         """Delete every key this round left in the store (called before
         re-rendezvous so dead rounds cannot accumulate; idempotent —
-        every survivor may call it)."""
+        every survivor may call it).  Also tears down this instance's
+        background workers: a dead round's async op must not keep
+        fetching."""
+        self.close()
         for key in self.client.keys(f"{self.ns}/{self.round_id}/"):
             try:
                 self.client.delete(key)

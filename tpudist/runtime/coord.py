@@ -80,6 +80,11 @@ class CoordClient:
         self._h = self._lib.tcs_connect(host.encode(), port, timeout_ms)
         if not self._h:
             raise ConnectionError(f"could not reach coordination server {host}:{port}")
+        # One request may be in flight per connection; serialize RPCs so a
+        # client shared across threads (e.g. ElasticMonitor.check probing
+        # from a collective's async worker while the owner thread polls)
+        # cannot interleave frames on the socket.  RLock: keys() -> _joined.
+        self._rpc_lock = threading.RLock()
 
     def clone(self) -> "CoordClient":
         """A fresh connection to the same server (one request is in flight
@@ -90,16 +95,19 @@ class CoordClient:
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):
             value = value.encode()
-        if self._lib.tcs_set(self._h, key.encode(), value, len(value)) != 0:
-            raise ConnectionError("set failed")
+        with self._rpc_lock:
+            if self._lib.tcs_set(self._h, key.encode(), value,
+                                 len(value)) != 0:
+                raise ConnectionError("set failed")
 
     def get(self, key: str) -> bytes | None:
         cap = _VALUE_CAP
         while True:
             buf = ctypes.create_string_buffer(cap)
             out_len = ctypes.c_uint32()
-            rc = self._lib.tcs_get(self._h, key.encode(), buf, cap,
-                                   ctypes.byref(out_len))
+            with self._rpc_lock:
+                rc = self._lib.tcs_get(self._h, key.encode(), buf, cap,
+                                       ctypes.byref(out_len))
             if rc == 1:
                 return None
             if rc == 2:  # buffer too small; out_len holds the needed size
@@ -110,20 +118,24 @@ class CoordClient:
             return buf.raw[: out_len.value]
 
     def add(self, key: str, delta: int) -> int:
-        v = self._lib.tcs_add(self._h, key.encode(), delta)
+        with self._rpc_lock:
+            v = self._lib.tcs_add(self._h, key.encode(), delta)
         if v == -(2**63):
             raise ConnectionError("add failed")
         return int(v)
 
     def wait(self, key: str, timeout_s: float = 30.0) -> bool:
-        rc = self._lib.tcs_wait(self._h, key.encode(), int(timeout_s * 1000))
+        with self._rpc_lock:
+            rc = self._lib.tcs_wait(self._h, key.encode(),
+                                    int(timeout_s * 1000))
         if rc < 0:
             raise ConnectionError("wait failed")
         return rc == 0
 
     def delete(self, key: str) -> None:
-        if self._lib.tcs_del(self._h, key.encode()) != 0:
-            raise ConnectionError("del failed")
+        with self._rpc_lock:
+            if self._lib.tcs_del(self._h, key.encode()) != 0:
+                raise ConnectionError("del failed")
 
     def keys(self, prefix: str = "") -> list[str]:
         joined = self._joined(
@@ -137,7 +149,8 @@ class CoordClient:
         while True:
             buf = ctypes.create_string_buffer(cap)
             out_len = ctypes.c_uint32()
-            rc = call(buf, cap, ctypes.byref(out_len))
+            with self._rpc_lock:
+                rc = call(buf, cap, ctypes.byref(out_len))
             if rc == 2:
                 cap = out_len.value
                 continue
@@ -148,9 +161,13 @@ class CoordClient:
     # -- synchronization ---------------------------------------------------
     def barrier(self, name: str, count: int, timeout_s: float = 60.0) -> bool:
         """Block until ``count`` participants arrive at ``name``.  Returns
-        False on timeout (the arrival is withdrawn server-side)."""
-        rc = self._lib.tcs_barrier(self._h, name.encode(), count,
-                                   int(timeout_s * 1000))
+        False on timeout (the arrival is withdrawn server-side).
+
+        Holds the connection's RPC lock for the whole wait — do not share
+        a client between a thread that barriers and one that polls."""
+        with self._rpc_lock:
+            rc = self._lib.tcs_barrier(self._h, name.encode(), count,
+                                       int(timeout_s * 1000))
         if rc < 0:
             raise ConnectionError("barrier failed")
         return rc == 0
@@ -158,9 +175,10 @@ class CoordClient:
     # -- liveness ----------------------------------------------------------
     def heartbeat(self, worker: str, ttl_s: float) -> None:
         """Refresh ``worker``'s liveness lease; ``ttl_s <= 0`` leaves."""
-        if self._lib.tcs_heartbeat(self._h, worker.encode(),
-                                   int(ttl_s * 1000)) != 0:
-            raise ConnectionError("heartbeat failed")
+        with self._rpc_lock:
+            if self._lib.tcs_heartbeat(self._h, worker.encode(),
+                                       int(ttl_s * 1000)) != 0:
+                raise ConnectionError("heartbeat failed")
 
     def live(self) -> set[str]:
         joined = self._joined(

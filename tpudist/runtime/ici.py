@@ -549,8 +549,55 @@ class IciCollectives:
 
         return jax.tree.map(lambda x: x * self.num_processes, mean)
 
+    # -- async handles ------------------------------------------------------
+    # XLA dispatch is ALREADY asynchronous (the executable call returns
+    # before the collective completes; _wait_ready polls afterwards), so
+    # the async API here needs no worker thread: submit dispatches on the
+    # caller's thread and the Handle defers only the readiness wait +
+    # local-row extraction.  Same contract as
+    # HostCollectives.allreduce_*_async — submit, overlap host work, wait.
+
+    def allreduce_mean_async(self, tree: Any) -> "IciAsyncHandle":
+        if self.on_check is not None:
+            self.on_check()
+        global_tree = self._stack_local(tree)
+        out = self._executable(global_tree)(global_tree)
+        return IciAsyncHandle(self, out, scale=1.0)
+
+    def allreduce_sum_async(self, tree: Any) -> "IciAsyncHandle":
+        h = self.allreduce_mean_async(tree)
+        h.scale = float(self.num_processes)
+        return h
+
     def _local_row(self, out_tree: Any) -> Any:
         import jax
 
         return jax.tree.map(
             lambda a: np.asarray(a.addressable_shards[0].data)[0], out_tree)
+
+
+class IciAsyncHandle:
+    """In-flight compiled allreduce: the op was dispatched at submit time;
+    :meth:`wait` polls it ready (same TTL-probing poll as the sync path,
+    so a peer death still surfaces as ``WorldChanged``/``PeerLost`` from
+    ``wait()``) and returns this process's reduced row."""
+
+    def __init__(self, coll: IciCollectives, out_tree: Any,
+                 scale: float) -> None:
+        self._coll = coll
+        self._out = out_tree
+        self.scale = scale
+
+    def done(self) -> bool:
+        import jax
+
+        return all(leaf.is_ready() for leaf in jax.tree.leaves(self._out))
+
+    def wait(self, timeout_s: float | None = None) -> Any:
+        import jax
+
+        self._coll._wait_ready(self._out)
+        row = self._coll._local_row(self._out)
+        if self.scale != 1.0:
+            row = jax.tree.map(lambda x: x * self.scale, row)
+        return row
