@@ -1065,6 +1065,129 @@ def bench_input_pipeline(on_tpu: bool) -> None:
           rtt_ms=round(_RTT * 1e3, 1))
 
 
+def bench_kv_paging(on_tpu: bool) -> None:
+    """Paged KV cache (PagedAttention layout): at equal slot count the
+    block pool only holds the tokens requests RESERVE, so its KV HBM is
+    a fraction of the dense layout's ``num_slots × max_seq_len`` — the
+    bytes cap that sizes a serving fleet.  The run checks the layout is
+    PURE capacity: paged greedy output must be token-identical to dense
+    on the same mixed-length workload, the pool must drain back to
+    fully free, and tokens/sec must hold (same kernels, plus a
+    per-segment page scatter)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist.models import Request, ServeLoop, TransformerConfig
+    from tpudist.models import TransformerLM
+    from tpudist.models.kv_pages import blocks_for
+
+    cfg = TransformerConfig(
+        vocab_size=32000 if on_tpu else 128,
+        num_layers=8 if on_tpu else 2,
+        num_heads=8, num_kv_heads=2,
+        embed_dim=512 if on_tpu else 64,
+        max_seq_len=8192 if on_tpu else 128,
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    slots = 4 if on_tpu else 2
+    gen = 256 if on_tpu else 8
+    chunk = 512 if on_tpu else 16
+    block = 128 if on_tpu else 16
+    # the workload the paged layout is FOR: prompts well under the
+    # context the dense layout charges every lane for
+    lens = ([1024, 2048, 512, 1024, 512, 2048]
+            if on_tpu else [16, 32, 24, 16, 24, 32])
+    attn = "flash" if on_tpu else "dense"
+    rng = np.random.default_rng(0)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+    reqs = [Request(rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32),
+                    gen, rid=i) for i, n in enumerate(lens)]
+    # pool sized for `slots` concurrent WORST-CASE reservations of this
+    # workload — the right-sizing that realizes the HBM win
+    blocks = slots * blocks_for(max(lens) + gen, block)
+
+    def kv_bytes(loop) -> int:
+        total = 0
+
+        def walk(node):
+            nonlocal total
+            if not isinstance(node, dict):
+                return
+            for k, v in node.items():
+                if k in ("cached_key", "cached_value",
+                         "paged_key", "paged_value"):
+                    total += int(v.size) * v.dtype.itemsize
+                elif isinstance(v, dict):
+                    walk(v)
+
+        walk(loop.cache)
+        return total
+
+    def build(layout):
+        kw = ({"cache_layout": "paged", "kv_block_size": block,
+               "kv_num_blocks": blocks} if layout == "paged" else {})
+        loop = ServeLoop(cfg, params, num_slots=slots,
+                         steps_per_sync=gen if on_tpu else 4,
+                         decode_attention=attn, prefill_chunk=chunk,
+                         pipeline_depth=2, **kw)
+        # warm every distinct prefill shape so no compile lands in the
+        # instrumented window
+        for n in sorted(set(lens)):
+            loop.run([Request(rng.integers(0, cfg.vocab_size, (n,)).astype(
+                np.int32), 2, rid="warm")])
+        return loop
+
+    def serve(loop) -> dict:
+        t0 = _t.perf_counter()
+        comps = loop.run(list(reqs))
+        wall = _t.perf_counter() - t0
+        sig = [(c.rid, tuple(c.tokens.tolist()), c.reason) for c in comps]
+        tokens = sum(len(c.tokens) for c in comps)
+        return {"sig": sig, "wall": wall, "tokens": tokens,
+                "bytes": kv_bytes(loop)}
+
+    dense_loop = build("dense")
+    dense = serve(dense_loop)
+    del dense_loop   # on TPU both full caches at once could not coexist
+    paged_loop = build("paged")
+    paged = serve(paged_loop)
+    pool = paged_loop.pool
+    pool.check()
+    drained = pool.free_blocks == pool.num_blocks
+    exact = dense["sig"] == paged["sig"]
+    # achievable lanes at the HBM the DENSE layout needs for `slots`:
+    # dense pays ceil(S/block) blocks per lane, paged only the
+    # workload's worst-case reservation
+    per_lane_dense = blocks_for(cfg.max_seq_len, block)
+    per_lane_paged = blocks_for(max(lens) + gen, block)
+    slots_equal_hbm = slots * per_lane_dense // per_lane_paged
+    hbm = {}
+    if on_tpu:
+        from tpudist.obs.xla import update_memory_gauges
+
+        hbm = {f"xla_{k}": v for k, v in update_memory_gauges().items()}
+    _emit("kv_paging", paged["bytes"], "bytes",
+          round(paged["bytes"] / max(dense["bytes"], 1), 3),
+          exact_match=bool(exact), pool_drained=bool(drained),
+          kv_cache_bytes_paged=paged["bytes"],
+          kv_cache_bytes_dense=dense["bytes"],
+          context=cfg.max_seq_len, slots=slots, block_size=block,
+          num_blocks=pool.num_blocks,
+          mixed_prompt_lens=sorted(set(lens)), max_new=gen,
+          slots_at_equal_hbm=slots_equal_hbm,
+          tokens_per_s_paged=round(
+              paged["tokens"] / max(paged["wall"], 1e-9), 1),
+          tokens_per_s_dense=round(
+              dense["tokens"] / max(dense["wall"], 1e-9), 1),
+          paged_vs_dense_tps=round(
+              (paged["tokens"] / max(paged["wall"], 1e-9))
+              / max(dense["tokens"] / max(dense["wall"], 1e-9), 1e-9), 3),
+          rtt_ms=round(_RTT * 1e3, 1), **hbm)
+
+
 def bench_serve_capacity(on_tpu: bool) -> None:
     """int8 KV as CAPACITY, not step time (round-4 verdict #4): at a
     fixed HBM budget the int8 cache holds ~2× the (slots × context) of
@@ -1742,6 +1865,7 @@ def main() -> None:
                bench_flash_attention, bench_window_speedup, bench_decode,
                bench_moe, bench_flash_decode_bandwidth,
                bench_serve_loop, bench_input_pipeline, bench_serve_capacity,
+               bench_kv_paging,
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode, bench_host_allreduce]
     # optional name filters: `python bench.py serve_loop moe` (positional

@@ -1,0 +1,170 @@
+"""BlockPool allocator: reservation accounting, invariants, and the
+ragged-length churn property test (admit/finish/re-admit mixed lengths
+through many segments; the pool must drain back to fully free and no
+page may ever be referenced by two live slots)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.kv_pages import BlockPool, blocks_for
+
+
+def test_blocks_for():
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+    assert blocks_for(0, 16) == 0
+
+
+def test_block_size_must_be_sublane_multiple():
+    with pytest.raises(ValueError, match="multiple of 8"):
+        BlockPool(4, 12, 2, 64)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        BlockPool(4, 0, 2, 64)
+
+
+class TestAllocation:
+    def test_admit_allocates_prompt_and_reserves_budget(self):
+        pool = BlockPool(10, 16, 2, 160)
+        # prompt 20 -> 2 blocks now; footprint min(20+40, 160)=60 -> 4
+        pool.admit(0, 20, 40)
+        assert pool.used_blocks == 2
+        assert pool.free_blocks == 10 - 4          # 2 held + 2 reserved
+        pool.check()
+
+    def test_grow_draws_from_reservation(self):
+        pool = BlockPool(10, 16, 2, 160)
+        pool.admit(0, 20, 40)
+        free_before = pool.free_blocks
+        pool.grow(0, 16)                           # coverage 36 -> 3 blocks
+        assert pool.used_blocks == 3
+        assert pool.free_blocks == free_before     # reserved -> held
+        pool.check()
+
+    def test_grow_caps_at_reservation(self):
+        pool = BlockPool(10, 16, 2, 160)
+        pool.admit(0, 20, 40)                      # cap 60 -> 4 blocks
+        for _ in range(20):
+            pool.grow(0, 16)
+        assert pool.used_blocks == 4               # never past the cap
+        pool.check()
+
+    def test_free_refunds_blocks_and_reservation(self):
+        pool = BlockPool(10, 16, 2, 160)
+        pool.admit(0, 20, 40)
+        pool.grow(0, 16)
+        pool.free_slot(0)
+        assert pool.free_blocks == 10
+        assert pool.used_blocks == 0
+        assert np.all(pool.table[0] == 0)
+        pool.check()
+
+    def test_can_admit_counts_reservations(self):
+        pool = BlockPool(4, 16, 2, 160)
+        pool.admit(0, 8, 40)                       # footprint 48 -> 3 blocks
+        assert not pool.can_admit(8, 40)           # only 1 unreserved left
+        assert pool.can_admit(8, 4)                # 1 block fits
+        pool.check()
+
+    def test_double_admit_rejected(self):
+        pool = BlockPool(8, 16, 2, 128)
+        pool.admit(0, 8, 8)
+        with pytest.raises(RuntimeError, match="still holds"):
+            pool.admit(0, 8, 8)
+
+    def test_admit_beyond_capacity_rejected(self):
+        pool = BlockPool(2, 16, 2, 160)
+        with pytest.raises(RuntimeError, match="exceeds free"):
+            pool.admit(0, 60, 20)
+
+    def test_table_entries_are_valid_pool_indices(self):
+        pool = BlockPool(6, 16, 3, 96)
+        pool.admit(1, 30, 10)
+        assert pool.table.min() >= 0
+        assert pool.table.max() < pool.num_blocks
+
+
+class TestChurnProperty:
+    def test_ragged_churn_drains_and_never_double_references(self):
+        """Many admit/grow/free cycles with ragged lengths across slots:
+        after every operation no block is on two slots (check()), and
+        when everything finishes the pool is fully free again."""
+        rng = np.random.default_rng(42)
+        S = 512
+        pool = BlockPool(48, 16, 4, S)
+        live: dict[int, int] = {}                  # slot -> segments left
+        for step in range(300):
+            op = rng.integers(0, 3)
+            if op == 0:                            # admit into a free slot
+                free_slots = [s for s in range(4) if s not in live]
+                if free_slots:
+                    L = int(rng.integers(1, 200))
+                    mn = int(rng.integers(1, min(120, S - L)))
+                    if pool.can_admit(L, mn):
+                        slot = free_slots[0]
+                        pool.admit(slot, L, mn)
+                        live[slot] = int(rng.integers(1, 6))
+            elif op == 1:                          # one decode segment
+                for slot in list(live):
+                    pool.grow(slot, 32)
+                    live[slot] -= 1
+            else:                                  # finalize finished slots
+                for slot in [s for s, left in live.items() if left <= 0]:
+                    pool.free_slot(slot)
+                    del live[slot]
+            pool.check()
+            # no page referenced by two live slots THROUGH THE TABLE
+            # either: only rows of live slots count (free rows are zeroed)
+            rows = [pool.table[s][:len(pool._slot_blocks[s])]
+                    for s in live]
+            flat = np.concatenate(rows) if rows else np.zeros(0, int)
+            assert len(flat) == len(set(flat.tolist()))
+        for slot in list(live):
+            pool.free_slot(slot)
+        pool.check()
+        assert pool.free_blocks == pool.num_blocks
+        assert pool.used_blocks == 0
+
+
+class TestServeChurnEndToEnd:
+    def test_serve_churn_returns_pool_to_free(self):
+        """The ISSUE's churn property through the REAL ServeLoop:
+        mixed-length requests admitted/finished/re-admitted over many
+        segments; the pool drains to fully free, invariants hold, and
+        every completion matches its dedicated greedy rollout."""
+        from tpudist.models.generate import greedy_generate
+        from tpudist.models.serving import Request, ServeLoop
+        from tpudist.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                                num_kv_heads=2, embed_dim=64,
+                                max_seq_len=96)
+        params = TransformerLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+        rng = np.random.default_rng(7)
+        reqs = [Request(rng.integers(0, 64, size=int(n)).astype(np.int32),
+                        int(m), rid=i)
+                for i, (n, m) in enumerate(
+                    zip(rng.integers(1, 40, size=9),
+                        rng.integers(1, 30, size=9)))]
+        loop = ServeLoop(cfg, params, num_slots=3, steps_per_sync=4,
+                         decode_attention="dense", prefill_chunk=8,
+                         stop_tokens=(7,), cache_layout="paged",
+                         kv_block_size=16, kv_num_blocks=12)
+        comps = loop.run(reqs)
+        assert sorted(c.rid for c in comps) == list(range(9))
+        loop.pool.check()
+        assert loop.pool.free_blocks == loop.pool.num_blocks
+        for c in comps:
+            n = len(c.tokens)
+            ref = greedy_generate(cfg, params,
+                                  jnp.asarray(c.prompt)[None, :], n,
+                                  stop_tokens=(7,))
+            want = np.asarray(ref[0])[0, len(c.prompt):len(c.prompt) + n]
+            np.testing.assert_array_equal(c.tokens, want,
+                                          err_msg=f"request {c.rid}")

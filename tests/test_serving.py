@@ -204,3 +204,123 @@ class TestPipelinedDispatch:
         snap = obs.snapshot()
         assert snap["histograms"]["serve/host_wait"]["count"] > before
         assert "serve/pipeline_depth" in snap["gauges"]
+
+
+class TestPagedCache:
+    """cache_layout='paged': the block-pool KV cache must be a pure
+    LAYOUT change — token-identical to dense — while its HBM scales with
+    reserved tokens and the pool drains back to free."""
+
+    def _sig(self, comps):
+        return [(c.rid, tuple(c.tokens.tolist()), c.reason) for c in comps]
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    @pytest.mark.parametrize("attn", ["dense", "flash"])
+    def test_paged_token_identical_to_dense(self, params, attn, depth):
+        """The acceptance bar: paged greedy output is TOKEN-IDENTICAL to
+        the dense layout at pipeline depths 1 and 2, on a mixed-length
+        workload with queueing, stops, and slot reuse."""
+        reqs = [Request(_prompt(70 + i, 3 + 5 * i), 20, rid=i)
+                for i in range(5)]
+        dense = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                          decode_attention=attn, prefill_chunk=8,
+                          stop_tokens=(7, 13), pipeline_depth=depth)
+        want = self._sig(dense.run(reqs))
+        paged = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                          decode_attention=attn, prefill_chunk=8,
+                          stop_tokens=(7, 13), pipeline_depth=depth,
+                          cache_layout="paged", kv_block_size=16)
+        assert self._sig(paged.run(reqs)) == want
+        paged.pool.check()
+        assert paged.pool.free_blocks == paged.pool.num_blocks
+
+    def test_small_pool_queues_instead_of_oom(self, params):
+        """A pool sized for ~one request at a time must still serve the
+        whole workload (capacity gate queues, FIFO) and match dense."""
+        reqs = [Request(_prompt(80 + i, 6), 10, rid=i) for i in range(4)]
+        dense = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                          decode_attention="dense", prefill_chunk=8)
+        want = {c.rid: tuple(c.tokens.tolist()) for c in dense.run(reqs)}
+        # 2 blocks of 16 = 32 tokens: fits one request's 16-token
+        # reservation (6 + 10), never two slots' worth at once
+        paged = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                          decode_attention="dense", prefill_chunk=8,
+                          cache_layout="paged", kv_block_size=16,
+                          kv_num_blocks=2)
+        got = {c.rid: tuple(c.tokens.tolist()) for c in paged.run(reqs)}
+        assert got == want
+        paged.pool.check()
+        assert paged.pool.free_blocks == 2
+
+    def test_paged_hbm_smaller_than_dense(self, params):
+        """The point of the PR: at equal slot count, a right-sized pool's
+        KV bytes are a fraction of the dense layout's B x S buffers."""
+        def kv_bytes(loop):
+            total = 0
+
+            def walk(node):
+                nonlocal total
+                if not isinstance(node, dict):
+                    return
+                for k, v in node.items():
+                    if k in ("cached_key", "cached_value", "paged_key",
+                             "paged_value"):
+                        total += v.size * v.dtype.itemsize
+                    elif isinstance(v, dict):
+                        walk(v)
+
+            walk(loop.cache)
+            return total
+
+        dense = ServeLoop(CFG, params, num_slots=4, steps_per_sync=4,
+                          decode_attention="dense")
+        paged = ServeLoop(CFG, params, num_slots=4, steps_per_sync=4,
+                          decode_attention="dense", cache_layout="paged",
+                          kv_block_size=16, kv_num_blocks=6)
+        assert kv_bytes(paged) < kv_bytes(dense) / 2
+
+    def test_paged_validation(self, params):
+        with pytest.raises(ValueError, match="cache_layout"):
+            ServeLoop(CFG, params, num_slots=1, cache_layout="sparse")
+        with pytest.raises(ValueError, match="block_size"):
+            ServeLoop(CFG, params, num_slots=1, cache_layout="paged",
+                      kv_block_size=12)
+        import dataclasses
+        wcfg = dataclasses.replace(CFG, attention_window=32)
+        with pytest.raises(ValueError, match="sliding-window"):
+            ServeLoop(wcfg, params, num_slots=1, cache_layout="paged")
+        # a request whose reservation can NEVER fit the pool fails fast
+        loop = ServeLoop(CFG, params, num_slots=1, cache_layout="paged",
+                         kv_block_size=16, kv_num_blocks=2)
+        with pytest.raises(ValueError, match="pool capacity"):
+            loop.run([Request(_prompt(1, 40), 20)])
+
+    def test_obs_gauges_live(self, params):
+        from tpudist import obs
+
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+        loop.run([Request(_prompt(90, 5), 8, rid=0)])
+        g = obs.snapshot()["gauges"]
+        assert g["serve/kv_blocks_used"]["value"] == 0          # drained
+        assert g["serve/kv_blocks_free"]["value"] == loop.pool.num_blocks
+        assert "serve/kv_frag" in g
+
+
+class TestPromptDtypeValidation:
+    """Regression: a float prompt used to silently truncate through
+    _admit's np.asarray(prompt, np.int32) cast."""
+
+    def test_float_prompt_rejected(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1)
+        with pytest.raises(ValueError, match="integer token ids"):
+            loop.run([Request(np.array([3.7, 5.2]), 4)])
+
+    def test_integer_dtypes_accepted(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        for dt in (np.int32, np.int64, np.uint8):
+            [c] = loop.run([Request(np.array([3, 5, 9], dt), 4, rid=dt)])
+            np.testing.assert_array_equal(
+                c.tokens, _want(params, c.prompt, 4))

@@ -17,6 +17,7 @@ from tpudist.models.generate import (
     tp_generate,
     tp_sp_generate,
 )
+from tpudist.models.kv_pages import BlockPool, blocks_for
 from tpudist.models.mlp import MLP
 from tpudist.models.speculative import (
     AdaptiveDraftPolicy,
@@ -40,6 +41,8 @@ from tpudist.models.transformer import (
 
 __all__ = [
     "AdaptiveDraftPolicy",
+    "BlockPool",
+    "blocks_for",
     "Completion",
     "ConvNet",
     "Request",

@@ -1,0 +1,206 @@
+"""Paged KV cache: host-side block-pool allocator + page-table layout.
+
+The dense serve cache allocates ``num_slots x max_seq_len`` KV positions
+per layer no matter what the requests actually use — at 8k context that
+full-context HBM bill per lane is what caps ``num_slots`` (and therefore
+decode throughput).  The paged layout (PagedAttention, vLLM SOSP '23)
+replaces the per-slot buffers with ONE device-resident block pool per
+layer (``[num_blocks, block_size, Hkv*D]``) plus a per-slot PAGE TABLE
+(``[num_slots, max_blocks_per_slot]`` int32 pool indices); a slot's
+logical position ``p`` lives at ``pool[table[slot, p // block_size],
+p % block_size]``.  Serve capacity then scales with the tokens requests
+actually RESERVE (prompt + budget), not with ``num_slots x max_seq_len``.
+
+This module is the HOST half: :class:`BlockPool` owns the free list and
+the per-slot block lists, and renders the page table the compiled side
+consumes.  Allocation policy (all host-side, O(blocks) bookkeeping — no
+device syncs anywhere):
+
+* **allocate-on-admit**: admission allocates blocks covering the prompt
+  (the insert scatter writes exactly those) and RESERVES the rest of the
+  request's worst-case footprint ``min(prompt + max_new_tokens,
+  max_seq_len)`` — growth can then never fail mid-flight, which matters
+  because the pipelined serve loop learns stop events a segment late and
+  must keep growing blindly until the finalize lands;
+* **grow-on-decode-boundary**: before each dispatched segment every live
+  slot's coverage is advanced by ``steps_per_sync`` tokens (drawn from
+  its reservation), so the per-segment side->pool merge always has pages
+  under every position it can write;
+* **free-on-finalize**: a finished request returns its blocks AND its
+  unused reservation immediately — early stops refund capacity the
+  moment the host learns of them.
+
+Admission control: :meth:`can_admit` checks the request's FULL
+reservation against unreserved free blocks and the serve loop queues the
+request instead of OOMing the pool.  Reserving the worst case forgoes
+optimistic over-commit (no preemption/swap machinery needed), yet keeps
+the capacity win: a short-prompt / small-budget request holds a few
+blocks, not a ``max_seq_len`` lane.
+
+The device half lives in :mod:`tpudist.models.transformer`
+(``CausalSelfAttention._paged_attend``) and
+:func:`tpudist.ops.flash_decode.paged_flash_decode`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudist import obs
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to cover ``tokens`` positions (ceil division)."""
+    return -(-int(tokens) // block_size)
+
+
+class BlockPool:
+    """Host-side allocator for the paged KV cache.
+
+    Args:
+      num_blocks: pool capacity (the device buffers' leading dim).
+      block_size: tokens per block; must be a positive multiple of 8
+        (the paged kernel streams one block per grid step and Mosaic
+        needs the 8-row sublane tile).
+      num_slots: decode lanes (page-table rows).
+      max_seq_len: model context; bounds ``max_blocks_per_slot``.
+
+    The page table (:attr:`table`) is a ``[num_slots,
+    max_blocks_per_slot]`` int32 array; rows are filled left-to-right
+    with the slot's allocated blocks and UNALLOCATED entries hold 0 — a
+    valid pool index, so the kernel's page-gather DMA always reads real
+    memory (the per-row length mask is what protects correctness).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, num_slots: int,
+                 max_seq_len: int) -> None:
+        if block_size < 8 or block_size % 8:
+            raise ValueError(
+                f"block_size must be a positive multiple of 8, got "
+                f"{block_size}")
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_slots = int(num_slots)
+        self.max_blocks_per_slot = blocks_for(max_seq_len, block_size)
+        self.max_seq_len = int(max_seq_len)
+        # LIFO free list: recently freed (hot) blocks are reused first
+        self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._slot_blocks: list[list[int]] = [[] for _ in range(num_slots)]
+        # per-slot tokens covered so far (the grow watermark) and the
+        # reservation cap (min(prompt + max_new, max_seq_len))
+        self._watermark = [0] * num_slots
+        self._cap = [0] * num_slots
+        self._reserved_total = 0  # blocks promised but not yet allocated
+        self.table = np.zeros(
+            (num_slots, self.max_blocks_per_slot), np.int32)
+        self._obs_used = obs.gauge("serve/kv_blocks_used", unit="blocks")
+        self._obs_free = obs.gauge("serve/kv_blocks_free", unit="blocks")
+        self._obs_frag = obs.gauge("serve/kv_frag", unit="fraction")
+        self._publish()
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        """Blocks neither allocated nor promised to a live reservation."""
+        return len(self._free) - self._reserved_total
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def _publish(self) -> None:
+        used = self.used_blocks
+        self._obs_used.set(used)
+        self._obs_free.set(self.num_blocks - used)
+        covered = sum(self._watermark)
+        alloc_tokens = used * self.block_size
+        # internal fragmentation of the allocated set: the fraction of
+        # allocated token slots not under any slot's coverage watermark
+        self._obs_frag.set(
+            0.0 if not alloc_tokens else 1.0 - covered / alloc_tokens)
+
+    def check(self) -> None:
+        """Allocator invariants — cheap enough to run in tests every
+        segment: no block on two live slots, no block both free and
+        allocated, reservation arithmetic consistent."""
+        live = [blk for blks in self._slot_blocks for blk in blks]
+        if len(live) != len(set(live)):
+            raise AssertionError("a block is referenced by two live slots")
+        overlap = set(live) & set(self._free)
+        if overlap:
+            raise AssertionError(f"blocks both free and live: {overlap}")
+        if len(live) + len(self._free) != self.num_blocks:
+            raise AssertionError("leaked blocks: live + free != pool")
+        if self._reserved_total < 0 or (
+                self._reserved_total > len(self._free)):
+            raise AssertionError(
+                f"reservation {self._reserved_total} outside free list "
+                f"{len(self._free)}")
+
+    # -- allocation --------------------------------------------------------
+
+    def request_blocks(self, prompt_len: int, max_new_tokens: int) -> int:
+        """The full worst-case footprint of a request, in blocks."""
+        total = min(prompt_len + max_new_tokens, self.max_seq_len)
+        return blocks_for(total, self.block_size)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        return (self.request_blocks(prompt_len, max_new_tokens)
+                <= self.free_blocks)
+
+    def admit(self, slot: int, prompt_len: int,
+              max_new_tokens: int) -> None:
+        """Allocate blocks covering the prompt and reserve the rest of
+        the request's footprint.  Caller must have checked
+        :meth:`can_admit` (raises ``RuntimeError`` otherwise)."""
+        if self._slot_blocks[slot]:
+            raise RuntimeError(f"slot {slot} still holds blocks; "
+                               "free_slot it before re-admitting")
+        total = self.request_blocks(prompt_len, max_new_tokens)
+        now = blocks_for(prompt_len, self.block_size)
+        if total > self.free_blocks:
+            raise RuntimeError(
+                f"admit of {total} blocks exceeds free {self.free_blocks}"
+                " (call can_admit first)")
+        self._cap[slot] = min(prompt_len + max_new_tokens,
+                              self.max_seq_len)
+        self._reserved_total += total - now
+        self._grow_to(slot, now)
+        self._watermark[slot] = prompt_len
+        self._publish()
+
+    def grow(self, slot: int, steps: int) -> None:
+        """Advance ``slot``'s coverage by ``steps`` decode tokens (capped
+        at its reservation), allocating from the reserved budget — this
+        can never fail for an admitted slot."""
+        target = min(self._watermark[slot] + steps, self._cap[slot])
+        need = blocks_for(target, self.block_size)
+        have = len(self._slot_blocks[slot])
+        if need > have:
+            self._reserved_total -= need - have
+            self._grow_to(slot, need)
+        self._watermark[slot] = target
+        self._publish()
+
+    def _grow_to(self, slot: int, count: int) -> None:
+        blks = self._slot_blocks[slot]
+        while len(blks) < count:
+            blk = self._free.pop()
+            self.table[slot, len(blks)] = blk
+            blks.append(blk)
+
+    def free_slot(self, slot: int) -> None:
+        """Return ``slot``'s blocks and its unused reservation to the
+        pool (free-on-finalize: the capacity is reusable immediately)."""
+        blks = self._slot_blocks[slot]
+        held = blocks_for(self._cap[slot], self.block_size) if blks else 0
+        self._reserved_total -= max(held - len(blks), 0)
+        self._free.extend(reversed(blks))
+        blks.clear()
+        self.table[slot, :] = 0
+        self._watermark[slot] = 0
+        self._cap[slot] = 0
+        self._publish()

@@ -54,8 +54,13 @@ from tpudist.models.generate import (
     _stop_array,
     serving_layout,
 )
+from tpudist.models.kv_pages import BlockPool
 from tpudist.models.speculative import _set_cache_index
 from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+# placeholder page row for the dense layout's admit signature (the insert
+# walk never reaches a paged node there)
+_NO_PAGES = np.zeros((0,), np.int32)
 
 
 @dataclasses.dataclass
@@ -115,6 +120,22 @@ class ServeLoop:
         prefill executables.
       stop_tokens / pad_token: EOS semantics as in ``greedy_generate``.
       temperature / top_k / top_p: sampling controls (0 = greedy).
+      cache_layout: "dense" (per-slot ``[B, S]`` KV buffers) or "paged"
+        (a shared block pool per layer + per-slot page tables —
+        PagedAttention).  Paged serving's KV HBM scales with the tokens
+        requests actually reserve, not ``num_slots × max_seq_len``; see
+        :mod:`tpudist.models.kv_pages`.  Admission gains a capacity
+        check against free blocks (requests QUEUE when the pool is
+        full, FIFO), and dispatch grows every live slot's page coverage
+        by ``steps_per_sync`` before each segment.
+      kv_block_size: tokens per KV block (paged only); a positive
+        multiple of 8.  Small blocks waste less memory on the last
+        partial block per request (~block_size/2 tokens × slots), large
+        blocks mean fewer grid steps and page-table entries.
+      kv_num_blocks: pool capacity (paged only).  Default ``None``
+        sizes the pool to full dense capacity
+        (``num_slots × ceil(max_seq_len / block_size)``); the HBM win
+        comes from passing the capacity the workload actually needs.
       pipeline_depth: compiled segments in flight before the host blocks
         on a fetch.  2 (the default) dispatches segment ``k+1`` as soon
         as ``k`` returns — the carry chains on device — and then fetches
@@ -147,6 +168,9 @@ class ServeLoop:
         key: jax.Array | None = None,
         auto_unstack: bool = True,
         pipeline_depth: int = 2,
+        cache_layout: str = "dense",
+        kv_block_size: int = 128,
+        kv_num_blocks: int | None = None,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -162,6 +186,14 @@ class ServeLoop:
             raise ValueError(
                 "ServeLoop needs the unrolled layout; pass the scanned "
                 "checkpoint with auto_unstack=True (the default)")
+        if cache_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"cache_layout must be 'dense' or 'paged', got "
+                f"{cache_layout!r}")
+        if cache_layout == "paged" and cfg.attention_window is not None:
+            raise ValueError(
+                "cache_layout='paged' has no sliding-window trim yet; "
+                "serve windowed models with the dense layout")
         self.cfg = cfg
         self.params = params
         self.B = num_slots
@@ -190,12 +222,41 @@ class ServeLoop:
         # per-row-indexed main-cache writes measured +0.35 ms/step on the
         # 8-layer 8k model) and one per-segment merge scatters side ->
         # main.  Other configurations use the direct per-row writes.
+        # the paged layout is sided UNCONDITIONALLY: the pool is frozen
+        # within a segment (growth happens at dispatch boundaries), so
+        # every in-segment token must stage in the side buffer
         self.side = (steps_per_sync
-                     if decode_attention == "flash"
-                     and cfg.attention_window is None else 0)
+                     if (decode_attention == "flash"
+                         and cfg.attention_window is None)
+                     or cache_layout == "paged" else 0)
+        self.cache_layout = cache_layout
+        if cache_layout == "paged":
+            bs_ = int(kv_block_size)
+            nb = (num_slots * -(-cfg.max_seq_len // bs_)
+                  if kv_num_blocks is None else int(kv_num_blocks))
+            self.kv_block_size, self.kv_num_blocks = bs_, nb
+            # the host half: free list, per-slot block lists, and the
+            # page table the compiled carry consumes (stamped at dispatch)
+            self.pool = BlockPool(nb, bs_, num_slots, cfg.max_seq_len)
+        else:
+            self.kv_block_size = self.kv_num_blocks = 0
+            self.pool = None
         self.model = TransformerLM(cfg, decode=True,
                                    decode_attention=decode_attention,
-                                   serve_side_slots=self.side)
+                                   serve_side_slots=self.side,
+                                   cache_layout=cache_layout,
+                                   kv_num_blocks=self.kv_num_blocks,
+                                   kv_block_size=self.kv_block_size)
+        # admission prefill ALWAYS runs dense: it fills a fresh batch-1
+        # scalar-index cache (contiguous chunked writes) and the insert
+        # scatters that row into pages — prefilling straight into the
+        # pool would need per-chunk page-table plumbing for zero gain
+        # (the batch-1 cache is transient)
+        self._prefill_model = (
+            TransformerLM(cfg, decode=True,
+                          decode_attention=decode_attention,
+                          serve_side_slots=self.side)
+            if cache_layout == "paged" else self.model)
         # the slot cache: blank, with VECTOR index leaves (one position
         # per slot) — this is what routes attention through the per-row
         # cache path — and, in sided mode, the side buffers materialized
@@ -206,7 +267,7 @@ class ServeLoop:
                           if leaf.ndim == 0 else leaf), blank)
         if self.side:
             self.cache = self._with_side_buffers(self.cache)
-        self._blank1 = _blank_cache(self.model, 1)  # prefill side cache
+        self._blank1 = _blank_cache(self._prefill_model, 1)  # prefill cache
         self._tok = jnp.full((num_slots,), self.pad_token, jnp.int32)
         self._active = jnp.zeros((num_slots,), bool)
         self._remaining = jnp.zeros((num_slots,), jnp.int32)
@@ -223,6 +284,9 @@ class ServeLoop:
         self._obs_segments = obs.counter("serve/segments", unit="segments")
         self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
         self._obs_latency = obs.histogram("serve/request_latency", unit="s")
+        # enqueue -> admit: how long requests sit behind busy lanes (and,
+        # paged, behind a full block pool)
+        self._obs_queue_wait = obs.histogram("serve/queue_wait_s", unit="s")
         # host_wait = time run() actually BLOCKS on a segment fetch (the
         # np.asarray tail not hidden by later segments' compute); depth
         # is the live in-flight segment count
@@ -260,8 +324,34 @@ class ServeLoop:
                 out["side_value"] = jnp.zeros(
                     (b, self.side, flat), out["cached_value"].dtype)
                 out["side_index"] = jnp.zeros((), jnp.int32)
+            elif "paged_key" in out:
+                # paged pool is [num_blocks, block, Hkv*D]; side buffers
+                # are per-SLOT, so their batch is self.B, not the pool's
+                flat = out["paged_key"].shape[2]
+                out["side_key"] = jnp.zeros(
+                    (self.B, self.side, flat), out["paged_key"].dtype)
+                out["side_value"] = jnp.zeros(
+                    (self.B, self.side, flat), out["paged_value"].dtype)
+                out["side_index"] = jnp.zeros((), jnp.int32)
             return out
         return walk(cache)
+
+    def _stamp_table(self) -> None:
+        """Push the host allocator's page table into the device carry.
+        Each layer gets a FRESH device array: the segment donates the
+        whole cache, and one buffer shared across every layer's
+        ``page_table`` leaf would be donated more than once."""
+        tbl = self.pool.table
+
+        def walk(node):
+            if not isinstance(node, dict):
+                return node
+            out = {k: walk(v) for k, v in node.items()}
+            if "page_table" in out:
+                out["page_table"] = jnp.asarray(tbl)
+            return out
+
+        self.cache = walk(self.cache)
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -314,7 +404,7 @@ class ServeLoop:
         returns the cache (index stamped to the TRUE length — padded
         positions hold garbage that masking hides and decode overwrites)
         and the first generated token."""
-        cache, logits = _prefill(self.model, params, self._blank1,
+        cache, logits = _prefill(self._prefill_model, params, self._blank1,
                                  prompt_padded, true_chunk)
         cache = _set_cache_index(cache, true_len)
         last = logits[0, true_len - 1 - (prompt_padded.shape[1]
@@ -322,23 +412,52 @@ class ServeLoop:
         first = self._select(last[None, :], key)[0].astype(jnp.int32)
         return cache, first
 
-    def _insert_impl(self, cache, cache1, slot, true_len):
+    def _insert_impl(self, cache, cache1, slot, true_len, pages):
         """Scatter the prefilled batch-1 cache into slot ``slot`` —
         matched BY NAME because the slot cache carries side buffers the
         prefill cache does not (they are left untouched: side_index is 0
-        between segments and stale side rows are masked)."""
+        between segments and stale side rows are masked).  Paged nodes
+        are intercepted whole: the prefill cache is always DENSE and its
+        row is re-blocked into the slot's pages."""
         def walk(big, small):
             if not isinstance(big, dict):
                 if big.ndim == 1:      # cache_index vector <- true length
                     return big.at[slot].set(true_len)
                 return big.at[slot].set(small[0])
+            if "paged_key" in big:
+                return self._insert_paged_node(
+                    big, small, slot, true_len, pages)
             return {k: (walk(v, small[k]) if k in small else v)
                     for k, v in big.items()}
         return walk(cache, cache1)
 
+    def _insert_paged_node(self, big, small, slot, true_len, pages):
+        """Scatter one layer's dense batch-1 prefill row into the block
+        pool through the slot's page row: the ``[S, F]`` row reshapes to
+        ``[M, block, F]`` blocks and lands at pool indices ``pages``;
+        blocks past the prompt's coverage target the (out-of-range)
+        index ``num_blocks`` and are DROPPED — only allocated pages are
+        written, so no live block of another slot can be hit."""
+        out = dict(big)
+        bs = self.kv_block_size
+        m = pages.shape[0]
+        n_pool = big["paged_key"].shape[0]
+        covered = jnp.arange(m) * bs < true_len
+        tgt = jnp.where(covered, pages, n_pool)
+        for name, src in (("paged_key", "cached_key"),
+                          ("paged_value", "cached_value")):
+            row = small[src][0]                       # dense [S, F]
+            pad = m * bs - row.shape[0]
+            blocks = jnp.pad(row, ((0, pad), (0, 0))).reshape(m, bs, -1)
+            out[name] = big[name].at[tgt].set(
+                blocks.astype(big[name].dtype), mode="drop")
+        out["page_table"] = big["page_table"].at[slot].set(pages)
+        out["cache_index"] = big["cache_index"].at[slot].set(true_len)
+        return out
+
     def _admit_dev_impl(self, params, cache, tok, active, remaining,
                         first_buf, prompt_padded, true_len, slot, max_new,
-                        key, *, true_chunk):
+                        pages, key, *, true_chunk):
         """The WHOLE of admission's device work — chunked prefill of the
         prompt into a fresh batch-1 cache, insertion into the freed slot,
         and the slot's token/active/budget lane stamps (plus the
@@ -350,7 +469,7 @@ class ServeLoop:
         dispatch time only, not the prefill's round trip)."""
         cache1, first = self._prefill_impl(
             params, prompt_padded, true_len, key, true_chunk=true_chunk)
-        cache = self._insert_impl(cache, cache1, slot, true_len)
+        cache = self._insert_impl(cache, cache1, slot, true_len, pages)
         tok = tok.at[slot].set(first)
         act = max_new > 1
         if self._stop is not None:
@@ -381,6 +500,8 @@ class ServeLoop:
             if not isinstance(node, dict):
                 return node
             out = {k: walk(v) for k, v in node.items()}
+            if "paged_key" in out:
+                return self._merge_paged_node(out, lived)
             if "side_key" in out:
                 idx = out["cache_index"]
                 S = out["cached_key"].shape[1]
@@ -409,6 +530,41 @@ class ServeLoop:
             return out
         return walk(cache)
 
+    def _merge_paged_node(self, out, lived):
+        """End-of-segment side -> POOL merge for one paged layer: row
+        ``r``'s live side token ``t`` lands at logical position
+        ``idx[r] + t``, i.e. pool block ``table[r, pos // block]`` offset
+        ``pos % block`` — a single two-axis scatter per buffer (unlike
+        the dense merge there is no contiguous window to dynamic-slice;
+        pages are scattered by construction).  Dead entries (frozen rows
+        past ``lived``, positions past ``max_seq_len``) are redirected to
+        the out-of-range pool index and DROPPED, so a frozen row's
+        garbage side writes never reach a block — including blocks that
+        the host has freed and re-admitted to another slot while this
+        segment was in flight (the pipelined-staleness hazard)."""
+        idx = out["cache_index"]                   # [B] main lengths
+        tbl = out["page_table"]                    # [B, M]
+        bs = self.kv_block_size
+        S = self.cfg.max_seq_len
+        n_pool = out["paged_key"].shape[0]
+        cap = out["side_key"].shape[1]
+        m = tbl.shape[1]
+        t = jnp.arange(cap)[None, :]               # [1, cap]
+        pos = idx[:, None] + t                     # [B, cap] logical
+        live = (t < lived[:, None]) & (pos < S)
+        blk = jnp.minimum(pos // bs, m - 1)
+        page = jnp.take_along_axis(tbl, blk, axis=1)
+        page = jnp.where(live, page, n_pool).reshape(-1)
+        off = (pos % bs).reshape(-1)
+        for name, side_name in (("paged_key", "side_key"),
+                                ("paged_value", "side_value")):
+            vals = out[side_name].astype(out[name].dtype)
+            out[name] = out[name].at[page, off].set(
+                vals.reshape(-1, vals.shape[2]), mode="drop")
+        out["cache_index"] = jnp.minimum(idx + lived, S)
+        out["side_index"] = jnp.zeros((), jnp.int32)
+        return out
+
     # -- the host loop -----------------------------------------------------
 
     def _validate(self, req: Request) -> None:
@@ -416,12 +572,24 @@ class ServeLoop:
         if prompt.ndim != 1 or prompt.size < 1:
             raise ValueError("request prompt must be a non-empty 1-D "
                              "token array")
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError(
+                f"request prompt must be integer token ids, got dtype "
+                f"{prompt.dtype} (_admit's int32 cast would silently "
+                "truncate float values)")
         if req.max_new_tokens < 1:
             raise ValueError("request max_new_tokens must be >= 1")
         if prompt.size + req.max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"request needs {prompt.size + req.max_new_tokens} cache "
                 f"slots > max_seq_len {self.cfg.max_seq_len}")
+        if self.pool is not None:
+            need = self.pool.request_blocks(prompt.size, req.max_new_tokens)
+            if need > self.pool.num_blocks:
+                raise ValueError(
+                    f"request reserves {need} KV blocks > pool capacity "
+                    f"{self.pool.num_blocks}; it could never be admitted "
+                    "(raise kv_num_blocks or shrink the request)")
 
     def _admit(self, slot: int, req: Request) -> dict:
         """Admit ``req`` into ``slot`` WITHOUT a host sync: the prefill
@@ -431,6 +599,16 @@ class ServeLoop:
         self._validate(req)
         prompt = np.asarray(req.prompt, np.int32)
         L = int(prompt.size)
+        if self.pool is not None:
+            # allocate-on-admit: pages covering the prompt now, the rest
+            # of the worst-case footprint RESERVED (growth at dispatch
+            # boundaries draws on the reservation and can never fail —
+            # required: the pipelined host learns stops a segment late
+            # and keeps growing blindly until the finalize lands)
+            self.pool.admit(slot, L, int(req.max_new_tokens))
+            pages = jnp.asarray(self.pool.table[slot])
+        else:
+            pages = _NO_PAGES
         chunk = min(self.prefill_chunk, self.cfg.max_seq_len)
         # pad to a chunk multiple, CAPPED at the cache size: an uncapped
         # pad past max_seq_len would make the final chunk's
@@ -444,7 +622,7 @@ class ServeLoop:
          self._first) = self._admit_dev(
             self.params, self.cache, self._tok, self._active,
             self._remaining, self._first, padded, np.int32(L),
-            np.int32(slot), np.int32(req.max_new_tokens), pk,
+            np.int32(slot), np.int32(req.max_new_tokens), pages, pk,
             true_chunk=chunk)
         return {"req": req, "tokens": [], "pending_first": True}
 
@@ -466,7 +644,10 @@ class ServeLoop:
         RNG key; sampled runs see a shifted key chain across depths)."""
         for req in requests:  # fail BEFORE any slot is touched, not mid-run
             self._validate(req)
-        pending = deque(requests)
+        # enqueue stamp: queue_wait_s = admit time - run() entry (the
+        # whole batch arrives together, so one stamp covers them all)
+        t_enq = time.perf_counter()
+        pending = deque((req, t_enq) for req in requests)
         slot_state: list[dict | None] = [None] * self.B
         done: list[Completion] = []
         inflight: deque[tuple[int, jax.Array]] = deque()
@@ -481,6 +662,14 @@ class ServeLoop:
             if "t_admit" in st:
                 self._obs_latency.record(time.perf_counter() - st["t_admit"])
             slot_state[slot] = None
+            if self.pool is not None:
+                # free-on-finalize: blocks AND the unused reservation
+                # return to the pool now.  Safe against in-flight
+                # segments that still map this slot to these blocks: the
+                # lane froze in-graph at the stop token, and the merge is
+                # masked by `lived`, so a frozen row never writes a page
+                # (its reads of recycled pages feed discarded pad emits).
+                self.pool.free_slot(slot)
 
         def drain(slot: int, emit_row) -> None:
             """Feed a slot's newly visible tokens (column 0 = the
@@ -509,7 +698,17 @@ class ServeLoop:
             so its drain is gated on that stamp."""
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
-                    req = pending.popleft()
+                    req, t_q = pending[0]
+                    if self.pool is not None and not self.pool.can_admit(
+                            int(np.asarray(req.prompt).size),
+                            int(req.max_new_tokens)):
+                        # capacity gate: QUEUE instead of OOMing the
+                        # pool.  FIFO — the head waits for blocks rather
+                        # than being jumped by a smaller request behind
+                        # it, which would starve long prompts
+                        break
+                    pending.popleft()
+                    self._obs_queue_wait.record(time.perf_counter() - t_q)
                     with obs.span("serve/admit", slot=slot):
                         slot_state[slot] = self._admit(slot, req)
                     # stamped here, not in _admit: benches wrap
@@ -527,6 +726,18 @@ class ServeLoop:
             """Chain one more segment on device and start its emits'
             async device→host copy — no host block."""
             nonlocal seq
+            if self.pool is not None:
+                # grow-on-decode-boundary: advance every live lane's page
+                # coverage by the segment's worst case (drawn from its
+                # admit-time reservation, so this cannot fail), then
+                # stamp the fresh table into the carry this segment
+                # consumes.  Lanes already frozen on device (host hasn't
+                # drained the stop yet) grow harmlessly within their
+                # reservation and refund it at finalize.
+                for slot in range(self.B):
+                    if slot_state[slot] is not None:
+                        self.pool.grow(slot, self.steps)
+                self._stamp_table()
             # the segment splits per-step keys and returns the advanced
             # key — no per-wave host-side split dispatch needed
             with obs.span("serve/segment", steps=self.steps, seq=seq):

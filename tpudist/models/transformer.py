@@ -242,6 +242,15 @@ class CausalSelfAttention(nn.Module):
     # continuous-batching side-buffer capacity (tokens per segment); > 0
     # selects the sided serve step — see _serve_attend_sided
     serve_side_slots: int = 0
+    # "dense": per-slot [B, S, Hkv*D] cache buffers; "paged": ONE shared
+    # block pool [kv_num_blocks, kv_block_size, Hkv*D] per layer plus a
+    # [B, max_blocks] page table (PagedAttention) — HBM scales with
+    # allocated tokens, not B x S.  Paged serving requires the side-
+    # buffer step (the pool is frozen within a segment; the ServeLoop's
+    # per-segment merge scatters side tokens through the page table).
+    cache_layout: str = "dense"
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, causal: bool = True) -> jnp.ndarray:
@@ -299,6 +308,15 @@ class CausalSelfAttention(nn.Module):
         cfg = self.cfg
         b, s, _, d = q.shape
         h_kv = k.shape[2]  # the GQA cache-memory win: Hkv slots, not H
+        if self.cache_layout == "paged":
+            # the paged layout never materializes the dense buffers —
+            # that absence IS the capacity win, so branch before the
+            # cached_key/cached_value variables exist
+            return self._paged_attend(q, k, v)
+        if self.cache_layout != "dense":
+            raise ValueError(
+                f"cache_layout must be 'dense' or 'paged', got "
+                f"{self.cache_layout!r}")
         # The cache is stored PACKED [B, S, Hkv*D]: with the per-head
         # 4-D shape and narrow heads (e.g. [B, S, 2, 64]), XLA lays the
         # carry out S-minor and inserts TWO full-cache layout-conversion
@@ -475,6 +493,121 @@ class CausalSelfAttention(nn.Module):
             side_k=side_k.value, side_v=side_v.value,
             side_len=side_idx.value, packed_kv_heads=h_kv)
 
+    def _paged_attend(self, q, k, v):
+        """One decode step against the PAGED cache: K/V live in a shared
+        block pool (``paged_key``/``paged_value``,
+        ``[kv_num_blocks, kv_block_size, Hkv*D]``) and each slot reaches
+        its logical positions through ``page_table``
+        (``[B, max_blocks_per_slot]`` int32 pool indices) — slot ``b``'s
+        position ``p`` is ``pool[table[b, p // bs], p % bs]``.
+
+        Within a compiled segment the pool is FROZEN (like the dense
+        sided path's main cache): the current token's K/V goes to the
+        segment-local side buffer at a scalar index, and the ServeLoop's
+        per-segment merge scatters side -> pool through the page table.
+        That makes the side-buffer step mandatory here — there is no
+        per-step paged write path (a per-row scatter through the table
+        every step would re-materialize exactly the indexed-write cost
+        the sided design measured and removed).
+
+        Attention: ``decode_attention="flash"`` runs
+        :func:`tpudist.ops.flash_decode.paged_flash_decode` (the dense
+        kernel's online softmax with a page-table-driven K/V index map);
+        ``"dense"`` gathers the slot's pages into a contiguous view and
+        masks — the CPU/test fallback."""
+        cfg = self.cfg
+        b, s = q.shape[0], q.shape[1]
+        h_kv, d = k.shape[2], k.shape[3]
+        flat = h_kv * d
+        bs_, nb = self.kv_block_size, self.kv_num_blocks
+        if bs_ < 1 or nb < 1:
+            raise ValueError(
+                "cache_layout='paged' needs kv_block_size and "
+                f"kv_num_blocks > 0 (got {bs_}, {nb})")
+        m_blocks = -(-cfg.max_seq_len // bs_)
+        paged_k = self.variable(
+            "cache", "paged_key", jnp.zeros, (nb, bs_, flat),
+            cfg.compute_dtype)
+        paged_v = self.variable(
+            "cache", "paged_value", jnp.zeros, (nb, bs_, flat),
+            cfg.compute_dtype)
+        table = self.variable(
+            "cache", "page_table", jnp.zeros, (b, m_blocks), jnp.int32)
+        idx_var = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        idx = idx_var.value
+        if idx.ndim == 0:
+            if self.is_initializing():
+                # init only creates the cache variables' shapes; the
+                # serve loop swaps cache_index to the per-row vector
+                # before any real apply
+                return jnp.zeros_like(q)
+            raise ValueError(
+                "the paged cache decodes through per-row vector "
+                "cache_index only (ServeLoop with cache_layout='paged'); "
+                "scalar-index rollouts use the dense layout")
+        if s != 1:
+            raise ValueError(
+                "paged cache decodes one token per call; prefill goes "
+                "through a dense batch-1 side cache and serving._insert "
+                "scatters it into pages")
+        if self.decode_shard is not None:
+            raise NotImplementedError(
+                "sharded decode over the paged cache is not wired yet; "
+                "serve paged through the replicated path")
+        if cfg.attention_window is not None:
+            raise ValueError(
+                "the paged cache has no sliding-window trim yet; use "
+                "cache_layout='dense' for windowed models")
+        if self.serve_side_slots <= 0:
+            raise ValueError(
+                "cache_layout='paged' requires serve_side_slots > 0 "
+                "(the pool is frozen within a segment; tokens stage in "
+                "the side buffer)")
+        cap = self.serve_side_slots
+        side_k = self.variable(
+            "cache", "side_key", jnp.zeros, (b, cap, flat),
+            cfg.compute_dtype)
+        side_v = self.variable(
+            "cache", "side_value", jnp.zeros, (b, cap, flat),
+            cfg.compute_dtype)
+        side_idx = self.variable(
+            "cache", "side_index", lambda: jnp.zeros((), jnp.int32))
+        s_at = jnp.minimum(side_idx.value, cap - 1)
+        side_k.value = jax.lax.dynamic_update_slice(
+            side_k.value,
+            k.reshape(b, 1, flat).astype(side_k.value.dtype), (0, s_at, 0))
+        side_v.value = jax.lax.dynamic_update_slice(
+            side_v.value,
+            v.reshape(b, 1, flat).astype(side_v.value.dtype), (0, s_at, 0))
+        side_idx.value = side_idx.value + 1
+
+        if self.decode_attention == "flash":
+            from tpudist.ops.flash_decode import paged_flash_decode
+
+            return paged_flash_decode(
+                q, paged_k.value, paged_v.value, table.value, idx,
+                packed_kv_heads=h_kv, side_k=side_k.value,
+                side_v=side_v.value, side_len=side_idx.value)
+        # dense fallback: gather the slot's pages into a contiguous view
+        # (one full-logical-cache copy per step — fine on CPU, the reason
+        # the kernel exists on TPU) and mask main + side positions
+        from tpudist.ops.flash_decode import paged_gather_kv
+
+        k_main = paged_gather_kv(paged_k.value, table.value)
+        v_main = paged_gather_kv(paged_v.value, table.value)
+        s_all = k_main.shape[1]
+        mask_main = jnp.arange(s_all)[None, :] < idx[:, None]      # [B, S']
+        mask_side = jnp.broadcast_to(
+            jnp.arange(cap)[None, :] < side_idx.value, (b, cap))
+        mask = jnp.concatenate([mask_main, mask_side], axis=1)
+        k_all = jnp.concatenate([k_main, side_k.value], axis=1)
+        v_all = jnp.concatenate([v_main, side_v.value], axis=1)
+        k4 = k_all.reshape(b, s_all + cap, h_kv, d)
+        v4 = v_all.reshape(b, s_all + cap, h_kv, d)
+        k_rep, v_rep = repeat_kv(q, k4, v4)
+        return _masked_attend(q, k_rep, v_rep, mask[:, None, None, :])
+
     def _prefill_attend(self, q, k_all, v_all, idx):
         """Chunk prefill: queries at global positions [idx, idx+s) attend
         over the cache's first idx+s slots, causally.  The flash path
@@ -562,6 +695,9 @@ class DecoderBlock(nn.Module):
     decode_attention: str = "dense"
     decode_shard: Any = None
     serve_side_slots: int = 0
+    cache_layout: str = "dense"
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, causal: bool = True) -> jnp.ndarray:
@@ -573,6 +709,9 @@ class DecoderBlock(nn.Module):
                                     decode_attention=self.decode_attention,
                                     decode_shard=self.decode_shard,
                                     serve_side_slots=self.serve_side_slots,
+                                    cache_layout=self.cache_layout,
+                                    kv_num_blocks=self.kv_num_blocks,
+                                    kv_block_size=self.kv_block_size,
                                     name="attn")(h, causal=causal)
         h = nn.LayerNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
         return x + MLPBlock(self.cfg, name="mlp")(h)
@@ -638,6 +777,9 @@ class TransformerLM(nn.Module):
     decode_attention: str = "dense"
     decode_shard: Any = None
     serve_side_slots: int = 0
+    cache_layout: str = "dense"
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(
@@ -665,6 +807,10 @@ class TransformerLM(nn.Module):
                     "serve_side_slots requires the unrolled layout "
                     "(scan_layers=False); serving normalizes via "
                     "serving_layout / auto_unstack")
+            if self.cache_layout != "dense":
+                raise ValueError(
+                    "cache_layout='paged' requires the unrolled layout "
+                    "(scan_layers=False), same as serve_side_slots")
             scanned = nn.scan(
                 _ScanBody,
                 variable_axes={"params": 0, "cache": 0},
@@ -682,6 +828,9 @@ class TransformerLM(nn.Module):
                               decode_attention=self.decode_attention,
                               decode_shard=self.decode_shard,
                               serve_side_slots=self.serve_side_slots,
+                              cache_layout=self.cache_layout,
+                              kv_num_blocks=self.kv_num_blocks,
+                              kv_block_size=self.kv_block_size,
                               name=f"block{i}")(x, causal)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,

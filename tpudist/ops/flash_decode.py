@@ -580,6 +580,189 @@ def _flash_decode_impl(q, k_cache, k_scale, v_cache, v_scale, cache_len,
     return unpack_out(out), unpack_lse(lse)
 
 
+def paged_gather_kv(pool: jnp.ndarray, page_table: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """Gather a paged pool into a contiguous per-slot view: ``[N, bs, F]``
+    pool + ``[B, M]`` page table -> ``[B, M*bs, F]`` (slot ``b``'s logical
+    position ``p`` lands at row ``p``).  The DENSE-fallback path for
+    CPU/test runs and the reference the paged kernel is checked against —
+    on TPU it materializes the whole logical cache every step, which is
+    exactly the copy :func:`paged_flash_decode` exists to avoid."""
+    b, m = page_table.shape
+    _, bs, flat = pool.shape
+    return pool[page_table.reshape(-1)].reshape(b, m * bs, flat)
+
+
+def paged_flash_decode(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    cache_len: jnp.ndarray,
+    *,
+    packed_kv_heads: int,
+    side_k: jnp.ndarray | None = None,
+    side_v: jnp.ndarray | None = None,
+    side_len: jnp.ndarray | int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One decode step of attention against a PAGED KV cache.
+
+    The continuous-batching capacity lever (PagedAttention): instead of a
+    dense ``[B, S, Hkv*D]`` buffer per slot, K/V live in ONE shared block
+    pool ``[num_blocks, block_size, Hkv*D]`` and each slot maps its
+    logical positions through a page table — slot ``b``'s position ``p``
+    is ``pool[page_table[b, p // block_size], p % block_size]``.  HBM
+    then scales with tokens actually allocated, not
+    ``num_slots x max_seq_len``.
+
+    The kernel is the SAME online-softmax body as :func:`flash_decode`
+    (block-diagonal head pairing included): the only paged thing about it
+    is the K/V BlockSpec index map, which reads grid step ``j``'s pool
+    block id from the scalar-prefetched page table instead of computing
+    ``start + j`` — the gather costs nothing on top of the DMA the dense
+    kernel already issues per block.  Blocks past a row's length skip
+    their FLOPs under ``pl.when`` exactly as before (dead page-table
+    entries must hold a VALID pool index, e.g. 0, so the prefetch still
+    reads real memory).
+
+    Args:
+      q: ``[B, 1, H, D]`` current-token queries.
+      k_pool / v_pool: ``[num_blocks, block_size, Hkv*D]`` packed block
+        pools (``block_size`` a multiple of 8 — the sublane tile).
+      page_table: ``[B, max_blocks_per_slot]`` int32 pool indices.
+      cache_len: ``[B]`` per-row valid lengths INCLUDING the current
+        token (the serve loop's vector ``cache_index`` + side occupancy
+        semantics are the caller's business, as with ``flash_decode``).
+      packed_kv_heads: ``H_kv`` of the packed minor dim.
+      side_k / side_v / side_len: the serve loop's segment-local staging
+        buffers (``[B, cap, Hkv*D]`` packed), attended after the paged
+        cache in the same online softmax — as on :func:`flash_decode`.
+
+    Returns ``[B, 1, H, D]``.
+    """
+    b, s_q, h, d = q.shape
+    assert s_q == 1, "paged_flash_decode consumes one query token"
+    if k_pool.ndim != 3:
+        raise ValueError(
+            f"paged pools are packed 3-D [N, block, Hkv*D]; got "
+            f"{k_pool.shape}")
+    n_pool, block, flat = k_pool.shape
+    h_kv = packed_kv_heads
+    if flat != h_kv * d:
+        raise ValueError(
+            f"pool minor dim {flat} != H_kv*D = {h_kv * d}")
+    if h % h_kv:
+        raise ValueError(f"num_heads {h} not a multiple of kv heads {h_kv}")
+    if block < 8 or block % 8:
+        raise ValueError(
+            f"block_size must be a multiple of 8, got {block}")
+    g = h // h_kv
+    gp = -(-g // 8) * 8
+    cache_len = jnp.asarray(cache_len, jnp.int32)
+    if cache_len.ndim != 1 or cache_len.shape[0] != b:
+        raise ValueError(
+            f"paged decode takes per-row cache_len [B={b}]; got "
+            f"{cache_len.shape}")
+    table = jnp.asarray(page_table, jnp.int32)
+    if table.ndim != 2 or table.shape[0] != b:
+        raise ValueError(
+            f"page_table must be [B={b}, max_blocks]; got {table.shape}")
+    m_blocks = table.shape[1]
+    side = side_k is not None
+    if side:
+        if side_k.ndim != 3:
+            raise ValueError(
+                "side buffers must be packed 3-D [B, cap, Hkv*D]")
+        cap = side_k.shape[1]
+        capp = max(8, -(-cap // 8) * 8)
+        if capp != cap:
+            pad = ((0, 0), (0, capp - cap), (0, 0))
+            side_k = jnp.pad(side_k, pad)
+            side_v = jnp.pad(side_v, pad)
+        side_k = side_k.astype(k_pool.dtype)
+        side_v = side_v.astype(v_pool.dtype)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    # meta = [side_len, offset=0, start_block=0, len_0..len_{B-1},
+    # table[0,0]..table[B-1,M-1]] — the kernel reads the first 3+B slots
+    # (identical layout to the per-row dense path), the K/V index maps
+    # read the page table tail
+    meta = jnp.concatenate([
+        jnp.stack([jnp.asarray(side_len, jnp.int32), jnp.int32(0),
+                   jnp.int32(0)]),
+        cache_len, table.reshape(-1)])
+
+    scale = d ** -0.5
+    paired = h_kv % 2 == 0 and d * 2 <= 128 and not _DISABLE_PAIRING
+    q4 = q.reshape(b, h_kv, g, d)
+    q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    if paired:
+        # pool pairing is free: adjacent KV heads are contiguous in the
+        # packed minor dim, so a pair chunk is just a wider index-map
+        # slice — no reshape of the pool ever happens
+        rows, r_kv, d_eff = 2 * gp, h_kv // 2, 2 * d
+        q3 = q4.reshape(b * r_kv, 2, gp, d)
+        gp, d = rows, d_eff
+    else:
+        r_kv = h_kv
+        q3 = q4.reshape(b * h_kv, gp, d)
+    R, M = r_kv, m_blocks  # noqa: N806 — closed over by the index maps
+
+    # THE paged line: grid step j of grid row g streams pool block
+    # table[g // R, j], read from the prefetched meta at its flattened
+    # offset — page gathering by index map, zero extra data movement
+    kv_spec = pl.BlockSpec(
+        (1, block, d),
+        lambda g_, j, m: (m[3 + b + (g_ // R) * M + j], 0, g_ % R))
+    if paired:
+        q_spec = pl.BlockSpec((1, 2, gp // 2, d // 2),
+                              lambda g_, j, m: (g_, 0, 0, 0))
+        out_spec = pl.BlockSpec((1, 2, gp // 2, d // 2),
+                                lambda g_, j, m: (g_, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct(
+            (b * r_kv, 2, gp // 2, d // 2), q.dtype)
+    else:
+        q_spec = pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))
+        out_spec = pl.BlockSpec((1, gp, d), lambda g_, j, m: (g_, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((b * r_kv, gp, d), q.dtype)
+    args = [meta, q3, k_pool, v_pool]
+    in_specs = [q_spec, kv_spec, kv_spec]
+    if side:
+        side_spec = pl.BlockSpec(
+            (1, capp, d), lambda g_, j, m: (g_ // R, 0, g_ % R))
+        args += [side_k, side_v]
+        in_specs += [side_spec, side_spec]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, block_k=block,
+            num_kb=m_blocks, window=None, with_lse=False, quant=False,
+            rows_per_batch=r_kv, paired_q=paired, side=side),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * r_kv, m_blocks),
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=[
+                pltpu.VMEM((gp, 1), jnp.float32),
+                pltpu.VMEM((gp, 1), jnp.float32),
+                pltpu.VMEM((gp, d), jnp.float32),
+            ] + ([pltpu.VMEM((gp, d), q.dtype)] if paired else []),
+        ),
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args)
+    if paired:
+        d0 = d // 2
+        o = out.reshape(b, r_kv * 2, gp // 2, d0)
+        return o[:, :, :g].reshape(b, 1, h, d0)
+    return out.reshape(b, r_kv, gp, d)[:, :, :g].reshape(b, 1, h, d)
+
+
 def quantize_kv(k: jnp.ndarray, v: jnp.ndarray):
     """Per-(token, head) symmetric int8 quantization of K/V cache blocks:
     ``[..., D] -> (int8 [..., D], f32 scale [..., 1])``.  Halves the
