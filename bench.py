@@ -1851,6 +1851,94 @@ def bench_host_allreduce(on_tpu: bool) -> None:
     server.stop()
 
 
+def bench_serve_fleet(on_tpu: bool) -> None:
+    """Fleet robustness under measurement: tokens/sec routed through the
+    fault-tolerant router at 2-4 replica worker subprocesses, with and
+    without a mid-run SIGKILL of one replica (``killed=True`` rows use
+    ``TPUDIST_FAULT_KILL_AFTER_SEGMENTS`` to tear a replica down
+    mid-decode).  Each row reports ``lost_requests`` (must be 0 — every
+    admitted request returns a Completion), ``redispatched`` /
+    ``replica_deaths`` (from the router counters), ``exact_match``
+    (routed greedy output vs an uninterrupted single-loop run over the
+    same seed-0 weights), and ``pool_drained`` (no orphaned KV blocks on
+    the cleanly-exiting replicas)."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        exit_reports, launch_local_fleet,
+                                        stop_fleet, wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_serve_fleet", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    n_requests = 8
+
+    def make_requests():
+        rng = np.random.default_rng(0)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"q{i}")
+                for i in range(n_requests)]
+
+    # the uninterrupted reference: one local loop, same seed-0 weights
+    # and cache layout as the fleet replicas
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    want = {c.rid: tuple(c.tokens.tolist())
+            for c in ref.run(make_requests())}
+
+    for idx, (n_replicas, kill) in enumerate([(2, False), (2, True),
+                                              (4, False)]):
+        ns = f"bench-fleet-{idx}"
+        env = ({1: {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "4"}}
+               if kill else None)
+        client = CoordClient(port=server.port)
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", n_replicas, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"],
+            env_overrides=env)
+        try:
+            # warm-up is jax import + compile; measure routing only
+            wait_live(client, n_replicas, namespace=ns, timeout_s=120.0)
+            before = obs.snapshot()["counters"]
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            t0 = time.perf_counter()
+            comps = router.run(make_requests(), timeout_s=180.0)
+            wall = time.perf_counter() - t0
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        got = {c.rid: tuple(c.tokens.tolist()) for c in comps}
+        reports = exit_reports(client, namespace=ns)
+        _emit("serve_fleet_tokens_per_s",
+              round(sum(len(t) for t in got.values()) / wall, 1),
+              "tokens/sec", None, replicas=n_replicas, killed=kill,
+              requests=n_requests,
+              lost_requests=n_requests - len(got),
+              redispatched=int(delta("router/redispatched")),
+              replica_deaths=int(delta("router/replica_deaths")),
+              exact_match=all(got.get(r) == w for r, w in want.items()),
+              pool_drained=all(r.get("pool_drained")
+                               for r in reports.values()),
+              clean_exits=sum(1 for r in reports.values() if r["clean"]),
+              wall_s=round(wall, 2))
+    server.stop()
+
+
 def main() -> None:
     import jax
 
@@ -1867,7 +1955,8 @@ def main() -> None:
                bench_serve_loop, bench_input_pipeline, bench_serve_capacity,
                bench_kv_paging,
                bench_pipeline_spans, bench_tp_flash_decode,
-               bench_speculative_decode, bench_host_allreduce]
+               bench_speculative_decode, bench_host_allreduce,
+               bench_serve_fleet]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

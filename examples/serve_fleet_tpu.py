@@ -1,0 +1,114 @@
+"""Fault-tolerant serve fleet demo: a router over N replica processes.
+
+Reference scope note: the reference suite is training-only; this example
+demonstrates the fleet tier tpudist adds beyond parity
+(`tpudist.runtime.router`).  It starts the native coordination server,
+launches ``--replicas`` serve worker subprocesses (each a `ServeLoop`
+over identical seed-0 tiny-LM weights, holding a TTL heartbeat lease and
+publishing its load gauges), then routes a queue of mixed requests
+least-loaded across the fleet.
+
+With ``--kill`` one replica SIGKILLs itself mid-decode
+(``TPUDIST_FAULT_KILL_AFTER_SEGMENTS`` — the fault-injection harness):
+the router notices the lapsed heartbeat, drains the dead replica's
+outstanding requests, and redispatches them to survivors.  Every request
+still completes, and because decoding is greedy over identical weights
+the redispatched outputs are token-identical to the undisturbed ones —
+the demo verifies this against a local single-loop reference run.
+
+Run (CPU works; each replica is a separate process):
+
+    python examples/serve_fleet_tpu.py --replicas 2 --requests 6 --kill
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import _common  # noqa: F401  - puts the repo root on sys.path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--requests", type=int, default=6)
+    parser.add_argument("--kill", action="store_true",
+                        help="SIGKILL the last replica mid-decode and "
+                             "watch the router redispatch")
+    parser.add_argument("--kill-after-segments", type=int, default=4)
+    parser.add_argument("--ttl", type=float, default=1.0,
+                        help="replica heartbeat lease (the death-"
+                             "detection latency floor)")
+    args = parser.parse_args(argv)
+
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        exit_reports, launch_local_fleet,
+                                        stop_fleet, wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        print(f"native coord store unavailable ({e}); "
+              "build it with `make -C native`", file=sys.stderr)
+        return 1
+
+    rng = np.random.default_rng(0)
+    requests = [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"q{i}")
+                for i in range(args.requests)]
+
+    env = ({args.replicas - 1:
+            {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS":
+             args.kill_after_segments}} if args.kill else None)
+    client = CoordClient(port=server.port)
+    print(f"launching {args.replicas} replicas"
+          + (f" (replica r{args.replicas - 1} will SIGKILL itself after "
+             f"{args.kill_after_segments} decode segments)"
+             if args.kill else ""))
+    procs = launch_local_fleet(
+        f"127.0.0.1:{server.port}", args.replicas,
+        replica_args=["--cache-layout", "paged", "--kv-block-size", "16",
+                      "--ttl", str(args.ttl)],
+        env_overrides=env)
+    try:
+        wait_live(client, args.replicas, timeout_s=120.0)
+        print("fleet live; routing")
+        router = Router(client, lost_after_s=5.0)
+        t0 = time.perf_counter()
+        comps = router.run(requests, timeout_s=180.0)
+        wall = time.perf_counter() - t0
+    finally:
+        stop_fleet(client, procs)
+
+    # verify: greedy fleet output (including anything redispatched)
+    # must be token-identical to one uninterrupted local loop
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    want = {c.rid: c.tokens.tolist() for c in ref.run(requests)}
+    mismatched = [c.rid for c in comps
+                  if c.tokens.tolist() != want[c.rid]]
+
+    for c in sorted(comps, key=lambda c: c.rid):
+        print(f"  {c.rid}: {len(c.tokens)} tokens ({c.reason})")
+    reports = exit_reports(client, namespace="fleet")
+    print(f"{len(comps)}/{len(requests)} requests completed "
+          f"in {wall:.1f}s; clean exits: {sorted(reports)}; "
+          f"pools drained: "
+          f"{all(r.get('pool_drained') for r in reports.values())}")
+    if len(comps) != len(requests) or mismatched:
+        print(f"FAILED: mismatched={mismatched}", file=sys.stderr)
+        return 1
+    print("exact match vs uninterrupted reference run OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

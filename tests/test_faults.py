@@ -1,0 +1,192 @@
+"""Fault-injection harness (ISSUE 6): env parsing, deterministic
+injection, CoordClient's idempotent-op retry riding through injected
+faults, heartbeat drop, and the SIGKILL-after-K-segments schedule."""
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpudist import obs
+from tpudist.runtime import faults
+from tpudist.runtime.faults import FaultInjected, FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """Never leak an installed plan (or env-parsed state) across tests."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _coord_pair():
+    try:
+        from tpudist.runtime.coord import CoordClient, CoordServer
+
+        server = CoordServer(0)
+    except Exception as e:  # NativeUnavailable or build failure
+        pytest.skip(f"native coord store unavailable: {e}")
+    return server, CoordClient("127.0.0.1", server.port)
+
+
+class TestPlan:
+    def test_env_parsing(self):
+        plan = FaultPlan.from_env({
+            "TPUDIST_FAULT_COORD_ERROR_P": "0.25",
+            "TPUDIST_FAULT_COORD_DELAY_P": "0.5",
+            "TPUDIST_FAULT_COORD_DELAY_S": "0.01",
+            "TPUDIST_FAULT_HEARTBEAT_STOP_AFTER_S": "3.5",
+            "TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "7",
+            "TPUDIST_FAULT_SEED": "42",
+        })
+        assert plan.active
+        assert plan.coord_error_p == 0.25
+        assert plan.coord_delay_p == 0.5
+        assert plan.coord_delay_s == 0.01
+        assert plan.heartbeat_stop_after_s == 3.5
+        assert plan.kill_after_segments == 7
+        assert plan.seed == 42
+
+    def test_empty_env_is_inert(self):
+        plan = FaultPlan.from_env({})
+        assert not plan.active
+        # inert hooks are no-ops
+        plan.coord_op("get")
+        assert not plan.drop_heartbeat()
+        plan.on_segment()
+        assert plan.injected == {"coord_error": 0, "coord_delay": 0,
+                                 "heartbeat_drop": 0}
+
+    def test_probability_validation(self):
+        with pytest.raises(ValueError, match="coord_error_p"):
+            FaultPlan(coord_error_p=1.5)
+        with pytest.raises(ValueError, match="coord_delay_p"):
+            FaultPlan(coord_delay_p=-0.1)
+
+    def test_injection_is_seed_deterministic(self):
+        """Same seed => bit-identical injection schedule (a failing CI
+        run replays); different seed => (almost surely) different."""
+
+        def schedule(seed):
+            plan = FaultPlan(coord_error_p=0.3, seed=seed)
+            out = []
+            for _ in range(64):
+                try:
+                    plan.coord_op("get")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+            return out
+
+        a, b = schedule(7), schedule(7)
+        assert a == b and 0 < sum(a) < 64
+        assert schedule(8) != a
+
+    def test_module_plan_reads_env_once(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_FAULT_COORD_ERROR_P", "1.0")
+        faults.reset()
+        assert faults.plan().coord_error_p == 1.0
+        with pytest.raises(FaultInjected):
+            faults.coord_op("get")
+        monkeypatch.delenv("TPUDIST_FAULT_COORD_ERROR_P")
+        # still cached until reset
+        assert faults.plan().coord_error_p == 1.0
+        faults.reset()
+        assert faults.plan().coord_error_p == 0.0
+
+
+class _FailFirstN(FaultPlan):
+    """Raise on the first ``fail_n`` coord ops, then pass — the
+    deterministic shape of a transient network blip."""
+
+    def __init__(self, fail_n):
+        super().__init__()
+        self.active = True
+        self.fail_n = fail_n
+        self.calls = 0
+
+    def coord_op(self, op):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise FaultInjected(f"injected: {op} call #{self.calls}")
+
+
+class TestCoordRetry:
+    def test_idempotent_get_retries_through_transient_fault(self):
+        server, client = _coord_pair()
+        client.set("k", b"v")  # before the plan goes in
+        before = obs.snapshot()["counters"].get(
+            "coord/retries", {}).get("value", 0)
+        plan = _FailFirstN(2)
+        faults.install(plan)
+        try:
+            assert client.get("k") == b"v"  # default retries=2 suffice
+        finally:
+            faults.reset()
+        assert plan.calls == 3  # 2 failures + 1 success
+        after = obs.snapshot()["counters"]["coord/retries"]["value"]
+        assert after - before == 2
+
+    def test_retry_budget_exhausts(self):
+        server, client = _coord_pair()
+        faults.install(_FailFirstN(10))
+        try:
+            with pytest.raises(FaultInjected):
+                client.get("k")
+        finally:
+            faults.reset()
+
+    def test_non_idempotent_add_surfaces_immediately(self):
+        """add is a read-modify-write: a lost reply may have applied, so
+        replaying it risks double-counting — the client must NOT retry."""
+        server, client = _coord_pair()
+        plan = _FailFirstN(1)
+        faults.install(plan)
+        try:
+            with pytest.raises(FaultInjected):
+                client.add("ctr", 1)
+        finally:
+            faults.reset()
+        assert plan.calls == 1  # exactly one attempt
+        # the fault fired BEFORE the RPC: nothing was applied
+        assert client.add("ctr", 1) == 1
+
+    def test_heartbeat_drop_swallows_lease_refresh(self):
+        server, client = _coord_pair()
+        faults.install(FaultPlan(heartbeat_stop_after_s=0.0))
+        try:
+            client.heartbeat("hb-dropped", 5.0)
+            assert "hb-dropped" not in client.live()
+        finally:
+            faults.reset()
+        client.heartbeat("hb-live", 5.0)
+        assert "hb-live" in client.live()
+        client.heartbeat("hb-live", 0.0)  # leave
+
+
+class TestKillSchedule:
+    def test_sigkill_after_k_segments(self, tmp_path):
+        """The subprocess counts segments and must vanish (SIGKILL, no
+        cleanup) on the Kth — asserted by return code -9 and by which
+        progress markers made it to stdout."""
+        script = (
+            "from tpudist.runtime import faults\n"
+            "for i in range(5):\n"
+            "    print(f'seg{i}', flush=True)\n"
+            "    faults.on_segment()\n"
+            "print('survived', flush=True)\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(Path(__file__).resolve().parents[1])]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        env["TPUDIST_FAULT_KILL_AFTER_SEGMENTS"] = "3"
+        res = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert res.returncode == -signal.SIGKILL
+        assert "seg2" in res.stdout  # the fatal segment was dispatched
+        assert "survived" not in res.stdout

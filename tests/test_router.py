@@ -1,0 +1,201 @@
+"""Fault-tolerant serve fleet (ISSUE 6): least-loaded pick, wire
+round-trip, the no-hang bound, and the acceptance end-to-end — SIGKILL a
+replica mid-decode and every admitted request still returns a greedy
+exact-match Completion with no orphaned KV blocks."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpudist.runtime.router import (
+    Router, _decode_request, _encode_completion, _encode_request,
+    build_tiny_lm, exit_reports, launch_local_fleet, stop_fleet,
+    wait_live)
+
+
+def _coord_pair():
+    try:
+        from tpudist.runtime.coord import CoordClient, CoordServer
+
+        server = CoordServer(0)
+    except Exception as e:  # NativeUnavailable or build failure
+        pytest.skip(f"native coord store unavailable: {e}")
+    return server, CoordClient("127.0.0.1", server.port)
+
+
+def _requests(n):
+    """The fleet workload: varied prompt lengths and budgets, seeded so
+    the uninterrupted reference run is reproducible."""
+    from tpudist.models.serving import Request
+
+    rng = np.random.default_rng(0)
+    return [Request(rng.integers(0, 64, size=4 + i).astype(np.int32),
+                    20 + 2 * i, rid=f"q{i}") for i in range(n)]
+
+
+class TestPick:
+    def _router(self):
+        return Router(None, use_health=False)
+
+    def test_prefers_fewest_outstanding(self):
+        r = self._router()
+        loads = {"a": {"queue_depth": 0.0, "queue_wait_mean": 0.0,
+                       "kv_blocks_free": 10.0, "rejected": 0.0},
+                 "b": {"queue_depth": 0.0, "queue_wait_mean": 0.0,
+                       "kv_blocks_free": 10.0, "rejected": 0.0}}
+        assert r._pick(["a", "b"], loads, {"a": 2, "b": 1}) == "b"
+        # published queue depth counts the same as own assignments
+        loads["b"]["queue_depth"] = 3.0
+        assert r._pick(["a", "b"], loads, {"a": 2}) == "a"
+
+    def test_tiebreak_queue_wait_then_free_blocks(self):
+        r = self._router()
+        loads = {"a": {"queue_depth": 0.0, "queue_wait_mean": 0.5,
+                       "kv_blocks_free": 50.0},
+                 "b": {"queue_depth": 0.0, "queue_wait_mean": 0.1,
+                       "kv_blocks_free": 2.0}}
+        assert r._pick(["a", "b"], loads, {}) == "b"
+        loads["b"]["queue_wait_mean"] = 0.5
+        assert r._pick(["a", "b"], loads, {}) == "a"
+
+    def test_dense_replica_sorts_as_infinite_blocks(self):
+        r = self._router()
+        loads = {"paged": {"queue_depth": 0.0, "queue_wait_mean": 0.0,
+                           "kv_blocks_free": 100.0},
+                 "dense": {"queue_depth": 0.0, "queue_wait_mean": 0.0,
+                           "kv_blocks_free": None}}
+        assert r._pick(["paged", "dense"], loads, {}) == "dense"
+
+    def test_no_candidates(self):
+        assert self._router()._pick([], {}, {}) is None
+
+
+class TestWireFormat:
+    def test_request_roundtrip(self):
+        from tpudist.models.serving import Completion, Request
+
+        req = Request(np.array([3, 1, 4], np.int32), 9, rid="caller-id",
+                      deadline_s=123.5)
+        got = _decode_request(_encode_request("00000007", req))
+        np.testing.assert_array_equal(got.prompt, req.prompt)
+        assert got.max_new_tokens == 9
+        assert got.rid == "00000007"  # router key, not caller rid
+        assert got.deadline_s == 123.5
+
+        comp = Completion(rid="00000007", prompt=req.prompt,
+                          tokens=np.array([5, 6], np.int32),
+                          reason="length")
+        import json
+
+        d = json.loads(_encode_completion("r1", comp).decode())
+        assert d == {"key": "00000007", "tokens": [5, 6],
+                     "reason": "length", "replica": "r1"}
+
+
+class TestNoHang:
+    def test_timeout_instead_of_hang_with_no_fleet(self):
+        server, client = _coord_pair()
+        router = Router(client, namespace="empty-fleet", use_health=False,
+                        poll_s=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="1 of 1"):
+            router.run(_requests(1), timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestFleetE2E:
+    def _route(self, client, procs, n_requests, *, namespace,
+               lost_after_s=5.0):
+        try:
+            wait_live(client, len(procs), namespace=namespace,
+                      timeout_s=90.0)
+            router = Router(client, namespace=namespace,
+                            lost_after_s=lost_after_s)
+            comps = router.run(_requests(n_requests), timeout_s=120.0)
+        finally:
+            stop_fleet(client, procs, namespace=namespace)
+        return comps
+
+    def _reference(self, n_requests):
+        """The uninterrupted run: one local ServeLoop, identical seed
+        and layout to the fleet replicas."""
+        from tpudist.models.serving import ServeLoop
+
+        cfg, params = build_tiny_lm(seed=0)
+        loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+        return {c.rid: tuple(c.tokens.tolist())
+                for c in loop.run(_requests(n_requests))}
+
+    def test_kill_mid_decode_every_request_completes_exact(self):
+        """THE acceptance E2E: 2 replicas, replica r1 SIGKILLs itself
+        after 4 dispatched segments (uncatchable, mid-decode).  Every
+        admitted request must still return a Completion, redispatched
+        greedy output must be token-identical to an uninterrupted run,
+        the survivor's pool must drain fully free, and the whole run
+        must finish inside the TTL + redispatch bound (timeout_s=120
+        would raise TimeoutError — not hitting it IS the bound check)."""
+        from tpudist import obs
+
+        server, client = _coord_pair()
+        ns = "kill-fleet"
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"],
+            env_overrides={1: {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "4"}})
+        before = obs.snapshot()["counters"]
+        comps = self._route(client, procs, 6, namespace=ns)
+
+        # every admitted request returned exactly one Completion
+        assert sorted(c.rid for c in comps) == [f"q{i}" for i in range(6)]
+        assert all(c.reason == "length" for c in comps)
+        # the kill actually happened and forced redispatch
+        after = obs.snapshot()["counters"]
+        deaths = (after["router/replica_deaths"]["value"]
+                  - before.get("router/replica_deaths",
+                               {}).get("value", 0))
+        redispatched = (after["router/redispatched"]["value"]
+                        - before.get("router/redispatched",
+                                     {}).get("value", 0))
+        assert deaths >= 1 and redispatched >= 1
+        assert procs[1].returncode == -9  # SIGKILL, not a clean exit
+        # redispatched greedy output is token-identical to an
+        # uninterrupted single-loop run over the same weights
+        want = self._reference(6)
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, np.asarray(want[c.rid], np.int32),
+                err_msg=f"request {c.rid} diverged after redispatch")
+        # no orphaned KV blocks: the survivor drained its pool; the
+        # killed replica leaves NO exit report (it vanished)
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0"}
+        assert reports["r0"]["pool_drained"] is True
+        assert reports["r0"]["clean"] is True
+
+    def test_two_replicas_share_load_no_faults(self):
+        """Happy path: both replicas serve, output exact-matches the
+        local reference, both exit cleanly with drained pools."""
+        server, client = _coord_pair()
+        ns = "happy-fleet"
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"])
+        comps = self._route(client, procs, 4, namespace=ns)
+        assert sorted(c.rid for c in comps) == [f"q{i}" for i in range(4)]
+        want = self._reference(4)
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, np.asarray(want[c.rid], np.int32))
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0", "r1"}
+        served = {rid: r["served"] for rid, r in reports.items()}
+        assert sum(served.values()) == 4
+        assert all(r["pool_drained"] and r["clean"]
+                   for r in reports.values())
+        # least-loaded admission actually spread the work
+        assert all(v >= 1 for v in served.values()), served

@@ -324,3 +324,136 @@ class TestPromptDtypeValidation:
             [c] = loop.run([Request(np.array([3, 5, 9], dt), 4, rid=dt)])
             np.testing.assert_array_equal(
                 c.tokens, _want(params, c.prompt, 4))
+
+
+class TestAdmissionControl:
+    """ISSUE 6 satellites: bounded queue with load shedding and
+    per-request deadlines that refund their KV reservation."""
+
+    def test_max_queue_sheds_newest_as_rejected(self, params):
+        import time
+
+        from tpudist import obs
+
+        before = obs.snapshot()["counters"].get(
+            "serve/rejected", {}).get("value", 0)
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8, max_queue=1)
+        reqs = [Request(_prompt(70 + i, 4), 6, rid=f"q{i}")
+                for i in range(5)]
+        comps = {c.rid: c for c in loop.run(reqs)}
+        assert len(comps) == 5  # shed requests still get a Completion
+        # q0 fills the slot, q1 holds the one queue place; the NEWEST
+        # arrivals are shed (earlier arrivals keep their FIFO place)
+        served = {r for r, c in comps.items() if c.reason == "length"}
+        shed = {r for r, c in comps.items() if c.reason == "rejected"}
+        assert served == {"q0", "q1"} and shed == {"q2", "q3", "q4"}
+        for rid in shed:
+            assert comps[rid].tokens.shape == (0,)
+        for rid in served:
+            np.testing.assert_array_equal(
+                comps[rid].tokens, _want(params, comps[rid].prompt, 6))
+        after = obs.snapshot()["counters"]["serve/rejected"]["value"]
+        assert after - before == 3
+
+    def test_max_queue_validation(self, params):
+        with pytest.raises(ValueError, match="max_queue"):
+            ServeLoop(CFG, params, num_slots=1, max_queue=-1)
+
+    def test_expired_queued_deadline_times_out(self, params):
+        import time
+
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        comps = {c.rid: c for c in loop.run([
+            Request(_prompt(1, 4), 6, rid="late",
+                    deadline_s=time.time() - 100.0),
+            Request(_prompt(2, 5), 6, rid="ok"),
+        ])}
+        assert comps["late"].reason == "timeout"
+        assert comps["late"].tokens.shape == (0,)
+        assert comps["ok"].reason == "length"
+        np.testing.assert_array_equal(
+            comps["ok"].tokens, _want(params, comps["ok"].prompt, 6))
+
+    def test_inflight_deadline_refunds_paged_pool(self, params):
+        """A request whose deadline passes MID-DECODE must finalize
+        reason='timeout' with the tokens it produced so far, and its KV
+        blocks must come back to the pool even with segments still in
+        flight (the zombie-slot path) — then the loop must serve a fresh
+        request exactly (requeue-safe finalize)."""
+        import time
+
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+        # deterministic expiry: the swappable clock jumps past the
+        # deadline on its 9th read — after admission and a few
+        # dispatched segments, before the 40-token budget completes
+        t0 = time.time()
+        calls = [0]
+
+        def clock():
+            calls[0] += 1
+            return t0 + (1000.0 if calls[0] > 8 else 0.0)
+
+        loop._clock = clock
+        [c] = loop.run([Request(_prompt(77, 6), 40, rid="doomed",
+                                deadline_s=t0 + 500.0)])
+        assert c.reason == "timeout"
+        assert 0 < c.tokens.shape[0] < 40  # partial, mid-decode
+        # the partial output is a prefix of the uninterrupted rollout
+        np.testing.assert_array_equal(
+            c.tokens, _want(params, c.prompt, 40)[:c.tokens.shape[0]])
+        # no orphaned blocks: the reservation was refunded in full
+        loop.pool.check()
+        assert loop.pool.free_blocks == loop.pool.num_blocks
+        # the loop (and the recycled blocks) still serve exactly
+        loop._clock = time.time
+        [c2] = loop.run([Request(_prompt(78, 5), 12, rid="next")])
+        assert c2.reason == "length"
+        np.testing.assert_array_equal(
+            c2.tokens, _want(params, c2.prompt, 12))
+
+
+class TestServiceMode:
+    """run(source=..., sink=...): incremental intake for the fleet's
+    replica worker, with streaming completions."""
+
+    def test_incremental_intake_streams_to_sink(self, params):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8)
+        batches = iter([
+            [Request(_prompt(1, 4), 6, rid="a"),
+             Request(_prompt(2, 9), 8, rid="b")],
+            [],                                     # idle poll
+            [Request(_prompt(3, 6), 5, rid="c")],   # late arrival
+            None,                                   # close + drain
+        ])
+        streamed = []
+        out = loop.run(source=lambda: next(batches),
+                       sink=streamed.append, idle_wait_s=0.0)
+        assert sorted(c.rid for c in out) == ["a", "b", "c"]
+        assert [c.rid for c in streamed] == [c.rid for c in out]
+        for c in out:
+            assert c.reason == "length"
+            np.testing.assert_array_equal(
+                c.tokens,
+                _want(params, c.prompt, c.tokens.shape[0]))
+
+    def test_malformed_request_completes_invalid(self, params):
+        """Service mode can't raise on a bad wire request (the loop must
+        keep serving the fleet) — it completes reason='invalid'."""
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        batches = iter([
+            [Request(_prompt(1, 90), 20, rid="toolong"),
+             Request(_prompt(1, 4), 6, rid="fine")],
+            None,
+        ])
+        comps = {c.rid: c for c in loop.run(source=lambda: next(batches),
+                                            idle_wait_s=0.0)}
+        assert comps["toolong"].reason == "invalid"
+        assert comps["toolong"].tokens.shape == (0,)
+        np.testing.assert_array_equal(
+            comps["fine"].tokens, _want(params, comps["fine"].prompt, 6))

@@ -47,6 +47,7 @@ import numpy as np
 from jax import lax
 
 from tpudist import obs
+from tpudist.runtime import faults
 from tpudist.models.generate import (
     _blank_cache,
     _make_select,
@@ -65,11 +66,20 @@ _NO_PAGES = np.zeros((0,), np.int32)
 
 @dataclasses.dataclass
 class Request:
-    """One serving request: a prompt and its generation budget."""
+    """One serving request: a prompt and its generation budget.
+
+    ``deadline_s`` is an ABSOLUTE wall-clock deadline (``time.time()``
+    epoch seconds, ``None`` = no deadline).  A request whose deadline
+    passes while queued completes with ``reason="timeout"`` and no
+    tokens; one that expires mid-decode is killed at the next segment
+    boundary, completes with the tokens generated so far, and refunds
+    its KV block reservation — a stuck client can never pin pool
+    capacity forever."""
 
     prompt: np.ndarray            # [L] int32 tokens, L >= 1
     max_new_tokens: int
     rid: Any = None               # caller's correlation id
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -77,7 +87,10 @@ class Completion:
     rid: Any
     prompt: np.ndarray
     tokens: np.ndarray            # the generated tokens (stop included)
-    reason: str                   # "stop" | "length"
+    # "stop" | "length" — the normal endings; "rejected" (load-shed at a
+    # full admission queue), "timeout" (deadline_s passed), "invalid"
+    # (service-mode request failed validation)
+    reason: str
 
 
 def _index_leaves(cache: Any) -> tuple[jnp.ndarray, jnp.ndarray | None]:
@@ -149,6 +162,12 @@ class ServeLoop:
         token-identical (frozen rows emit pads in-graph; stale columns
         are dropped by the same rules as the synchronous loop).  1
         restores the fully synchronous loop.
+      max_queue: bound on WAITING requests (excluding the ones already
+        in slots).  ``None`` (default) keeps the queue unbounded; with a
+        bound, overflow requests are load-shed newest-first — they
+        complete immediately with ``reason="rejected"`` and tick the
+        ``serve/rejected`` counter, which a router reads to back off a
+        saturated replica instead of piling more work on it.
     """
 
     def __init__(
@@ -171,9 +190,12 @@ class ServeLoop:
         cache_layout: str = "dense",
         kv_block_size: int = 128,
         kv_num_blocks: int | None = None,
+        max_queue: int | None = None,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         if steps_per_sync < 1:
             raise ValueError(
                 f"steps_per_sync must be >= 1, got {steps_per_sync}")
@@ -279,8 +301,15 @@ class ServeLoop:
         self._first = jnp.full((num_slots,), self.pad_token, jnp.int32)
         # obs handles cached once; recording on the serve loop is host
         # ints/floats only, never a device fetch
+        self.max_queue = None if max_queue is None else int(max_queue)
+        # deadline clock, swappable by tests (deterministic expiry
+        # without real sleeps); production uses wall time because
+        # Request.deadline_s crosses process boundaries via the router
+        self._clock = time.time
         self._obs_requests = obs.counter("serve/requests", unit="reqs")
         self._obs_tokens = obs.counter("serve/tokens", unit="tokens")
+        self._obs_rejected = obs.counter("serve/rejected", unit="reqs")
+        self._obs_timeouts = obs.counter("serve/timeouts", unit="reqs")
         self._obs_segments = obs.counter("serve/segments", unit="segments")
         self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
         self._obs_latency = obs.histogram("serve/request_latency", unit="s")
@@ -626,7 +655,9 @@ class ServeLoop:
             true_chunk=chunk)
         return {"req": req, "tokens": [], "pending_first": True}
 
-    def run(self, requests: Sequence[Request]) -> list[Completion]:
+    def run(self, requests: Sequence[Request] = (), *,
+            source=None, sink=None,
+            idle_wait_s: float = 0.005) -> list[Completion]:
         """Serve every request to completion; returns completions in
         FINISH order (slot events), each with its generated tokens.
 
@@ -641,28 +672,90 @@ class ServeLoop:
         misread as the new request's output.  The drain itself applies
         the same stop/budget rules as the synchronous loop, so output
         is token-identical at any depth (greedy selection ignores the
-        RNG key; sampled runs see a shifted key chain across depths)."""
+        RNG key; sampled runs see a shifted key chain across depths).
+
+        SERVICE MODE — ``source`` / ``sink`` turn the batch runner into
+        a long-lived replica worker (the router tier's unit):
+
+        * ``source()`` is polled once per outer-loop iteration and
+          returns an iterable of new :class:`Request`\\ s (``[]`` =
+          open but idle; the loop sleeps ``idle_wait_s`` when there is
+          nothing to do), or ``None`` to CLOSE intake — the loop then
+          drains everything in flight and returns.  Service-mode
+          requests that fail validation complete with
+          ``reason="invalid"`` instead of raising (one malformed
+          request must not take the replica down).
+        * ``sink(completion)`` fires at every finalize — including
+          rejections and timeouts — so completions stream out while the
+          loop runs; the full list is still returned.
+
+        Deadline kills and the paged layout interact with pipelining:
+        segments already in flight at kill time carry the PRE-KILL
+        active mask and page table, so the killed lane's blocks cannot
+        be refunded (and re-allocated) until every one of those
+        segments has drained — a freed-then-recycled block would be
+        written by a stale merge.  The lane is parked as a ZOMBIE
+        (finalized for the caller, un-admittable, blocks held) and the
+        refund happens when the drain index passes the kill point; the
+        in-graph freeze (``active=False``) guarantees segments
+        dispatched AFTER the kill never write it."""
         for req in requests:  # fail BEFORE any slot is touched, not mid-run
             self._validate(req)
-        # enqueue stamp: queue_wait_s = admit time - run() entry (the
-        # whole batch arrives together, so one stamp covers them all)
-        t_enq = time.perf_counter()
-        pending = deque((req, t_enq) for req in requests)
+        pending: deque[tuple[Request, float]] = deque()
         slot_state: list[dict | None] = [None] * self.B
         done: list[Completion] = []
         inflight: deque[tuple[int, jax.Array]] = deque()
         seq = 0   # segments dispatched so far == index of the next one
+        closed = source is None
 
-        def finalize(slot: int, reason: str) -> None:
+        def emit(comp: Completion) -> None:
+            done.append(comp)
+            if sink is not None:
+                sink(comp)
+
+        def complete_unadmitted(req: Request, reason: str) -> None:
+            """Finalize a request that never reached a slot (shed,
+            expired in queue, or invalid): no tokens, no lane state."""
+            if reason == "rejected":
+                self._obs_rejected.inc()
+            elif reason == "timeout":
+                self._obs_timeouts.inc()
+            emit(Completion(
+                rid=req.rid, prompt=np.asarray(req.prompt),
+                tokens=np.zeros((0,), np.int32), reason=reason))
+
+        def intake(batch, strict: bool) -> None:
+            """Enqueue new requests; service mode (strict=False) turns
+            validation failures into ``reason="invalid"`` completions."""
+            for req in batch:
+                if not strict:
+                    try:
+                        self._validate(req)
+                    except ValueError:
+                        complete_unadmitted(req, "invalid")
+                        continue
+                pending.append((req, time.perf_counter()))
+
+        def shed() -> None:
+            """Load-shed the queue down to ``max_queue`` — newest first,
+            so earlier arrivals keep their FIFO place."""
+            while (self.max_queue is not None
+                   and len(pending) > self.max_queue):
+                req, _ = pending.pop()
+                complete_unadmitted(req, "rejected")
+            self._obs_queue.set(len(pending))
+
+        def finalize(slot: int, reason: str, *,
+                     free_pool: bool = True) -> None:
             st = slot_state[slot]
-            done.append(Completion(
+            emit(Completion(
                 rid=st["req"].rid, prompt=np.asarray(st["req"].prompt),
                 tokens=np.asarray(st["tokens"], np.int32), reason=reason))
             self._obs_tokens.inc(len(st["tokens"]))
             if "t_admit" in st:
                 self._obs_latency.record(time.perf_counter() - st["t_admit"])
             slot_state[slot] = None
-            if self.pool is not None:
+            if self.pool is not None and free_pool:
                 # free-on-finalize: blocks AND the unused reservation
                 # return to the pool now.  Safe against in-flight
                 # segments that still map this slot to these blocks: the
@@ -671,31 +764,50 @@ class ServeLoop:
                 # (its reads of recycled pages feed discarded pad emits).
                 self.pool.free_slot(slot)
 
-        def drain(slot: int, emit_row) -> None:
-            """Feed a slot's newly visible tokens (column 0 = the
-            admission-deferred first token, then the segment's emits)
-            through the stop/budget rules; the first hit finalizes
-            BEFORE any frozen-row pad could be consumed, mirroring the
-            compiled freeze rule token for token."""
-            st = slot_state[slot]
-            row = [int(t) for t in emit_row]
-            if st["pending_first"]:
-                st["pending_first"] = False
-            else:
-                row = row[1:]               # column 0 is a stale first
-            for t in row:
-                st["tokens"].append(t)
-                if t in self._stop_set:
-                    finalize(slot, "stop")
-                    return
-                if len(st["tokens"]) >= st["req"].max_new_tokens:
-                    finalize(slot, "length")
-                    return
+        def expire_inflight() -> None:
+            """Kill lanes whose deadline passed: freeze the row on
+            device, finalize with the tokens drained so far.  Dense
+            lanes free immediately (the seq stamp already gates stale
+            emits); paged lanes with segments in flight become zombies
+            until the pre-kill segments drain (see the docstring)."""
+            now = None
+            for slot in range(self.B):
+                st = slot_state[slot]
+                if (st is None or st.get("zombie")
+                        or st["req"].deadline_s is None):
+                    continue
+                if now is None:
+                    now = self._clock()
+                if now <= st["req"].deadline_s:
+                    continue
+                self._active = self._active.at[slot].set(False)
+                self._obs_timeouts.inc()
+                obs.recorder.record("serve_timeout", slot=slot, seq=seq,
+                                    tokens=len(st["tokens"]))
+                if self.pool is not None and inflight:
+                    finalize(slot, "timeout", free_pool=False)
+                    slot_state[slot] = {"zombie": True, "free_at": seq}
+                else:
+                    finalize(slot, "timeout")
 
         def admit_free() -> None:
-            """Fill free lanes from the queue; a new admission's tokens
-            first surface in the NEXT dispatched segment (index ``seq``),
-            so its drain is gated on that stamp."""
+            """Expire queued deadlines, then fill free lanes from the
+            queue; a new admission's tokens first surface in the NEXT
+            dispatched segment (index ``seq``), so its drain is gated
+            on that stamp."""
+            nonlocal pending
+            if pending:
+                now = None
+                kept: deque[tuple[Request, float]] = deque()
+                for req, t_q in pending:
+                    if req.deadline_s is not None:
+                        if now is None:
+                            now = self._clock()
+                        if now > req.deadline_s:
+                            complete_unadmitted(req, "timeout")
+                            continue
+                    kept.append((req, t_q))
+                pending = kept
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
                     req, t_q = pending[0]
@@ -722,6 +834,31 @@ class ServeLoop:
                         max_new=req.max_new_tokens)
             self._obs_queue.set(len(pending))
 
+        def drain(slot: int, emit_row) -> None:
+            """Feed a slot's newly visible tokens (column 0 = the
+            admission-deferred first token, then the segment's emits)
+            through the stop/budget rules; the first hit finalizes
+            BEFORE any frozen-row pad could be consumed, mirroring the
+            compiled freeze rule token for token."""
+            st = slot_state[slot]
+            row = [int(t) for t in emit_row]
+            if st["pending_first"]:
+                st["pending_first"] = False
+            else:
+                row = row[1:]               # column 0 is a stale first
+            for t in row:
+                st["tokens"].append(t)
+                if t in self._stop_set:
+                    finalize(slot, "stop")
+                    return
+                if len(st["tokens"]) >= st["req"].max_new_tokens:
+                    finalize(slot, "length")
+                    return
+
+        def busy_live() -> bool:
+            return any(st is not None and not st.get("zombie")
+                       for st in slot_state)
+
         def dispatch() -> None:
             """Chain one more segment on device and start its emits'
             async device→host copy — no host block."""
@@ -733,9 +870,12 @@ class ServeLoop:
                 # stamp the fresh table into the carry this segment
                 # consumes.  Lanes already frozen on device (host hasn't
                 # drained the stop yet) grow harmlessly within their
-                # reservation and refund it at finalize.
+                # reservation and refund it at finalize.  Zombie lanes
+                # are dead (their reservation was dropped at finalize);
+                # their held blocks just wait for the refund.
                 for slot in range(self.B):
-                    if slot_state[slot] is not None:
+                    st = slot_state[slot]
+                    if st is not None and not st.get("zombie"):
                         self.pool.grow(slot, self.steps)
                 self._stamp_table()
             # the segment splits per-step keys and returns the advanced
@@ -753,6 +893,9 @@ class ServeLoop:
             inflight.append((seq, emits))
             seq += 1
             self._obs_depth.set(len(inflight))
+            # fault harness: a configured kill-after-K-segments SIGKILLs
+            # here — mid-decode, with segments in flight
+            faults.on_segment()
 
         def drain_oldest() -> None:
             """Resolve the oldest in-flight segment: block on its fetch
@@ -761,33 +904,56 @@ class ServeLoop:
             its tokens."""
             s_idx, emits_dev = inflight.popleft()
             self._obs_depth.set(len(inflight))
-            if not any(st is not None and st["seq"] <= s_idx
-                       for st in slot_state):
-                return  # nothing mapped to this segment — skip the fetch
-            t0 = time.perf_counter()
-            emits = np.asarray(emits_dev)
-            self._obs_host_wait.record(time.perf_counter() - t0)
+            if any(st is not None and not st.get("zombie")
+                   and st["seq"] <= s_idx for st in slot_state):
+                t0 = time.perf_counter()
+                emits = np.asarray(emits_dev)
+                self._obs_host_wait.record(time.perf_counter() - t0)
+                for slot in range(self.B):
+                    st = slot_state[slot]
+                    if (st is not None and not st.get("zombie")
+                            and st["seq"] <= s_idx):
+                        drain(slot, emits[slot])
+            # zombie refund: every segment dispatched before the kill
+            # (index < free_at) has drained once s_idx reaches
+            # free_at - 1 — no stale merge can touch the blocks now
             for slot in range(self.B):
                 st = slot_state[slot]
-                if st is not None and st["seq"] <= s_idx:
-                    drain(slot, emits[slot])
+                if (st is not None and st.get("zombie")
+                        and s_idx >= st["free_at"] - 1):
+                    self.pool.free_slot(slot)
+                    slot_state[slot] = None
 
         # an unhandled exception mid-serve dumps the flight-recorder
         # bundle (admission ring, final snapshot) before propagating
         with obs.recorder.guard("serve_loop", num_slots=self.B,
                                 requests=len(requests),
                                 pipeline_depth=self.pipeline_depth):
+            intake(requests, strict=True)
             admit_free()
-            while pending or inflight or any(
-                    s is not None for s in slot_state):
-                if pending or any(s is not None for s in slot_state):
+            shed()
+            while True:
+                if not closed:
+                    batch = source()
+                    if batch is None:
+                        closed = True
+                    elif batch:
+                        intake(batch, strict=False)
+                        admit_free()
+                        shed()
+                expire_inflight()
+                if pending or busy_live():
                     dispatch()
                 # fetch when the pipeline is full — or when there is
                 # nothing left to dispatch and only fetches remain
                 while inflight and (
                         len(inflight) >= self.pipeline_depth
-                        or not (pending or any(
-                            s is not None for s in slot_state))):
+                        or not (pending or busy_live())):
                     drain_oldest()
                     admit_free()
+                if not (pending or inflight or any(
+                        st is not None for st in slot_state)):
+                    if closed:
+                        break
+                    time.sleep(idle_wait_s)
         return done

@@ -24,13 +24,29 @@ XLA collectives (SURVEY.md §2.2).
 from __future__ import annotations
 
 import ctypes
+import os
+import random
 import threading
 import time
 import zlib
 
 from tpudist import _native
+from tpudist.runtime import faults as _faults
 
 _VALUE_CAP = 1 << 20
+
+# obs handle cached lazily: coord is imported by lightweight workers and
+# must not pull the metrics registry (and its dependencies) at import
+_retries_counter = None
+
+
+def _obs_retries():
+    global _retries_counter
+    if _retries_counter is None:
+        from tpudist import obs
+
+        _retries_counter = obs.counter("coord/retries", unit="retries")
+    return _retries_counter
 
 
 class NativeUnavailable(RuntimeError):
@@ -71,12 +87,39 @@ class CoordServer:
 
 class CoordClient:
     """Client connection to a :class:`CoordServer` (possibly on another host;
-    numeric IPs and hostnames both resolve)."""
+    numeric IPs and hostnames both resolve).
+
+    Transient-fault policy — the split is by IDEMPOTENCY, not by verb
+    importance:
+
+    * ``get`` / ``keys`` / ``live`` / ``heartbeat`` retry transparently
+      on ``ConnectionError`` with bounded jittered exponential backoff
+      (``retries`` attempts beyond the first; ``TPUDIST_COORD_RETRIES``
+      overrides the default 2).  Re-running any of them is harmless: a
+      read re-reads, and a heartbeat lease refresh applied twice is one
+      refresh.  Each retry ticks the ``coord/retries`` counter so a
+      flaky control plane is visible before it becomes an outage.
+    * ``add``, ``barrier`` — and the writes ``set`` / ``delete`` /
+      ``wait`` — surface errors IMMEDIATELY.  They are not idempotent
+      (or their failure is not observable as such): an ``add`` whose
+      reply was lost may have been applied, so a blind client-side
+      replay could double-count a rank or double-arrive at a barrier —
+      exactly the split-brain the rendezvous layer exists to prevent.
+      Callers own the recovery semantics for those (e.g. a fresh
+      rendezvous round).
+
+    Fault injection (:mod:`tpudist.runtime.faults`) hooks every op, so
+    both halves of this contract are exercised deterministically in
+    tests."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout_ms: int = 10_000) -> None:
+                 timeout_ms: int = 10_000, retries: int | None = None,
+                 retry_base_s: float = 0.02) -> None:
         self._lib = _lib()
         self.host, self.port, self._timeout_ms = host, port, timeout_ms
+        self._retries = (int(os.environ.get("TPUDIST_COORD_RETRIES", "2"))
+                         if retries is None else int(retries))
+        self._retry_base_s = float(retry_base_s)
         self._h = self._lib.tcs_connect(host.encode(), port, timeout_ms)
         if not self._h:
             raise ConnectionError(f"could not reach coordination server {host}:{port}")
@@ -89,18 +132,41 @@ class CoordClient:
     def clone(self) -> "CoordClient":
         """A fresh connection to the same server (one request is in flight
         per connection, so background threads need their own)."""
-        return CoordClient(self.host, self.port, self._timeout_ms)
+        return CoordClient(self.host, self.port, self._timeout_ms,
+                           retries=self._retries,
+                           retry_base_s=self._retry_base_s)
+
+    def _retry(self, op: str, fn):
+        """Run ``fn`` with the idempotent-op retry schedule (see class
+        docstring).  Jittered exponential backoff: base × 2^attempt,
+        scaled by a uniform [0.5, 1.5) draw so a fleet of clients hit by
+        the same blip doesn't re-stampede the server in lockstep."""
+        delay = self._retry_base_s
+        for attempt in range(self._retries + 1):
+            try:
+                return fn()
+            except ConnectionError:
+                if attempt >= self._retries:
+                    raise
+                _obs_retries().inc()
+                time.sleep(delay * (0.5 + random.random()))
+                delay *= 2.0
 
     # -- kv ----------------------------------------------------------------
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):
             value = value.encode()
+        _faults.coord_op("set")
         with self._rpc_lock:
             if self._lib.tcs_set(self._h, key.encode(), value,
                                  len(value)) != 0:
                 raise ConnectionError("set failed")
 
     def get(self, key: str) -> bytes | None:
+        return self._retry("get", lambda: self._get_once(key))
+
+    def _get_once(self, key: str) -> bytes | None:
+        _faults.coord_op("get")
         cap = _VALUE_CAP
         while True:
             buf = ctypes.create_string_buffer(cap)
@@ -118,6 +184,9 @@ class CoordClient:
             return buf.raw[: out_len.value]
 
     def add(self, key: str, delta: int) -> int:
+        # NOT retried: a lost reply may still have incremented the
+        # counter server-side — see the class docstring
+        _faults.coord_op("add")
         with self._rpc_lock:
             v = self._lib.tcs_add(self._h, key.encode(), delta)
         if v == -(2**63):
@@ -125,6 +194,7 @@ class CoordClient:
         return int(v)
 
     def wait(self, key: str, timeout_s: float = 30.0) -> bool:
+        _faults.coord_op("wait")
         with self._rpc_lock:
             rc = self._lib.tcs_wait(self._h, key.encode(),
                                     int(timeout_s * 1000))
@@ -133,16 +203,21 @@ class CoordClient:
         return rc == 0
 
     def delete(self, key: str) -> None:
+        _faults.coord_op("delete")
         with self._rpc_lock:
             if self._lib.tcs_del(self._h, key.encode()) != 0:
                 raise ConnectionError("del failed")
 
     def keys(self, prefix: str = "") -> list[str]:
-        joined = self._joined(
-            lambda buf, cap, out: self._lib.tcs_keys(
-                self._h, prefix.encode(), buf, cap, out)
-        )
-        return joined.split(",") if joined else []
+        def once() -> list[str]:
+            _faults.coord_op("keys")
+            joined = self._joined(
+                lambda buf, cap, out: self._lib.tcs_keys(
+                    self._h, prefix.encode(), buf, cap, out)
+            )
+            return joined.split(",") if joined else []
+
+        return self._retry("keys", once)
 
     def _joined(self, call) -> str:
         cap = _VALUE_CAP
@@ -164,7 +239,12 @@ class CoordClient:
         False on timeout (the arrival is withdrawn server-side).
 
         Holds the connection's RPC lock for the whole wait — do not share
-        a client between a thread that barriers and one that polls."""
+        a client between a thread that barriers and one that polls.
+
+        NOT retried on error: a barrier arrival whose reply was lost may
+        still be counted server-side, and a client-side replay would
+        arrive twice — see the class docstring."""
+        _faults.coord_op("barrier")
         with self._rpc_lock:
             rc = self._lib.tcs_barrier(self._h, name.encode(), count,
                                        int(timeout_s * 1000))
@@ -174,17 +254,34 @@ class CoordClient:
 
     # -- liveness ----------------------------------------------------------
     def heartbeat(self, worker: str, ttl_s: float) -> None:
-        """Refresh ``worker``'s liveness lease; ``ttl_s <= 0`` leaves."""
-        with self._rpc_lock:
-            if self._lib.tcs_heartbeat(self._h, worker.encode(),
-                                       int(ttl_s * 1000)) != 0:
-                raise ConnectionError("heartbeat failed")
+        """Refresh ``worker``'s liveness lease; ``ttl_s <= 0`` leaves.
+        Idempotent (a lease refreshed twice is one refresh), so transient
+        errors retry."""
+        if _faults.drop_heartbeat():
+            # injected heartbeat loss: the refresh silently never leaves
+            # this process — the TTL plane will declare us dead while we
+            # keep running (the false-positive a router must survive)
+            return
+
+        def once() -> None:
+            _faults.coord_op("heartbeat")
+            with self._rpc_lock:
+                if self._lib.tcs_heartbeat(self._h, worker.encode(),
+                                           int(ttl_s * 1000)) != 0:
+                    raise ConnectionError("heartbeat failed")
+
+        self._retry("heartbeat", once)
 
     def live(self) -> set[str]:
-        joined = self._joined(
-            lambda buf, cap, out: self._lib.tcs_live(self._h, buf, cap, out)
-        )
-        return set(joined.split(",")) if joined else set()
+        def once() -> set[str]:
+            _faults.coord_op("live")
+            joined = self._joined(
+                lambda buf, cap, out: self._lib.tcs_live(
+                    self._h, buf, cap, out)
+            )
+            return set(joined.split(",")) if joined else set()
+
+        return self._retry("live", once)
 
     def close(self) -> None:
         if self._h:

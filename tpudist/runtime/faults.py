@@ -1,0 +1,213 @@
+"""Deterministic fault injection for the coordination and serve planes.
+
+Robustness code that is only exercised by real hardware failures is
+robustness code that has never run.  This module is the harness that
+makes the failure paths *testable*: a process-wide :class:`FaultPlan`,
+parsed once from ``TPUDIST_FAULT_*`` environment variables, whose hooks
+are threaded through the hot points where production faults actually
+land —
+
+* :meth:`FaultPlan.coord_op` — called by every
+  :class:`~tpudist.runtime.coord.CoordClient` RPC; injects a
+  :class:`FaultInjected` (a ``ConnectionError`` subclass, so the
+  production retry/error paths handle it exactly like a dropped TCP
+  connection) with probability ``coord_error_p`` and/or a ``coord_delay_s``
+  stall with probability ``coord_delay_p``;
+* :meth:`FaultPlan.drop_heartbeat` — consulted by
+  ``CoordClient.heartbeat``; once process uptime passes
+  ``heartbeat_stop_after_s`` every lease refresh is silently swallowed,
+  so the worker *looks* dead to the TTL plane while actually running
+  (the false-positive case a router must survive);
+* :meth:`FaultPlan.on_segment` — called by the serve loop after each
+  dispatched decode segment; after ``kill_after_segments`` dispatches the
+  process SIGKILLs *itself* — an uncatchable death mid-decode, the
+  harshest replica-loss shape.
+
+Determinism: the probabilistic knobs draw from one ``random.Random``
+seeded by ``TPUDIST_FAULT_SEED`` (default 0), so a failing CI run
+replays bit-identically.  With no ``TPUDIST_FAULT_*`` variable set the
+plan is inert and every hook is a near-free early return — production
+code pays one attribute check.
+
+Environment knobs (all optional):
+
+==================================  =========================================
+``TPUDIST_FAULT_COORD_ERROR_P``     probability a coord RPC raises
+                                    :class:`FaultInjected` before running
+``TPUDIST_FAULT_COORD_DELAY_P``     probability a coord RPC sleeps first
+``TPUDIST_FAULT_COORD_DELAY_S``     the injected sleep (default 0.05 s)
+``TPUDIST_FAULT_HEARTBEAT_STOP_AFTER_S``
+                                    drop all heartbeats once process uptime
+                                    exceeds this many seconds
+``TPUDIST_FAULT_KILL_AFTER_SEGMENTS``
+                                    SIGKILL self after this many dispatched
+                                    serve segments
+``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
+==================================  =========================================
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+
+__all__ = ["FaultInjected", "FaultPlan", "plan", "install", "reset",
+           "coord_op", "drop_heartbeat", "on_segment"]
+
+ENV_PREFIX = "TPUDIST_FAULT_"
+
+
+class FaultInjected(ConnectionError):
+    """An injected coordination-plane failure.  Subclasses
+    ``ConnectionError`` so production error handling (CoordClient's
+    idempotent-op retry, callers' except clauses) treats it exactly like
+    a real dropped connection."""
+
+
+def _env_float(environ, name: str) -> float | None:
+    raw = environ.get(ENV_PREFIX + name)
+    if raw is None or raw.strip() == "":
+        return None
+    return float(raw)
+
+
+class FaultPlan:
+    """One process's fault schedule.  Thread-safe: the serve loop, the
+    heartbeat daemon, and collective workers all consult the same plan."""
+
+    def __init__(
+        self,
+        coord_error_p: float = 0.0,
+        coord_delay_p: float = 0.0,
+        coord_delay_s: float = 0.05,
+        heartbeat_stop_after_s: float | None = None,
+        kill_after_segments: int | None = None,
+        seed: int = 0,
+    ) -> None:
+        if not 0.0 <= coord_error_p <= 1.0:
+            raise ValueError(
+                f"coord_error_p must be in [0, 1], got {coord_error_p}")
+        if not 0.0 <= coord_delay_p <= 1.0:
+            raise ValueError(
+                f"coord_delay_p must be in [0, 1], got {coord_delay_p}")
+        self.coord_error_p = float(coord_error_p)
+        self.coord_delay_p = float(coord_delay_p)
+        self.coord_delay_s = float(coord_delay_s)
+        self.heartbeat_stop_after_s = heartbeat_stop_after_s
+        self.kill_after_segments = (None if kill_after_segments is None
+                                    else int(kill_after_segments))
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._segments = 0
+        self._born = time.monotonic()
+        # per-kind injection tallies, inspectable by tests
+        self.injected = {"coord_error": 0, "coord_delay": 0,
+                         "heartbeat_drop": 0}
+        self.active = bool(coord_error_p or coord_delay_p
+                           or heartbeat_stop_after_s is not None
+                           or kill_after_segments is not None)
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan":
+        env = os.environ if environ is None else environ
+        kill = _env_float(env, "KILL_AFTER_SEGMENTS")
+        hb = _env_float(env, "HEARTBEAT_STOP_AFTER_S")
+        return cls(
+            coord_error_p=_env_float(env, "COORD_ERROR_P") or 0.0,
+            coord_delay_p=_env_float(env, "COORD_DELAY_P") or 0.0,
+            coord_delay_s=(_env_float(env, "COORD_DELAY_S")
+                           if _env_float(env, "COORD_DELAY_S") is not None
+                           else 0.05),
+            heartbeat_stop_after_s=hb,
+            kill_after_segments=None if kill is None else int(kill),
+            seed=int(_env_float(env, "SEED") or 0),
+        )
+
+    # -- hooks -------------------------------------------------------------
+
+    def coord_op(self, op: str) -> None:
+        """Maybe delay, maybe raise — called before every coord RPC."""
+        if not (self.coord_error_p or self.coord_delay_p):
+            return
+        with self._lock:
+            delay = (self.coord_delay_p
+                     and self._rng.random() < self.coord_delay_p)
+            error = (self.coord_error_p
+                     and self._rng.random() < self.coord_error_p)
+            if delay:
+                self.injected["coord_delay"] += 1
+            if error:
+                self.injected["coord_error"] += 1
+        if delay:
+            time.sleep(self.coord_delay_s)
+        if error:
+            raise FaultInjected(f"injected fault: coord {op}")
+
+    def drop_heartbeat(self) -> bool:
+        """True when this process's heartbeats should be swallowed."""
+        if self.heartbeat_stop_after_s is None:
+            return False
+        if time.monotonic() - self._born < self.heartbeat_stop_after_s:
+            return False
+        with self._lock:
+            self.injected["heartbeat_drop"] += 1
+        return True
+
+    def on_segment(self) -> None:
+        """Count one dispatched serve segment; SIGKILL self at the
+        configured count.  SIGKILL (not sys.exit) on purpose: no atexit,
+        no finally blocks, no graceful heartbeat leave — the process
+        simply vanishes mid-decode, as a torn pod does."""
+        if self.kill_after_segments is None:
+            return
+        with self._lock:
+            self._segments += 1
+            n = self._segments
+        if n >= self.kill_after_segments:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+_INERT = FaultPlan()
+_plan: FaultPlan | None = None
+
+
+def plan() -> FaultPlan:
+    """The process-wide plan, parsed from the environment on first use."""
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan.from_env()
+    return _plan
+
+
+def install(new_plan: FaultPlan | None) -> None:
+    """Replace the process-wide plan (tests); ``None`` re-reads the
+    environment on next use."""
+    global _plan
+    _plan = new_plan
+
+
+def reset() -> None:
+    install(None)
+
+
+# module-level conveniences: the hot-path call sites use these so the
+# inert case is one global load + one attribute check
+def coord_op(op: str) -> None:
+    p = plan()
+    if p.active:
+        p.coord_op(op)
+
+
+def drop_heartbeat() -> bool:
+    p = plan()
+    return p.active and p.drop_heartbeat()
+
+
+def on_segment() -> None:
+    p = plan()
+    if p.active:
+        p.on_segment()

@@ -1,0 +1,711 @@
+"""Fault-tolerant serve fleet: a router tier over N ``ServeLoop`` replicas.
+
+ROADMAP item 4's millions-of-users shape: capacity and availability come
+from REPLICAS behind a router, not from one bigger loop.  Everything
+rides the coordination planes that already exist — no new transport:
+
+* **Liveness** — each replica holds a TTL heartbeat lease
+  (``{ns}:{rid}`` via :class:`~tpudist.runtime.coord.ElasticMonitor`);
+  the router's death signal is the lease expiring, exactly the signal
+  elastic training uses.
+* **Load** — each replica publishes its metric snapshot
+  (:class:`~tpudist.obs.aggregate.MetricsPublisher` under
+  ``{ns}/metrics``); the router admits least-loaded from the published
+  ``serve/kv_blocks_free`` / ``serve/queue_depth`` gauges and the
+  ``serve/queue_wait_s`` histogram, cross-checked by a
+  :class:`~tpudist.obs.health.HealthMonitor` over the same snapshots
+  (a replica whose publisher went quiet is excluded before its
+  heartbeat ever lapses).
+* **Requests** — the router writes each admitted request to the chosen
+  replica's inbox (``{ns}/inbox/{rid}/{key}``); the replica's
+  :class:`ReplicaWorker` feeds them to ``ServeLoop.run``'s service mode
+  and writes each completion to ``{ns}/done/{key}``.
+
+Failure model (the robustness core):
+
+* **Death detection** — a replica absent from ``live()`` (TTL lapsed,
+  e.g. SIGKILL or an injected heartbeat drop) or classified ``lost`` by
+  the health monitor is dead to the router.
+* **Drain + redispatch** — the dead replica's inbox is swept and every
+  request assigned to it (picked up or not) is re-enqueued and
+  dispatched to a survivor.  Redispatched requests restart from the
+  prompt; greedy decoding over identical replica weights makes the
+  redispatched output token-identical to an uninterrupted run.
+* **Exactly-once completion** — the router consumes ``done`` keys
+  (get + delete) keyed by its own request id and returns the FIRST
+  completion per request; a false-positive death (replica alive but
+  presumed dead, e.g. dropped heartbeats) can produce a duplicate done
+  write, but under greedy determinism the duplicate is byte-identical
+  and is simply deleted.  Every admitted request returns exactly one
+  :class:`~tpudist.models.serving.Completion`.
+* **Bounded time** — :meth:`Router.run` raises :class:`TimeoutError`
+  at its ``timeout_s`` bound instead of hanging when no capacity
+  remains (every replica dead).
+
+Replica-side load shedding composes with routing: a replica that sheds
+(``reason="rejected"``, ``serve/rejected`` counter) gets its requests
+re-routed and is put on a short admission backoff instead of being
+hammered while saturated.
+
+The fault-injection harness (:mod:`tpudist.runtime.faults`,
+``TPUDIST_FAULT_*``) exercises all of this deterministically: coord-op
+errors/delays hit the retry paths, ``KILL_AFTER_SEGMENTS`` SIGKILLs a
+replica mid-decode, ``HEARTBEAT_STOP_AFTER_S`` fakes death without
+stopping the worker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Sequence
+
+import numpy as np
+
+from tpudist import obs
+from tpudist.obs.aggregate import collect, MetricsPublisher
+from tpudist.obs.health import HealthMonitor
+from tpudist.runtime.coord import CoordClient, ElasticMonitor
+from tpudist.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+__all__ = ["Router", "ReplicaWorker", "build_tiny_lm",
+           "launch_local_fleet", "stop_fleet", "exit_reports",
+           "wait_live"]
+
+DEFAULT_NAMESPACE = "fleet"
+
+
+# -- wire format (JSON over the KV store) ---------------------------------
+
+def _encode_request(key: str, req) -> bytes:
+    return json.dumps({
+        "key": key,
+        "prompt": np.asarray(req.prompt).astype(int).tolist(),
+        "max_new_tokens": int(req.max_new_tokens),
+        "deadline_s": req.deadline_s,
+    }).encode()
+
+
+def _decode_request(raw: bytes):
+    from tpudist.models.serving import Request
+
+    d = json.loads(raw.decode())
+    return Request(prompt=np.asarray(d["prompt"], np.int32),
+                   max_new_tokens=int(d["max_new_tokens"]),
+                   rid=d["key"], deadline_s=d.get("deadline_s"))
+
+
+def _encode_completion(replica_id: str, comp) -> bytes:
+    return json.dumps({
+        "key": comp.rid,
+        "tokens": np.asarray(comp.tokens).astype(int).tolist(),
+        "reason": comp.reason,
+        "replica": replica_id,
+    }).encode()
+
+
+# -- the replica side ------------------------------------------------------
+
+class ReplicaWorker:
+    """One serve replica: a ``ServeLoop`` in service mode, wired to the
+    fleet's coordination planes.
+
+    Lifecycle: :meth:`serve` registers the replica
+    (``{ns}/replica/{rid}``), starts the TTL heartbeat and the metrics
+    publisher, then blocks in ``loop.run(source=..., sink=...)`` — the
+    source polls the inbox (FIFO by key) and watches the stop keys
+    (``{ns}/stop`` fleet-wide, ``{ns}/stop/{rid}`` targeted); the sink
+    commits each completion to ``{ns}/done/{key}``.  On a clean exit an
+    exit report (``{ns}/exit/{rid}``: served count, pool-drained flag)
+    lets cross-process tests assert the no-orphaned-blocks invariant.
+    """
+
+    def __init__(self, loop, client: CoordClient, replica_id: str, *,
+                 rank: int = 0, namespace: str = DEFAULT_NAMESPACE,
+                 ttl_s: float = 2.0, publish_interval_s: float = 0.25,
+                 idle_wait_s: float = 0.01) -> None:
+        self.loop = loop
+        self.client = client
+        self.replica_id = replica_id
+        self.rank = int(rank)
+        self.ns = namespace
+        self.ttl_s = float(ttl_s)
+        self.idle_wait_s = idle_wait_s
+        self._inbox = f"{namespace}/inbox/{replica_id}/"
+        self._served = 0
+        self._hb = ElasticMonitor(client, f"{namespace}:{replica_id}",
+                                  ttl_s=ttl_s,
+                                  interval_s=max(ttl_s / 4, 0.05))
+        self._pub = MetricsPublisher(client, self.rank, obs.registry,
+                                     namespace=f"{namespace}/metrics",
+                                     interval_s=publish_interval_s)
+
+    def register(self) -> None:
+        info = {
+            "replica_id": self.replica_id,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "num_slots": self.loop.B,
+            "cache_layout": self.loop.cache_layout,
+            "kv_num_blocks": self.loop.kv_num_blocks or None,
+            "kv_block_size": self.loop.kv_block_size or None,
+            "ttl_s": self.ttl_s,
+        }
+        self.client.set(f"{self.ns}/replica/{self.replica_id}",
+                        json.dumps(info).encode())
+
+    def _source(self):
+        """One intake poll: ``None`` on a stop key (close and drain),
+        else the inbox's requests in key order (the router's dispatch
+        order — its keys are zero-padded sequence numbers)."""
+        if (self.client.get(f"{self.ns}/stop") is not None
+                or self.client.get(
+                    f"{self.ns}/stop/{self.replica_id}") is not None):
+            return None
+        out = []
+        for key in sorted(self.client.keys(self._inbox)):
+            raw = self.client.get(key)
+            self.client.delete(key)
+            if raw is None:   # racing a router sweep of a presumed death
+                continue
+            try:
+                out.append(_decode_request(raw))
+            except (ValueError, KeyError) as e:
+                log.warning("replica %s: dropping undecodable request "
+                            "%s: %s", self.replica_id, key, e)
+        return out
+
+    def _sink(self, comp) -> None:
+        """Commit one completion.  This write is the commit point of the
+        exactly-once contract: a replica that dies before it leaves no
+        trace, and the router redispatches."""
+        self.client.set(f"{self.ns}/done/{comp.rid}",
+                        _encode_completion(self.replica_id, comp))
+        self._served += 1
+
+    def pool_drained(self) -> bool | None:
+        pool = self.loop.pool
+        if pool is None:
+            return None
+        pool.check()
+        return pool.free_blocks == pool.num_blocks
+
+    def serve(self) -> None:
+        self.register()
+        self._hb.start(0)
+        self._pub.start()
+        self._pub.publish()   # immediate: the router gates on load info
+        clean = False
+        try:
+            self.loop.run((), source=self._source, sink=self._sink,
+                          idle_wait_s=self.idle_wait_s)
+            clean = True
+        finally:
+            try:
+                self.client.set(
+                    f"{self.ns}/exit/{self.replica_id}",
+                    json.dumps({"replica": self.replica_id,
+                                "served": self._served,
+                                "pool_drained": self.pool_drained(),
+                                "clean": clean}).encode())
+            except Exception:
+                pass
+            self._pub.stop(final_publish=True)
+            self._hb.stop(graceful=True)
+
+
+# -- the router side -------------------------------------------------------
+
+class Router:
+    """Health-aware least-loaded request router over the fleet namespace.
+
+    See the module docstring for the failure model.  One ``Router``
+    instance serialises one stream of requests; run several routers on
+    disjoint namespaces for more.
+
+    Args:
+      client: coord client (the router's own; not shared with threads).
+      namespace: fleet namespace prefix in the KV store.
+      poll_s: idle poll interval of :meth:`run`'s event loop.
+      max_redispatch: death-redispatches per request before it completes
+        with ``reason="failed"`` (rejection re-routes are not counted —
+        they are bounded by ``timeout_s``, not by attempts).
+      reject_backoff_s: admission backoff applied to a replica whose
+        published ``serve/rejected`` counter grew (it is shedding load).
+      stale_after_s / lost_after_s: publish-age bounds handed to the
+        health monitor (scaled for serve cadence, not training's).
+    """
+
+    def __init__(self, client: CoordClient, *,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 poll_s: float = 0.02,
+                 max_redispatch: int = 8,
+                 reject_backoff_s: float = 0.25,
+                 stale_after_s: float = 3.0,
+                 lost_after_s: float = 10.0,
+                 use_health: bool = True) -> None:
+        self.client = client
+        self.ns = namespace
+        self.poll_s = float(poll_s)
+        self.max_redispatch = int(max_redispatch)
+        self.reject_backoff_s = float(reject_backoff_s)
+        self._health = (HealthMonitor(
+            client=client, namespace=f"{namespace}/metrics",
+            signal="serve/queue_wait_s", skew_threshold=4.0,
+            stale_after_s=stale_after_s, lost_after_s=lost_after_s,
+            confirm_n=2, recover_n=1) if use_health else None)
+        self._seq = 0
+        self._dead: set[str] = set()
+        self._backoff: dict[str, float] = {}           # rid -> until (mono)
+        self._rejected_seen: dict[str, float] = {}     # rid -> watermark
+        self._obs_requests = obs.counter("router/requests", unit="reqs")
+        self._obs_dispatched = obs.counter("router/dispatched", unit="reqs")
+        self._obs_completions = obs.counter("router/completions",
+                                            unit="reqs")
+        self._obs_redispatched = obs.counter("router/redispatched",
+                                             unit="reqs")
+        self._obs_rerouted = obs.counter("router/rejected_rerouted",
+                                         unit="reqs")
+        self._obs_deaths = obs.counter("router/replica_deaths",
+                                       unit="replicas")
+        self._obs_live = obs.gauge("router/replicas_live", unit="replicas")
+        self._obs_outstanding = obs.gauge("router/outstanding", unit="reqs")
+
+    # -- fleet view --------------------------------------------------------
+
+    def replicas(self) -> dict[str, dict]:
+        """Registered replicas: ``{replica_id: registration info}``."""
+        out = {}
+        prefix = f"{self.ns}/replica/"
+        for key in self.client.keys(prefix):
+            raw = self.client.get(key)
+            if raw is not None:
+                out[key[len(prefix):]] = json.loads(raw.decode())
+        return out
+
+    def live(self) -> set[str]:
+        """Replica ids currently holding a heartbeat lease."""
+        mark = f"{self.ns}:"
+        return {name[len(mark):] for name in self.client.live()
+                if name.startswith(mark)}
+
+    def loads(self, regs: dict[str, dict]) -> dict[str, dict]:
+        """Published load per replica id: queue depth + free KV blocks
+        gauges and the lifetime queue-wait mean."""
+        rank_to_rid = {int(info.get("rank", -1)): rid
+                       for rid, info in regs.items()}
+        out: dict[str, dict] = {}
+        for rank, snap in collect(self.client,
+                                  f"{self.ns}/metrics").items():
+            rid = rank_to_rid.get(rank)
+            if rid is None:
+                continue
+            gauges = snap.get("gauges", {})
+            counters = snap.get("counters", {})
+            wait = snap.get("histograms", {}).get("serve/queue_wait_s")
+            out[rid] = {
+                "queue_depth": (gauges.get("serve/queue_depth")
+                                or {}).get("value") or 0.0,
+                "kv_blocks_free": (gauges.get("serve/kv_blocks_free")
+                                   or {}).get("value"),
+                "queue_wait_mean": (wait["sum"] / wait["count"]
+                                    if wait and wait["count"] else 0.0),
+                "rejected": (counters.get("serve/rejected")
+                             or {}).get("value") or 0.0,
+                "age_s": snap.get("age_s"),
+            }
+        return out
+
+    def _update_backoffs(self, loads: dict[str, dict]) -> None:
+        """A replica whose ``serve/rejected`` counter grew is shedding:
+        pause new admissions to it briefly instead of feeding the shed."""
+        now = time.monotonic()
+        for rid, l in loads.items():
+            seen = self._rejected_seen.get(rid, 0.0)
+            if l["rejected"] > seen:
+                self._backoff[rid] = now + self.reject_backoff_s
+            self._rejected_seen[rid] = l["rejected"]
+
+    def _pick(self, candidates: Sequence[str], loads: dict[str, dict],
+              assigned: dict[str, int]) -> str | None:
+        """Least-loaded: fewest known-outstanding work first (the
+        router's own assignments are fresher than any published gauge),
+        then shortest published queue wait, then most free KV blocks
+        (a dense replica has no block limit and sorts as infinite)."""
+        best, best_score = None, None
+        for rid in candidates:
+            l = loads.get(rid, {})
+            free = l.get("kv_blocks_free")
+            score = (
+                assigned.get(rid, 0) + l.get("queue_depth", 0.0),
+                l.get("queue_wait_mean", 0.0),
+                -(free if free is not None else float("inf")),
+            )
+            if best_score is None or score < best_score:
+                best, best_score = rid, score
+        return best
+
+    def _sweep_dead(self, rid: str, regs: dict[str, dict]) -> None:
+        """Remove a dead replica's coordination residue so restarted
+        ids and fresh health rounds start clean."""
+        for key in self.client.keys(f"{self.ns}/inbox/{rid}/"):
+            try:
+                self.client.delete(key)
+            except ConnectionError:
+                pass
+        for key in (f"{self.ns}/replica/{rid}",
+                    f"{self.ns}/metrics/{regs.get(rid, {}).get('rank')}"):
+            try:
+                self.client.delete(key)
+            except ConnectionError:
+                pass
+
+    # -- the event loop ----------------------------------------------------
+
+    def run(self, requests: Sequence[Any], *,
+            timeout_s: float = 120.0) -> list[Any]:
+        """Route ``requests`` across the fleet; returns one
+        :class:`~tpudist.models.serving.Completion` per request, in
+        FINISH order, with each completion's ``rid`` restored to the
+        caller's.  Raises :class:`TimeoutError` after ``timeout_s`` —
+        the no-hang bound for total-fleet loss."""
+        from tpudist.models.serving import Completion
+
+        entries: dict[str, dict] = {}
+        order: list[str] = []
+        for req in requests:
+            key = f"{self._seq:08d}"
+            self._seq += 1
+            entries[key] = {"req": req, "assigned": None, "attempts": 0}
+            order.append(key)
+        self._obs_requests.inc(len(order))
+        done: dict[str, Completion] = {}
+        finish: list[str] = []
+
+        def complete(key: str, comp: Completion) -> None:
+            done[key] = comp
+            finish.append(key)
+            self._obs_completions.inc()
+
+        deadline = time.monotonic() + timeout_s
+        while len(done) < len(entries):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"router: {len(entries) - len(done)} of "
+                    f"{len(entries)} requests unresolved after "
+                    f"{timeout_s:.0f}s (live replicas: "
+                    f"{sorted(self.live())})")
+            progressed = self._poll(entries, done, complete)
+            self._obs_outstanding.set(len(entries) - len(done))
+            if not progressed:
+                time.sleep(self.poll_s)
+        # sweep duplicate done keys (a presumed-dead replica may have
+        # committed after its redispatch; greedy determinism makes the
+        # duplicate identical, so it is just deleted)
+        for key in entries:
+            try:
+                self.client.delete(f"{self.ns}/done/{key}")
+            except ConnectionError:
+                pass
+        self._obs_outstanding.set(0)
+        return [done[k] for k in finish]
+
+    def _poll(self, entries: dict[str, dict], done: dict,
+              complete) -> bool:
+        from tpudist.models.serving import Completion
+
+        progressed = False
+        regs = self.replicas()
+        live = self.live() - self._dead
+        self._obs_live.set(len(live))
+
+        # 1) consume completions FIRST: work a replica committed just
+        # before dying must not be re-run
+        done_prefix = f"{self.ns}/done/"
+        for key in self.client.keys(done_prefix):
+            k = key[len(done_prefix):]
+            e = entries.get(k)
+            if e is None or k in done:
+                continue
+            raw = self.client.get(key)
+            if raw is None:
+                continue
+            self.client.delete(key)
+            payload = json.loads(raw.decode())
+            req = e["req"]
+            comp = Completion(
+                rid=req.rid, prompt=np.asarray(req.prompt),
+                tokens=np.asarray(payload["tokens"], np.int32),
+                reason=payload["reason"])
+            progressed = True
+            if comp.reason == "rejected":
+                # replica-side load shed: re-route, don't surface —
+                # the request was admitted to the FLEET, and some other
+                # replica (or this one, later) can still serve it
+                e["assigned"] = None
+                self._obs_rerouted.inc()
+                self._backoff[payload.get("replica", "")] = (
+                    time.monotonic() + self.reject_backoff_s)
+            else:
+                complete(k, comp)
+
+        # 2) death detection + drain/redispatch
+        verdict_lost: set[str] = set()
+        if self._health is not None:
+            try:
+                self._health.update()
+                rank_to_rid = {int(info.get("rank", -1)): rid
+                               for rid, info in regs.items()}
+                verdict_lost = {
+                    rank_to_rid[int(r)]
+                    for r in self._health.verdict().get("lost", [])
+                    if int(r) in rank_to_rid}
+            except (ConnectionError, ValueError):
+                pass
+        assigned_to = {e["assigned"] for e in entries.values()
+                       if e["assigned"] is not None}
+        for rid in sorted(assigned_to):
+            if rid in live and rid not in verdict_lost:
+                continue
+            # dead: lease lapsed (SIGKILL, heartbeat drop) or publisher
+            # lost.  Drain its inbox, redispatch its outstanding.
+            self._dead.add(rid)
+            live.discard(rid)
+            self._obs_deaths.inc()
+            log.warning("router: replica %s presumed dead; "
+                        "redispatching its outstanding requests", rid)
+            self._sweep_dead(rid, regs)
+            for k, e in entries.items():
+                if k in done or e["assigned"] != rid:
+                    continue
+                e["assigned"] = None
+                e["attempts"] += 1
+                progressed = True
+                self._obs_redispatched.inc()
+                if e["attempts"] > self.max_redispatch:
+                    req = e["req"]
+                    complete(k, Completion(
+                        rid=req.rid, prompt=np.asarray(req.prompt),
+                        tokens=np.zeros((0,), np.int32),
+                        reason="failed"))
+
+        # 3) dispatch unassigned requests least-loaded
+        now = time.monotonic()
+        self._backoff = {r: t for r, t in self._backoff.items() if t > now}
+        loads = self.loads(regs)
+        self._update_backoffs(loads)
+        unhealthy: set[str] = set()
+        if self._health is not None:
+            v = self._health.verdict()
+            rank_to_rid = {int(info.get("rank", -1)): rid
+                           for rid, info in regs.items()}
+            for r in v.get("stale", []) + v.get("lost", []):
+                rid = rank_to_rid.get(int(r))
+                if rid is not None:
+                    unhealthy.add(rid)
+        candidates = [rid for rid in sorted(live)
+                      if rid not in self._backoff
+                      and rid not in unhealthy]
+        if candidates:
+            assigned_counts: dict[str, int] = {}
+            for e in entries.values():
+                if e["assigned"] is not None:
+                    assigned_counts[e["assigned"]] = (
+                        assigned_counts.get(e["assigned"], 0) + 1)
+            wall = time.time()
+            for k, e in entries.items():
+                if k in done or e["assigned"] is not None:
+                    continue
+                req = e["req"]
+                if req.deadline_s is not None and wall > req.deadline_s:
+                    complete(k, Completion(
+                        rid=req.rid, prompt=np.asarray(req.prompt),
+                        tokens=np.zeros((0,), np.int32), reason="timeout"))
+                    progressed = True
+                    continue
+                rid = self._pick(candidates, loads, assigned_counts)
+                if rid is None:
+                    break
+                self.client.set(f"{self.ns}/inbox/{rid}/{k}",
+                                _encode_request(k, req))
+                e["assigned"] = rid
+                assigned_counts[rid] = assigned_counts.get(rid, 0) + 1
+                progressed = True
+                self._obs_dispatched.inc()
+        return progressed
+
+
+# -- fleet process helpers (tests, bench, example, CI) ---------------------
+
+def build_tiny_lm(vocab: int = 64, layers: int = 2, heads: int = 4,
+                  kv_heads: int = 2, embed: int = 64, seq_len: int = 96,
+                  seed: int = 0):
+    """The fleet's shared tiny model: every replica (and the reference
+    single loop) builds IDENTICAL weights from the same seed, which is
+    what makes redispatched greedy output exact-match verifiable."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=heads, num_kv_heads=kv_heads,
+                            embed_dim=embed, max_seq_len=seq_len)
+    params = TransformerLM(cfg).init(
+        jax.random.key(seed), jnp.zeros((1, 2), jnp.int32))["params"]
+    return cfg, params
+
+
+def launch_local_fleet(coord_addr: str, n: int, *,
+                       namespace: str = DEFAULT_NAMESPACE,
+                       replica_args: Sequence[str] = (),
+                       env_overrides: dict[int, dict] | None = None,
+                       platform: str = "cpu") -> list[subprocess.Popen]:
+    """Spawn ``n`` replica worker subprocesses on this host (tests,
+    bench, CI, the example).  ``env_overrides[i]`` adds env vars to
+    replica ``i`` — the fault-injection knobs go in this way, so a kill
+    schedule hits exactly the replica the scenario names."""
+    host, port = coord_addr.rsplit(":", 1)
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                          else []))
+        env.setdefault("JAX_PLATFORMS", platform)
+        env.update({k: str(v) for k, v in
+                    (env_overrides or {}).get(i, {}).items()})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpudist.runtime.router",
+             "--coord", f"{host}:{port}", "--replica-id", f"r{i}",
+             "--rank", str(i), "--namespace", namespace,
+             *replica_args],
+            env=env))
+    return procs
+
+
+def stop_fleet(client: CoordClient, procs: Sequence[subprocess.Popen], *,
+               namespace: str = DEFAULT_NAMESPACE,
+               timeout_s: float = 30.0) -> None:
+    """Set the fleet-wide stop key and reap the worker processes."""
+    try:
+        client.set(f"{namespace}/stop", b"1")
+    except ConnectionError:
+        pass
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+def wait_live(client: CoordClient, n: int, *,
+              namespace: str = DEFAULT_NAMESPACE,
+              timeout_s: float = 60.0) -> set[str]:
+    """Block until ``n`` replicas hold heartbeat leases (fleet warm-up:
+    replica startup is jax import + model compile, and routing before
+    the fleet assembles concentrates all early requests on whichever
+    replica won the race).  Returns the live replica-id set."""
+    mark = f"{namespace}:"
+    deadline = time.monotonic() + timeout_s
+    while True:
+        live = {name[len(mark):] for name in client.live()
+                if name.startswith(mark)}
+        if len(live) >= n:
+            return live
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"fleet: only {sorted(live)} of {n} replicas live "
+                f"after {timeout_s:.0f}s")
+        time.sleep(0.1)
+
+
+def exit_reports(client: CoordClient, *,
+                 namespace: str = DEFAULT_NAMESPACE) -> dict[str, dict]:
+    """Clean-exit reports by replica id (a SIGKILLed replica leaves
+    none — that absence is itself the assertion)."""
+    out = {}
+    prefix = f"{namespace}/exit/"
+    for key in client.keys(prefix):
+        raw = client.get(key)
+        if raw is not None:
+            out[key[len(prefix):]] = json.loads(raw.decode())
+    return out
+
+
+# -- replica CLI -----------------------------------------------------------
+
+def main() -> None:  # pragma: no cover - subprocess entry point
+    """Run one serve replica: ``python -m tpudist.runtime.router --coord
+    HOST:PORT --replica-id r0 --rank 0 [model/serve args]``.
+
+    Builds the deterministic tiny LM (same ``--seed`` across the fleet
+    => identical weights => redispatch exact-match) and serves until a
+    stop key appears.  The fault-injection env (``TPUDIST_FAULT_*``) is
+    read by the hooks already threaded through CoordClient/ServeLoop —
+    nothing to wire here."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="tpudist serve replica")
+    ap.add_argument("--coord", required=True, help="coord server host:port")
+    ap.add_argument("--replica-id", required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    ap.add_argument("--ttl", type=float, default=2.0)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=96)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--steps-per-sync", type=int, default=4)
+    ap.add_argument("--prefill-chunk", type=int, default=8)
+    ap.add_argument("--stop-tokens", default="",
+                    help="comma-separated stop token ids")
+    ap.add_argument("--cache-layout", default="dense",
+                    choices=["dense", "paged"])
+    ap.add_argument("--kv-block-size", type=int, default=16)
+    ap.add_argument("--kv-num-blocks", type=int, default=0,
+                    help="0 = dense-capacity default")
+    ap.add_argument("--max-queue", type=int, default=-1,
+                    help="-1 = unbounded")
+    args = ap.parse_args()
+
+    from tpudist.models.serving import ServeLoop
+
+    cfg, params = build_tiny_lm(args.vocab, args.layers, args.heads,
+                                args.kv_heads, args.embed, args.seq_len,
+                                args.seed)
+    stop = ([int(t) for t in args.stop_tokens.split(",") if t.strip()]
+            or None)
+    loop = ServeLoop(
+        cfg, params, num_slots=args.slots,
+        steps_per_sync=args.steps_per_sync,
+        prefill_chunk=args.prefill_chunk, stop_tokens=stop,
+        cache_layout=args.cache_layout,
+        kv_block_size=args.kv_block_size,
+        kv_num_blocks=args.kv_num_blocks or None,
+        max_queue=None if args.max_queue < 0 else args.max_queue)
+    host, port = args.coord.rsplit(":", 1)
+    client = CoordClient(host, int(port))
+    worker = ReplicaWorker(loop, client, args.replica_id,
+                           rank=args.rank, namespace=args.namespace,
+                           ttl_s=args.ttl)
+    log.info("replica %s (rank %d) serving on %s", args.replica_id,
+             args.rank, args.namespace)
+    worker.serve()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
