@@ -1860,12 +1860,16 @@ def bench_serve_fleet(on_tpu: bool) -> None:
     admitted request returns a Completion), ``redispatched`` /
     ``replica_deaths`` (from the router counters), ``exact_match``
     (routed greedy output vs an uninterrupted single-loop run over the
-    same seed-0 weights), and ``pool_drained`` (no orphaned KV blocks on
-    the cleanly-exiting replicas)."""
+    same seed-0 weights), ``pool_drained`` (no orphaned KV blocks on
+    the cleanly-exiting replicas), and the fleet-merged queue-wait
+    p50/p99 (the published histogram the router's SLO admission reads
+    — merged bucket-by-bucket, never averaged per-replica)."""
     import numpy as np
 
     from tpudist import obs
     from tpudist.models.serving import Request, ServeLoop
+    from tpudist.obs.aggregate import collect, merge_snapshots
+    from tpudist.obs.registry import hist_quantile
     from tpudist.runtime.coord import CoordClient, CoordServer
     from tpudist.runtime.router import (Router, build_tiny_lm,
                                         exit_reports, launch_local_fleet,
@@ -1924,6 +1928,13 @@ def bench_serve_fleet(on_tpu: bool) -> None:
 
         got = {c.rid: tuple(c.tokens.tolist()) for c in comps}
         reports = exit_reports(client, namespace=ns)
+        # fleet-merged queue-wait percentiles: the same published
+        # histogram the router's SLO admission consults, quantiled over
+        # merged buckets (survivors' final publishes persist in the KV
+        # store past stop_fleet; a swept dead rank simply drops out)
+        merged = merge_snapshots(collect(client, f"{ns}/metrics"))
+        wait_h = merged["histograms"].get("serve/queue_wait_s")
+        have_wait = bool(wait_h) and wait_h["count"] > 0
         _emit("serve_fleet_tokens_per_s",
               round(sum(len(t) for t in got.values()) / wall, 1),
               "tokens/sec", None, replicas=n_replicas, killed=kill,
@@ -1935,7 +1946,129 @@ def bench_serve_fleet(on_tpu: bool) -> None:
               pool_drained=all(r.get("pool_drained")
                                for r in reports.values()),
               clean_exits=sum(1 for r in reports.values() if r["clean"]),
+              queue_wait_p50_s=(round(hist_quantile(wait_h, 0.5), 4)
+                                if have_wait else None),
+              queue_wait_p99_s=(round(hist_quantile(wait_h, 0.99), 4)
+                                if have_wait else None),
               wall_s=round(wall, 2))
+    server.stop()
+
+
+def bench_serve_elastic(on_tpu: bool) -> None:
+    """Elastic fleet under measurement (live join + rolling hot-swap):
+    2 replicas boot off a shared v1 weight snapshot, one is SIGKILLed
+    mid-decode while a fresh replica joins via ``scale_fleet``, then a
+    rolling weight swap (with a deliberately abandoned ticket on the
+    chain, exercising the dead-ticket-holder timeout) moves the fleet
+    to v2 and a second batch decodes on the NEW weights.  The single
+    row asserts the elastic guarantees end-to-end: ``lost_requests=0``,
+    ``joined>=1``, ``swap_downtime_requests=0``, exact-match greedy
+    output against uninterrupted references on BOTH weight versions,
+    and drained KV pools on every clean exit."""
+    import tempfile
+
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        exit_reports, launch_local_fleet,
+                                        roll_weights, scale_fleet,
+                                        stop_fleet, wait_live,
+                                        wait_swapped)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_serve_elastic", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    def make_requests(n, seed):
+        rng = np.random.default_rng(seed)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"q{seed}-{i}")
+                for i in range(n)]
+
+    def reference(seed, reqs):
+        cfg, params = build_tiny_lm(seed=seed)
+        loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+        return {c.rid: tuple(c.tokens.tolist()) for c in loop.run(reqs)}
+
+    n_pre, n_post = 8, 6
+    want_pre = reference(0, make_requests(n_pre, seed=0))
+    want_post = reference(1, make_requests(n_post, seed=1))
+
+    ns = "bench-elastic"
+    client = CoordClient(port=server.port)
+    _, params_v2 = build_tiny_lm(seed=1)
+    with tempfile.TemporaryDirectory() as snap_dir:
+        # v1 snapshot first: joiners and hot-swaps both restore from it
+        roll_weights(client, snap_dir, build_tiny_lm(seed=0)[1],
+                     version=1, namespace=ns)
+        args = ["--cache-layout", "paged", "--kv-block-size", "16",
+                "--ttl", "1.0", "--snapshot-dir", snap_dir,
+                "--swap-turn-timeout", "2.0"]
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=args,
+            env_overrides={1: {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "4"}})
+        before = obs.snapshot()["counters"]
+        t0 = time.perf_counter()
+        try:
+            wait_live(client, 2, namespace=ns, timeout_s=120.0,
+                      procs=procs)
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            router._poll({}, {}, None)  # pin the membership baseline
+            procs += scale_fleet(f"127.0.0.1:{server.port}", 1,
+                                 start_index=2, namespace=ns,
+                                 replica_args=args)
+            comps_pre = router.run(make_requests(n_pre, seed=0),
+                                   timeout_s=180.0)
+            wait_live(client, 2, namespace=ns, timeout_s=120.0)
+            # abandoned ticket: version 2's chain starts with a claimed
+            # turn nobody will finish, so survivors must take the
+            # turn-timeout liveness path
+            client.add(f"{ns}/weights/ticket/2", 1)
+            roll_weights(client, snap_dir, params_v2, version=2,
+                         namespace=ns)
+            wait_swapped(client, 2, 2, namespace=ns, timeout_s=120.0)
+            comps_post = router.run(make_requests(n_post, seed=1),
+                                    timeout_s=180.0)
+            wall = time.perf_counter() - t0
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+    after = obs.snapshot()["counters"]
+
+    def delta(name):
+        return (after.get(name, {}).get("value", 0)
+                - before.get(name, {}).get("value", 0))
+
+    got_pre = {c.rid: tuple(c.tokens.tolist()) for c in comps_pre
+               if c.reason == "length"}
+    got_post = {c.rid: tuple(c.tokens.tolist()) for c in comps_post
+                if c.reason == "length"}
+    reports = exit_reports(client, namespace=ns)
+    _emit("serve_elastic", round(wall, 2), "s", None,
+          requests=n_pre + n_post,
+          lost_requests=(n_pre - len(got_pre)) + (n_post - len(got_post)),
+          joined=int(delta("router/joins")),
+          replica_deaths=int(delta("router/replica_deaths")),
+          redispatched=int(delta("router/redispatched")),
+          swap_downtime_requests=n_post - len(got_post),
+          exact_match_pre=all(got_pre.get(r) == w
+                              for r, w in want_pre.items()),
+          exact_match_post=all(got_post.get(r) == w
+                               for r, w in want_post.items()),
+          pool_drained=all(r.get("pool_drained")
+                           for r in reports.values()),
+          clean_exits=sum(1 for r in reports.values() if r["clean"]),
+          weights_versions=sorted({r.get("weights_version")
+                                   for r in reports.values()}),
+          wall_s=round(wall, 2))
     server.stop()
 
 
@@ -1956,7 +2089,7 @@ def main() -> None:
                bench_kv_paging,
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode, bench_host_allreduce,
-               bench_serve_fleet]
+               bench_serve_fleet, bench_serve_elastic]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

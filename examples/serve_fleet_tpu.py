@@ -16,15 +16,29 @@ still completes, and because decoding is greedy over identical weights
 the redispatched outputs are token-identical to the undisturbed ones —
 the demo verifies this against a local single-loop reference run.
 
+The elastic tier (ISSUE 7):
+
+* ``--join`` scales a RUNNING fleet up by one replica mid-traffic
+  (`scale_fleet`) — the router discovers it on its next membership poll
+  (`router/joins` ticks) and starts dispatching to it immediately.
+* ``--hot-swap`` serves a first batch on version-1 weights, then rolls
+  version-2 weights through the live fleet (`roll_weights` →
+  drain-gated, one-replica-at-a-time ticket chain → `wait_swapped`)
+  and serves a second batch — verified token-identical to a local
+  reference on the NEW weights, with zero requests lost to the roll.
+
 Run (CPU works; each replica is a separate process):
 
     python examples/serve_fleet_tpu.py --replicas 2 --requests 6 --kill
+    python examples/serve_fleet_tpu.py --replicas 2 --join --hot-swap
 """
 
 from __future__ import annotations
 
 import argparse
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -40,6 +54,13 @@ def main(argv=None) -> int:
                         help="SIGKILL the last replica mid-decode and "
                              "watch the router redispatch")
     parser.add_argument("--kill-after-segments", type=int, default=4)
+    parser.add_argument("--join", action="store_true",
+                        help="scale the running fleet up by one joiner "
+                             "replica while traffic flows")
+    parser.add_argument("--hot-swap", action="store_true",
+                        help="roll new weights through the live fleet "
+                             "between two batches (drain-gated, zero "
+                             "lost requests)")
     parser.add_argument("--ttl", type=float, default=1.0,
                         help="replica heartbeat lease (the death-"
                              "detection latency floor)")
@@ -49,7 +70,9 @@ def main(argv=None) -> int:
     from tpudist.runtime.coord import CoordClient, CoordServer
     from tpudist.runtime.router import (Router, build_tiny_lm,
                                         exit_reports, launch_local_fleet,
-                                        stop_fleet, wait_live)
+                                        roll_weights, scale_fleet,
+                                        stop_fleet, wait_live,
+                                        wait_swapped)
 
     try:
         server = CoordServer(0)
@@ -58,55 +81,105 @@ def main(argv=None) -> int:
               "build it with `make -C native`", file=sys.stderr)
         return 1
 
-    rng = np.random.default_rng(0)
-    requests = [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
-                        16 + 2 * (i % 4), rid=f"q{i}")
-                for i in range(args.requests)]
+    def make_requests(n, seed):
+        rng = np.random.default_rng(seed)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"q{seed}-{i}")
+                for i in range(n)]
+
+    def reference(seed, reqs):
+        cfg, params = build_tiny_lm(seed=seed)
+        loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+        return {c.rid: c.tokens.tolist() for c in loop.run(reqs)}
 
     env = ({args.replicas - 1:
             {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS":
              args.kill_after_segments}} if args.kill else None)
     client = CoordClient(port=server.port)
+
+    replica_args = ["--cache-layout", "paged", "--kv-block-size", "16",
+                    "--ttl", str(args.ttl)]
+    snap_dir = None
+    if args.hot_swap:
+        # version 1 goes to the shared snapshot dir BEFORE launch:
+        # replicas (and any later joiner) restore the fleet's current
+        # weights from it instead of trusting their build seed
+        snap_dir = tempfile.mkdtemp(prefix="tpudist-weights-")
+        roll_weights(client, snap_dir, build_tiny_lm(seed=0)[1],
+                     version=1)
+        replica_args += ["--snapshot-dir", snap_dir,
+                         "--swap-turn-timeout", "5.0"]
+
     print(f"launching {args.replicas} replicas"
           + (f" (replica r{args.replicas - 1} will SIGKILL itself after "
              f"{args.kill_after_segments} decode segments)"
              if args.kill else ""))
     procs = launch_local_fleet(
         f"127.0.0.1:{server.port}", args.replicas,
-        replica_args=["--cache-layout", "paged", "--kv-block-size", "16",
-                      "--ttl", str(args.ttl)],
-        env_overrides=env)
+        replica_args=replica_args, env_overrides=env)
+    requests = make_requests(args.requests, seed=0)
+    comps2: list = []
     try:
-        wait_live(client, args.replicas, timeout_s=120.0)
+        wait_live(client, args.replicas, timeout_s=120.0, procs=procs)
         print("fleet live; routing")
         router = Router(client, lost_after_s=5.0)
+        if args.join:
+            router._poll({}, {}, None)  # pin the membership baseline
+            print("scaling up: one joiner replica entering the "
+                  "live fleet")
+            procs += scale_fleet(f"127.0.0.1:{server.port}", 1,
+                                 start_index=args.replicas,
+                                 replica_args=replica_args)
         t0 = time.perf_counter()
         comps = router.run(requests, timeout_s=180.0)
         wall = time.perf_counter() - t0
+        if args.hot_swap:
+            survivors = (args.replicas + (1 if args.join else 0)
+                         - (1 if args.kill else 0))
+            print("rolling weight hot-swap to version 2 "
+                  f"across {survivors} live replicas")
+            roll_weights(client, snap_dir, build_tiny_lm(seed=1)[1],
+                         version=2)
+            swapped = wait_swapped(client, survivors, 2,
+                                   timeout_s=120.0)
+            print(f"version 2 live on ranks {sorted(swapped)}; "
+                  "routing the post-swap batch")
+            comps2 = router.run(make_requests(args.requests, seed=1),
+                                timeout_s=180.0)
     finally:
         stop_fleet(client, procs)
+        if snap_dir is not None:
+            shutil.rmtree(snap_dir, ignore_errors=True)
 
     # verify: greedy fleet output (including anything redispatched)
-    # must be token-identical to one uninterrupted local loop
-    cfg, params = build_tiny_lm(seed=0)
-    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
-                    prefill_chunk=8, cache_layout="paged",
-                    kv_block_size=16)
-    want = {c.rid: c.tokens.tolist() for c in ref.run(requests)}
+    # must be token-identical to one uninterrupted local loop — batch 1
+    # against the version-1 weights, batch 2 against version 2
+    want = reference(0, requests)
     mismatched = [c.rid for c in comps
                   if c.tokens.tolist() != want[c.rid]]
+    n_want = len(requests)
+    if args.hot_swap:
+        want2 = reference(1, make_requests(args.requests, seed=1))
+        mismatched += [c.rid for c in comps2
+                       if c.tokens.tolist() != want2[c.rid]]
+        n_want += args.requests
 
-    for c in sorted(comps, key=lambda c: c.rid):
+    for c in sorted(comps + comps2, key=lambda c: c.rid):
         print(f"  {c.rid}: {len(c.tokens)} tokens ({c.reason})")
-    reports = exit_reports(client, namespace="fleet")
-    print(f"{len(comps)}/{len(requests)} requests completed "
-          f"in {wall:.1f}s; clean exits: {sorted(reports)}; "
+    reports = exit_reports(client)
+    print(f"{len(comps) + len(comps2)}/{n_want} requests completed "
+          f"(first batch in {wall:.1f}s); "
+          f"clean exits: {sorted(reports)}; "
           f"pools drained: "
           f"{all(r.get('pool_drained') for r in reports.values())}")
-    if len(comps) != len(requests) or mismatched:
+    if len(comps) + len(comps2) != n_want or mismatched:
         print(f"FAILED: mismatched={mismatched}", file=sys.stderr)
         return 1
-    print("exact match vs uninterrupted reference run OK")
+    print("exact match vs uninterrupted reference run"
+          + ("s (both weight versions)" if args.hot_swap else "")
+          + " OK")
     return 0
 
 

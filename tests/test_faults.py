@@ -41,6 +41,7 @@ class TestPlan:
             "TPUDIST_FAULT_COORD_DELAY_S": "0.01",
             "TPUDIST_FAULT_HEARTBEAT_STOP_AFTER_S": "3.5",
             "TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "7",
+            "TPUDIST_FAULT_PUBLISH_DROP": "2.5",
             "TPUDIST_FAULT_SEED": "42",
         })
         assert plan.active
@@ -49,6 +50,7 @@ class TestPlan:
         assert plan.coord_delay_s == 0.01
         assert plan.heartbeat_stop_after_s == 3.5
         assert plan.kill_after_segments == 7
+        assert plan.publish_drop_after_s == 2.5
         assert plan.seed == 42
 
     def test_empty_env_is_inert(self):
@@ -57,9 +59,10 @@ class TestPlan:
         # inert hooks are no-ops
         plan.coord_op("get")
         assert not plan.drop_heartbeat()
+        assert not plan.drop_publish()
         plan.on_segment()
         assert plan.injected == {"coord_error": 0, "coord_delay": 0,
-                                 "heartbeat_drop": 0}
+                                 "heartbeat_drop": 0, "publish_drop": 0}
 
     def test_probability_validation(self):
         with pytest.raises(ValueError, match="coord_error_p"):
@@ -154,6 +157,30 @@ class TestCoordRetry:
         assert plan.calls == 1  # exactly one attempt
         # the fault fired BEFORE the RPC: nothing was applied
         assert client.add("ctr", 1) == 1
+
+    def test_publish_drop_swallows_store_write_not_heartbeat(self):
+        """PUBLISH_DROP starves the obs plane while the TTL plane keeps
+        beating — the exact stale-not-lost shape HealthMonitor
+        classifies.  The publisher must still return the snapshot (its
+        local callers keep working); only the store write vanishes."""
+        from tpudist.obs.aggregate import MetricsPublisher, collect
+
+        server, client = _coord_pair()
+        faults.install(FaultPlan(publish_drop_after_s=0.0))
+        try:
+            pub = MetricsPublisher(client, 0, obs.registry,
+                                   namespace="pd")
+            snap = pub.publish()
+            assert snap["rank"] == 0            # local snapshot intact
+            assert collect(client, namespace="pd") == {}  # write dropped
+            client.heartbeat("pd-live", 5.0)    # heartbeats unaffected
+            assert "pd-live" in client.live()
+            assert faults.plan().injected["publish_drop"] >= 1
+        finally:
+            faults.reset()
+            client.heartbeat("pd-live", 0.0)
+        pub.publish()
+        assert 0 in collect(client, namespace="pd")  # flows again
 
     def test_heartbeat_drop_swallows_lease_refresh(self):
         server, client = _coord_pair()

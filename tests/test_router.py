@@ -1,8 +1,12 @@
-"""Fault-tolerant serve fleet (ISSUE 6): least-loaded pick, wire
-round-trip, the no-hang bound, and the acceptance end-to-end — SIGKILL a
-replica mid-decode and every admitted request still returns a greedy
-exact-match Completion with no orphaned KV blocks."""
+"""Fault-tolerant serve fleet (ISSUE 6) and its elastic extension
+(ISSUE 7): least-loaded pick, wire round-trip, the no-hang bound,
+redispatch-cap exhaustion, SLO admission, live join, hot-swap steering —
+and the acceptance end-to-ends: SIGKILL a replica mid-decode (every
+admitted request still returns a greedy exact-match Completion with no
+orphaned KV blocks), then join a fresh replica and roll a weight
+hot-swap through the survivors with zero lost requests."""
 
+import json
 import time
 
 import numpy as np
@@ -10,8 +14,8 @@ import pytest
 
 from tpudist.runtime.router import (
     Router, _decode_request, _encode_completion, _encode_request,
-    build_tiny_lm, exit_reports, launch_local_fleet, stop_fleet,
-    wait_live)
+    build_tiny_lm, exit_reports, launch_local_fleet, roll_weights,
+    scale_fleet, stop_fleet, wait_live, wait_swapped)
 
 
 def _coord_pair():
@@ -104,6 +108,223 @@ class TestNoHang:
         assert time.monotonic() - t0 < 5.0
 
 
+# -- elastic membership: unit layer over an in-memory coord double ---------
+
+class FakeCoord:
+    """In-memory stand-in for CoordClient — just the verbs Router and
+    HealthMonitor reach for (keys/get/set/delete/live), plus an
+    ``on_set`` hook so a test can inject a fleet-wide event (every
+    replica dying at once) at an exact point in the dispatch
+    sequence."""
+
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+        self.live_set: set[str] = set()
+        self.on_set = None
+
+    def keys(self, prefix=""):
+        return [k for k in list(self.kv) if k.startswith(prefix)]
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value):
+        self.kv[key] = value
+        if self.on_set is not None:
+            self.on_set(key, value)
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+
+    def live(self):
+        return set(self.live_set)
+
+
+def _register(fc, ns, rid, rank):
+    fc.kv[f"{ns}/replica/{rid}"] = json.dumps(
+        {"replica_id": rid, "rank": rank}).encode()
+    fc.live_set.add(f"{ns}:{rid}")
+
+
+def _publish(fc, ns, rank, *, gauges=None, hist_wait=None, age_s=0.0):
+    """One published metrics snapshot, exactly the MetricsPublisher
+    shape ``collect`` parses; ``age_s`` backdates ``published_at``."""
+    snap = {"rank": rank, "published_at": time.time() - age_s,
+            "gauges": {name: {"value": v}
+                       for name, v in (gauges or {}).items()},
+            "counters": {}, "histograms": {}}
+    if hist_wait is not None:
+        snap["histograms"]["serve/queue_wait_s"] = hist_wait
+    fc.kv[f"{ns}/metrics/{rank}"] = json.dumps(snap).encode()
+
+
+def _fat_wait_hist(idx=6, count=100):
+    """Every queue-wait observation in one bucket at ``2**idx`` seconds
+    — a power of the growth factor, so EVERY quantile is exactly
+    ``2**idx`` (hist_quantile returns bucket lower bounds)."""
+    v = float(2.0 ** idx)
+    return {"growth": 2.0, "count": count, "sum": v * count, "zero": 0,
+            "min": v, "max": v, "buckets": {str(idx): count}}
+
+
+def _counter(name):
+    from tpudist import obs
+
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+def _entry(req, attempts=0):
+    return {"req": req, "assigned": None, "attempts": attempts}
+
+
+class TestElasticUnit:
+    def test_simultaneous_two_death_hits_redispatch_cap(self):
+        """BOTH replicas die at once with ``max_redispatch=0``: every
+        outstanding request must surface ``reason="failed"`` immediately
+        (no hang, no silent drop), with both deaths and all four
+        redispatch attempts counted."""
+        fc = FakeCoord()
+        ns = "cap"
+        _register(fc, ns, "a", 0)
+        _register(fc, ns, "b", 1)
+        inbox_writes = []
+
+        def on_set(key, value):
+            if key.startswith(f"{ns}/inbox/"):
+                inbox_writes.append(key)
+                if len(inbox_writes) == 4:   # whole fleet dies at once
+                    fc.live_set.clear()
+
+        fc.on_set = on_set
+        router = Router(fc, namespace=ns, use_health=False,
+                        max_redispatch=0, poll_s=0.001)
+        d0 = _counter("router/replica_deaths")
+        r0 = _counter("router/redispatched")
+        comps = router.run(_requests(4), timeout_s=10.0)
+        assert [c.reason for c in comps] == ["failed"] * 4
+        assert sorted(c.rid for c in comps) == [f"q{i}" for i in range(4)]
+        assert all(c.tokens.size == 0 for c in comps)
+        assert _counter("router/replica_deaths") - d0 == 2
+        assert _counter("router/redispatched") - r0 == 4
+
+    def test_slo_shed_predicted_miss(self):
+        """The best candidate's published p99 queue wait already blows
+        the deadline: the request is shed AT THE ROUTER (reason="shed")
+        before any replica pays a prefill."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "slo"
+        _register(fc, ns, "a", 0)
+        _publish(fc, ns, 0, hist_wait=_fat_wait_hist(idx=6))  # p99 = 64s
+        router = Router(fc, namespace=ns, use_health=False, poll_s=0.001)
+        s0 = _counter("router/slo_shed")
+        req = Request(np.arange(4, dtype=np.int32), 8, rid="doomed",
+                      deadline_s=time.time() + 5.0)
+        comps = router.run([req], timeout_s=10.0)
+        assert comps[0].reason == "shed" and comps[0].rid == "doomed"
+        assert comps[0].tokens.size == 0
+        assert _counter("router/slo_shed") - s0 == 1
+        assert fc.keys(f"{ns}/inbox/") == []   # never cost a prefill
+
+    def test_slo_admission_scope(self):
+        """Shed is ONLY for first-dispatch deadline requests whose miss
+        is predicted: no-deadline and far-deadline requests dispatch
+        normally, and an already-redispatched request (sunk prefill
+        cost) races its deadline instead of being shed."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "slo2"
+        _register(fc, ns, "a", 0)
+        _publish(fc, ns, 0, hist_wait=_fat_wait_hist(idx=6))  # p99 = 64s
+        router = Router(fc, namespace=ns, use_health=False)
+        prompt = np.arange(4, dtype=np.int32)
+        entries = {
+            "00000000": _entry(Request(prompt, 8, rid="no-deadline")),
+            "00000001": _entry(Request(prompt, 8, rid="far",
+                                       deadline_s=time.time() + 1e4)),
+            "00000002": _entry(Request(prompt, 8, rid="retry",
+                                       deadline_s=time.time() + 5.0),
+                               attempts=1),
+        }
+        done = {}
+        router._poll(entries, done, lambda k, c: done.__setitem__(k, c))
+        assert done == {}                              # nothing shed
+        assert all(e["assigned"] == "a" for e in entries.values())
+        assert len(fc.keys(f"{ns}/inbox/a/")) == 3
+
+    def test_late_registration_counts_as_join(self):
+        """Membership is re-read every poll: the first poll's live set
+        is the baseline fleet, every later appearance is a JOIN —
+        counted once, then known."""
+        fc = FakeCoord()
+        ns = "join"
+        _register(fc, ns, "a", 0)
+        router = Router(fc, namespace=ns, use_health=False)
+        j0 = _counter("router/joins")
+        router._poll({}, {}, None)
+        assert _counter("router/joins") - j0 == 0   # baseline, not a join
+        _register(fc, ns, "b", 1)
+        router._poll({}, {}, None)
+        assert _counter("router/joins") - j0 == 1
+        router._poll({}, {}, None)                  # no double count
+        assert _counter("router/joins") - j0 == 1
+        assert router._known == {"a", "b"}
+
+    def test_swapping_replica_is_steered_around(self):
+        """A replica advertising ``serve/swapping`` has paused admission
+        to drain for a weight rebind: the router must route around it —
+        and when EVERY candidate is mid-swap, requests wait rather than
+        fail."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "steer"
+        _register(fc, ns, "a", 0)
+        _register(fc, ns, "b", 1)
+        # a is otherwise the obvious pick (idle) but is mid-hot-swap
+        _publish(fc, ns, 0, gauges={"serve/swapping": 1.0,
+                                    "serve/queue_depth": 0.0})
+        _publish(fc, ns, 1, gauges={"serve/swapping": 0.0,
+                                    "serve/queue_depth": 5.0})
+        router = Router(fc, namespace=ns, use_health=False)
+        prompt = np.arange(4, dtype=np.int32)
+        entries = {"00000000": _entry(Request(prompt, 8, rid="x"))}
+        router._poll(entries, {}, None)
+        assert entries["00000000"]["assigned"] == "b"
+        _publish(fc, ns, 1, gauges={"serve/swapping": 1.0})
+        entries2 = {"00000001": _entry(Request(prompt, 8, rid="y"))}
+        done = {}
+        router._poll(entries2, done,
+                     lambda k, c: done.__setitem__(k, c))
+        assert entries2["00000001"]["assigned"] is None and done == {}
+
+    def test_stale_publisher_steers_routing_without_a_death(self):
+        """A replica that published then went quiet (the PUBLISH_DROP
+        shape) goes ``stale`` in the health verdict: the router stops
+        admitting to it but must NOT declare it dead — its heartbeat is
+        still flowing and its in-flight work will land."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "quiet"
+        _register(fc, ns, "a", 0)
+        _register(fc, ns, "b", 1)
+        _publish(fc, ns, 0, age_s=0.0)
+        _publish(fc, ns, 1, age_s=10.0)   # published, then went quiet
+        router = Router(fc, namespace=ns, stale_after_s=3.0,
+                        lost_after_s=1e6, use_health=True)
+        d0 = _counter("router/replica_deaths")
+        prompt = np.arange(4, dtype=np.int32)
+        entries = {"00000000": _entry(Request(prompt, 8, rid="x"))}
+        router._poll(entries, {}, None)
+        assert router._health.verdict()["stale"] == ["1"]
+        assert entries["00000000"]["assigned"] == "a"
+        assert _counter("router/replica_deaths") - d0 == 0
+        assert "b" not in router._dead
+
+
 class TestFleetE2E:
     def _route(self, client, procs, n_requests, *, namespace,
                lost_after_s=5.0):
@@ -117,12 +338,12 @@ class TestFleetE2E:
             stop_fleet(client, procs, namespace=namespace)
         return comps
 
-    def _reference(self, n_requests):
+    def _reference(self, n_requests, seed=0):
         """The uninterrupted run: one local ServeLoop, identical seed
         and layout to the fleet replicas."""
         from tpudist.models.serving import ServeLoop
 
-        cfg, params = build_tiny_lm(seed=0)
+        cfg, params = build_tiny_lm(seed=seed)
         loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
                          prefill_chunk=8, cache_layout="paged",
                          kv_block_size=16)
@@ -199,3 +420,127 @@ class TestFleetE2E:
                    for r in reports.values())
         # least-loaded admission actually spread the work
         assert all(v >= 1 for v in served.values()), served
+
+    def test_elastic_join_kill_and_rolling_hot_swap(self, tmp_path):
+        """ISSUE 7's acceptance E2E: 2 replicas serve; r1 SIGKILLs
+        itself mid-decode while a fresh replica r2 joins the RUNNING
+        fleet (restoring the fleet snapshot, so its greedy output
+        exact-matches the incumbents); then a rolling hot-swap to new
+        weights — with a GHOST ticket pre-claimed on the chain, so the
+        dead-ticket-holder turn-timeout path runs for real — and a
+        second batch decodes exact-match on the NEW weights.  Zero lost
+        requests across the whole scenario."""
+        from tpudist import obs
+
+        server, client = _coord_pair()
+        ns = "elastic-fleet"
+        snap_dir = tmp_path / "weights"
+        _, params_v1 = build_tiny_lm(seed=0)
+        _, params_v2 = build_tiny_lm(seed=1)
+        # v1 on disk BEFORE launch: every member (and the joiner)
+        # restores the same committed bytes
+        roll_weights(client, snap_dir, params_v1, version=1,
+                     namespace=ns)
+        args = ["--cache-layout", "paged", "--kv-block-size", "16",
+                "--ttl", "1.0", "--snapshot-dir", str(snap_dir),
+                "--swap-turn-timeout", "2.0"]
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=args,
+            env_overrides={1: {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "4"}})
+        before = obs.snapshot()["counters"]
+        try:
+            wait_live(client, 2, namespace=ns, timeout_s=90.0,
+                      procs=procs)
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            router._poll({}, {}, None)   # membership baseline: {r0, r1}
+            # the joiner RACES r1's kill: spawned now, admitted whenever
+            # its registration lands (typically mid-run)
+            procs += scale_fleet(f"127.0.0.1:{server.port}", 1,
+                                 start_index=2, namespace=ns,
+                                 replica_args=args)
+            comps = router.run(_requests(6), timeout_s=120.0)
+            assert sorted(c.rid for c in comps) == [f"q{i}"
+                                                    for i in range(6)]
+            assert all(c.reason == "length" for c in comps)  # zero lost
+            want = self._reference(6, seed=0)
+            for c in comps:
+                np.testing.assert_array_equal(
+                    c.tokens, np.asarray(want[c.rid], np.int32),
+                    err_msg=f"request {c.rid} diverged (pre-swap)")
+            # the kill really happened (reap: SIGKILL already landed)
+            assert procs[1].wait(timeout=30) == -9
+            # survivors: r0 + the joiner (NOT passing procs — r1's death
+            # is expected here, not a launch failure)
+            wait_live(client, 2, namespace=ns, timeout_s=90.0)
+            # GHOST ticket: a chain member that "died" holding ticket 1
+            # — the survivors must time out its turn, not stall forever
+            client.add(f"{ns}/weights/ticket/2", 1)
+            roll_weights(client, snap_dir, params_v2, version=2,
+                         namespace=ns)
+            assert wait_swapped(client, 2, 2, namespace=ns,
+                                timeout_s=90.0) == {0, 2}
+            comps2 = router.run(_requests(4), timeout_s=120.0)
+            assert sorted(c.rid for c in comps2) == [f"q{i}"
+                                                     for i in range(4)]
+            # zero swap-downtime losses: every post-roll request served
+            assert all(c.reason == "length" for c in comps2)
+            want2 = self._reference(4, seed=1)
+            for c in comps2:
+                np.testing.assert_array_equal(
+                    c.tokens, np.asarray(want2[c.rid], np.int32),
+                    err_msg=f"request {c.rid} diverged (post-swap)")
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        assert delta("router/joins") >= 1           # r2 joined mid-run
+        assert delta("router/replica_deaths") >= 1  # r1's death was seen
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0", "r2"}  # SIGKILLed r1 left none
+        for rid, rep in reports.items():
+            assert rep["clean"] and rep["pool_drained"], (rid, rep)
+            assert rep["weights_version"] == 2, (rid, rep)
+
+    @pytest.mark.slow
+    def test_publish_drop_replica_stays_alive_and_serves(self):
+        """TPUDIST_FAULT_PUBLISH_DROP starves r1's obs plane from
+        birth: it never publishes a snapshot, but its heartbeat flows —
+        the router must treat it as a live (if unknown-load) member,
+        NOT a death.  Every request completes exact-match and r1 exits
+        clean with a drained pool."""
+        from tpudist import obs
+        from tpudist.obs.aggregate import collect
+
+        server, client = _coord_pair()
+        ns = "quiet-fleet"
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"],
+            env_overrides={1: {"TPUDIST_FAULT_PUBLISH_DROP": "0"}})
+        before = obs.snapshot()["counters"]
+        comps = self._route(client, procs, 4, namespace=ns)
+        assert sorted(c.rid for c in comps) == [f"q{i}" for i in range(4)]
+        assert all(c.reason == "length" for c in comps)
+        want = self._reference(4)
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, np.asarray(want[c.rid], np.int32))
+        # the drop was really active end-to-end: not even the final
+        # publish on shutdown landed for rank 1
+        assert 1 not in collect(client, namespace=f"{ns}/metrics")
+        after = obs.snapshot()["counters"]
+        deaths = (after.get("router/replica_deaths",
+                            {}).get("value", 0)
+                  - before.get("router/replica_deaths",
+                               {}).get("value", 0))
+        assert deaths == 0                  # starved obs plane != death
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0", "r1"}
+        assert all(r["clean"] and r["pool_drained"]
+                   for r in reports.values())

@@ -457,3 +457,148 @@ class TestServiceMode:
         assert comps["toolong"].tokens.shape == (0,)
         np.testing.assert_array_equal(
             comps["fine"].tokens, _want(params, comps["fine"].prompt, 6))
+
+
+@pytest.fixture(scope="module")
+def params_v2():
+    """A SECOND weight set (different init seed): the hot-swap target.
+    Same shapes/dtypes as ``params``, so the rebind must not retrace."""
+    return TransformerLM(CFG).init(
+        jax.random.key(1), jnp.zeros((1, 2), jnp.int32))["params"]
+
+
+def _want2(params_v2, prompt, n):
+    out = greedy_generate(CFG, params_v2, jnp.asarray(prompt)[None, :], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+class TestWeightHotSwap:
+    """request_swap: drain-gated rebind of self.params.  The contract —
+    requests admitted before the swap complete on the OLD weights,
+    requests admitted after decode on the NEW ones, and no request ever
+    straddles versions."""
+
+    def test_midstream_swap_drains_then_rebinds(self, params, params_v2):
+        """old0/old1 hold the two slots when the swap arrives (old
+        weights); q is QUEUED behind them — never admitted pre-swap, so
+        the barrier holds it for the NEW weights; new0/new1 arrive with
+        the swap request itself."""
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8)
+        old = [Request(_prompt(60 + i, 4 + 2 * i), 8 + i, rid=f"old{i}")
+               for i in range(2)]
+        queued = Request(_prompt(65, 6), 7, rid="q")
+        new = [Request(_prompt(70 + i, 5 + i), 6 + 2 * i, rid=f"new{i}")
+               for i in range(2)]
+        events = []
+        polls = {"n": 0}
+
+        def source():
+            polls["n"] += 1
+            if polls["n"] == 1:
+                return old + [queued]
+            if polls["n"] == 2:
+                # swap requested while old requests are still decoding;
+                # the new batch arrives in the SAME poll and must wait
+                # behind the admission barrier
+                loop.request_swap(
+                    lambda: params_v2, version=7,
+                    on_swapped=lambda: events.append("swapped"))
+                return new
+            done = sum(1 for e in events if e != "swapped")
+            return None if done == len(old) + len(new) + 1 else []
+
+        comps = {c.rid: c for c in loop.run(
+            source=source, sink=lambda c: events.append(c.rid),
+            idle_wait_s=0.0)}
+        assert len(comps) == 5
+        for i, r in enumerate(old):
+            np.testing.assert_array_equal(
+                comps[r.rid].tokens, _want(params, r.prompt, 8 + i),
+                err_msg=f"{r.rid} must decode on the OLD weights")
+        np.testing.assert_array_equal(
+            comps["q"].tokens, _want2(params_v2, queued.prompt, 7),
+            err_msg="a request still queued at swap time is held by the "
+                    "admission barrier and decodes on the NEW weights")
+        for i, r in enumerate(new):
+            np.testing.assert_array_equal(
+                comps[r.rid].tokens, _want2(params_v2, r.prompt, 6 + 2 * i),
+                err_msg=f"{r.rid} must decode on the NEW weights")
+        # ordering: every pre-swap completion lands before on_swapped,
+        # every post-swap one after — the drain gate, observed
+        swap_at = events.index("swapped")
+        assert {e for e in events[:swap_at]} == {r.rid for r in old}
+        assert {e for e in events[swap_at + 1:]} == (
+            {r.rid for r in new} | {"q"})
+        from tpudist import obs
+        assert obs.snapshot()["gauges"][
+            "serve/weights_version"]["value"] == 7
+
+    def test_swap_between_runs_no_retrace(self, params, params_v2):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8)
+        req = Request(_prompt(80, 6), 9, rid="a")
+        [c1] = loop.run([Request(_prompt(80, 6), 9, rid="a")])
+        np.testing.assert_array_equal(c1.tokens, _want(params, req.prompt, 9))
+        traced = (loop._segment._cache_size()
+                  if hasattr(loop._segment, "_cache_size") else None)
+        loop.request_swap(lambda: params_v2, version=2)
+        [c2] = loop.run([Request(_prompt(80, 6), 9, rid="a")])
+        np.testing.assert_array_equal(
+            c2.tokens, _want2(params_v2, req.prompt, 9))
+        if traced is not None:
+            # params is a jit ARGUMENT with unchanged avals: the swap
+            # must not have grown the executable cache
+            assert loop._segment._cache_size() == traced
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_swap_paged_layout_drains_pool(self, params, params_v2, depth):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16, pipeline_depth=depth)
+        old = [Request(_prompt(82, 7), 8, rid="old")]
+        new = [Request(_prompt(83, 5), 7, rid="new")]
+        polls = {"n": 0}
+        seen = []
+
+        def source():
+            polls["n"] += 1
+            if polls["n"] == 1:
+                return old
+            if polls["n"] == 2:
+                loop.request_swap(lambda: params_v2, version=3)
+                return new
+            return None if len(seen) == 2 else []
+
+        comps = {c.rid: c for c in loop.run(
+            source=source, sink=seen.append, idle_wait_s=0.0)}
+        np.testing.assert_array_equal(
+            comps["old"].tokens, _want(params, old[0].prompt, 8))
+        np.testing.assert_array_equal(
+            comps["new"].tokens, _want2(params_v2, new[0].prompt, 7))
+        assert loop.pool.used_blocks == 0  # fully drained through the swap
+
+    def test_failed_restore_keeps_old_weights_and_completes(self, params):
+        """params_fn returning None (missing snapshot): the rebind is
+        skipped but the swap COMPLETES — on_swapped fires, admission
+        resumes, and the queued request decodes on the old weights."""
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        fired = []
+        loop.request_swap(lambda: None, version=9,
+                          on_swapped=lambda: fired.append(True))
+        req = Request(_prompt(85, 5), 8, rid="q")
+        [c] = loop.run([Request(_prompt(85, 5), 8, rid="q")])
+        assert fired == [True]
+        np.testing.assert_array_equal(c.tokens, _want(params, req.prompt, 8))
+
+    def test_idle_swap_applies_immediately(self, params, params_v2):
+        """No traffic in flight: the swap lands on the next loop tick,
+        before any later admission."""
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8)
+        loop.request_swap(lambda: params_v2, version=1)
+        req = Request(_prompt(86, 4), 6, rid="q")
+        [c] = loop.run([Request(_prompt(86, 4), 6, rid="q")])
+        np.testing.assert_array_equal(
+            c.tokens, _want2(params_v2, req.prompt, 6))

@@ -89,7 +89,9 @@ class Completion:
     tokens: np.ndarray            # the generated tokens (stop included)
     # "stop" | "length" — the normal endings; "rejected" (load-shed at a
     # full admission queue), "timeout" (deadline_s passed), "invalid"
-    # (service-mode request failed validation)
+    # (service-mode request failed validation), "shed" (router-side SLO
+    # admission refused it before any replica paid a prefill — see
+    # tpudist.runtime.router)
     reason: str
 
 
@@ -306,6 +308,9 @@ class ServeLoop:
         # without real sleeps); production uses wall time because
         # Request.deadline_s crosses process boundaries via the router
         self._clock = time.time
+        # drain-gated weight hot-swap (see request_swap): set by
+        # request_swap, consumed by run() once the loop is fully drained
+        self._pending_swap: dict | None = None
         self._obs_requests = obs.counter("serve/requests", unit="reqs")
         self._obs_tokens = obs.counter("serve/tokens", unit="tokens")
         self._obs_rejected = obs.counter("serve/rejected", unit="reqs")
@@ -321,6 +326,9 @@ class ServeLoop:
         # is the live in-flight segment count
         self._obs_host_wait = obs.histogram("serve/host_wait", unit="s")
         self._obs_depth = obs.gauge("serve/pipeline_depth", unit="segments")
+        self._obs_swaps = obs.counter("serve/swaps", unit="swaps")
+        self._obs_weights_version = obs.gauge("serve/weights_version",
+                                              unit="version")
         # donate every rebound carry: cache, tok, active, remaining, key
         # (argnums 2-4 and 6) mirror _admit_dev — their inputs are dead
         # the moment the segment returns replacements.  `first` (argnum 5)
@@ -655,6 +663,32 @@ class ServeLoop:
             true_chunk=chunk)
         return {"req": req, "tokens": [], "pending_first": True}
 
+    def request_swap(self, params_fn, *, version: int | None = None,
+                     on_swapped=None) -> None:
+        """Schedule a DRAIN-GATED weight hot-swap: admission pauses,
+        every lane already decoding runs to completion on the OLD
+        weights, every in-flight segment drains, and only then is
+        ``params_fn()`` called and its tree rebound as ``self.params``
+        before admission resumes — no request ever straddles two weight
+        versions, so greedy output stays exact-match against whichever
+        single-version reference admitted it.  Because ``params`` is a
+        jit ARGUMENT (not a closure capture), a same-shape/dtype tree
+        swaps in with ZERO recompilation.
+
+        ``params_fn`` returning ``None`` (e.g. a missing snapshot)
+        aborts the rebind — old weights stay, the version gauge does
+        not move — but the swap still COMPLETES: ``on_swapped()`` fires
+        either way, so a rolling-upgrade chain (``runtime/router.py``'s
+        ticket protocol) can never stall on one replica's failed
+        restore.  ``version`` (when given and applied) lands on the
+        ``serve/weights_version`` gauge the router and ``wait_swapped``
+        poll.  Callable between :meth:`run` calls or during one from
+        the ``source()``/``sink`` callbacks — the loop is single-
+        threaded, so no locking; the latest request wins if one is
+        already pending."""
+        self._pending_swap = {"fn": params_fn, "version": version,
+                              "on_swapped": on_swapped}
+
     def run(self, requests: Sequence[Request] = (), *,
             source=None, sink=None,
             idle_wait_s: float = 0.005) -> list[Completion]:
@@ -808,6 +842,12 @@ class ServeLoop:
                             continue
                     kept.append((req, t_q))
                 pending = kept
+            if self._pending_swap is not None:
+                # swap barrier: no new admissions until the rebind lands
+                # (queued-deadline expiry above still runs — a request
+                # cannot outlive its deadline waiting on a swap)
+                self._obs_queue.set(len(pending))
+                return
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
                     req, t_q = pending[0]
@@ -858,6 +898,40 @@ class ServeLoop:
         def busy_live() -> bool:
             return any(st is not None and not st.get("zombie")
                        for st in slot_state)
+
+        def can_work() -> bool:
+            """Is there decode work a dispatch could advance?  A pending
+            swap gates QUEUED requests out (the admission barrier means
+            they cannot reach a slot, so dispatching for them would spin
+            empty segments forever); lanes already decoding still count
+            — they must run to completion before the swap lands."""
+            return busy_live() or (bool(pending)
+                                   and self._pending_swap is None)
+
+        def maybe_swap() -> None:
+            """Apply a pending weight swap once the loop is fully
+            drained: no in-flight segments (their emits were computed
+            under the old weights and must finalize against them) and
+            no occupied lanes (zombies included — their pool blocks are
+            refunded by the drain that just ran)."""
+            if (self._pending_swap is None or inflight
+                    or any(st is not None for st in slot_state)):
+                return
+            swap, self._pending_swap = self._pending_swap, None
+            with obs.span("serve/swap", version=swap["version"]):
+                tree = swap["fn"]()
+                if tree is not None:
+                    self.params = jax.tree.map(jnp.asarray, tree)
+                    self._obs_swaps.inc()
+                    if swap["version"] is not None:
+                        self._obs_weights_version.set(int(swap["version"]))
+            obs.recorder.record("serve_swap", seq=seq,
+                                version=swap["version"],
+                                applied=tree is not None)
+            if swap["on_swapped"] is not None:
+                swap["on_swapped"]()
+            admit_free()   # the barrier is down; refill lanes now
+            shed()
 
         def dispatch() -> None:
             """Chain one more segment on device and start its emits'
@@ -942,15 +1016,16 @@ class ServeLoop:
                         admit_free()
                         shed()
                 expire_inflight()
-                if pending or busy_live():
+                if can_work():
                     dispatch()
                 # fetch when the pipeline is full — or when there is
                 # nothing left to dispatch and only fetches remain
                 while inflight and (
                         len(inflight) >= self.pipeline_depth
-                        or not (pending or busy_live())):
+                        or not can_work()):
                     drain_oldest()
                     admit_free()
+                maybe_swap()
                 if not (pending or inflight or any(
                         st is not None for st in slot_state)):
                     if closed:

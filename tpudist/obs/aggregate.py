@@ -26,6 +26,8 @@ import json
 import threading
 import time
 
+from tpudist.runtime import faults
+
 __all__ = ["MetricsPublisher", "collect", "merge_snapshots"]
 
 DEFAULT_NAMESPACE = "obs/metrics"
@@ -60,6 +62,12 @@ class MetricsPublisher:
         # consumers can drop (or the health plane can flag) leftovers
         # from ranks that died in a previous elastic round
         snap["published_at"] = time.time()
+        # fault harness (TPUDIST_FAULT_PUBLISH_DROP): swallow the store
+        # write while heartbeats keep flowing — the end-to-end shape of
+        # a wedged obs plane, which the health monitor must classify
+        # `stale` (not `lost`) and a router must NOT treat as a death
+        if faults.drop_publish():
+            return snap
         (client or self._client).set(
             self.key, json.dumps(snap).encode("utf-8"))
         return snap

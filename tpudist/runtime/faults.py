@@ -21,7 +21,14 @@ land —
 * :meth:`FaultPlan.on_segment` — called by the serve loop after each
   dispatched decode segment; after ``kill_after_segments`` dispatches the
   process SIGKILLs *itself* — an uncatchable death mid-decode, the
-  harshest replica-loss shape.
+  harshest replica-loss shape;
+* :meth:`FaultPlan.drop_publish` — consulted by
+  :meth:`~tpudist.obs.aggregate.MetricsPublisher.publish`; once uptime
+  passes ``publish_drop_after_s`` every metrics publish is silently
+  swallowed while heartbeats keep flowing — the replica stays LIVE to
+  the TTL plane but its gauges age out, which is exactly the health
+  monitor's ``stale`` verdict (a wedged metrics thread, a partitioned
+  obs plane) as opposed to ``lost``.
 
 Determinism: the probabilistic knobs draw from one ``random.Random``
 seeded by ``TPUDIST_FAULT_SEED`` (default 0), so a failing CI run
@@ -42,6 +49,10 @@ Environment knobs (all optional):
 ``TPUDIST_FAULT_KILL_AFTER_SEGMENTS``
                                     SIGKILL self after this many dispatched
                                     serve segments
+``TPUDIST_FAULT_PUBLISH_DROP``      drop all metrics publishes once process
+                                    uptime exceeds this many seconds
+                                    (heartbeats keep flowing: the replica
+                                    goes ``stale``, not ``lost``)
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -55,7 +66,7 @@ import threading
 import time
 
 __all__ = ["FaultInjected", "FaultPlan", "plan", "install", "reset",
-           "coord_op", "drop_heartbeat", "on_segment"]
+           "coord_op", "drop_heartbeat", "drop_publish", "on_segment"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -85,6 +96,7 @@ class FaultPlan:
         coord_delay_s: float = 0.05,
         heartbeat_stop_after_s: float | None = None,
         kill_after_segments: int | None = None,
+        publish_drop_after_s: float | None = None,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -99,6 +111,7 @@ class FaultPlan:
         self.heartbeat_stop_after_s = heartbeat_stop_after_s
         self.kill_after_segments = (None if kill_after_segments is None
                                     else int(kill_after_segments))
+        self.publish_drop_after_s = publish_drop_after_s
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
@@ -106,10 +119,11 @@ class FaultPlan:
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
-                         "heartbeat_drop": 0}
+                         "heartbeat_drop": 0, "publish_drop": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
-                           or kill_after_segments is not None)
+                           or kill_after_segments is not None
+                           or publish_drop_after_s is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -124,6 +138,7 @@ class FaultPlan:
                            else 0.05),
             heartbeat_stop_after_s=hb,
             kill_after_segments=None if kill is None else int(kill),
+            publish_drop_after_s=_env_float(env, "PUBLISH_DROP"),
             seed=int(_env_float(env, "SEED") or 0),
         )
 
@@ -155,6 +170,18 @@ class FaultPlan:
             return False
         with self._lock:
             self.injected["heartbeat_drop"] += 1
+        return True
+
+    def drop_publish(self) -> bool:
+        """True when this process's metrics publishes should be
+        swallowed (the heartbeat keeps flowing — staleness, not
+        death)."""
+        if self.publish_drop_after_s is None:
+            return False
+        if time.monotonic() - self._born < self.publish_drop_after_s:
+            return False
+        with self._lock:
+            self.injected["publish_drop"] += 1
         return True
 
     def on_segment(self) -> None:
@@ -205,6 +232,11 @@ def coord_op(op: str) -> None:
 def drop_heartbeat() -> bool:
     p = plan()
     return p.active and p.drop_heartbeat()
+
+
+def drop_publish() -> bool:
+    p = plan()
+    return p.active and p.drop_publish()
 
 
 def on_segment() -> None:

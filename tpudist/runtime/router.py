@@ -47,11 +47,43 @@ Replica-side load shedding composes with routing: a replica that sheds
 re-routed and is put on a short admission backoff instead of being
 hammered while saturated.
 
+Elastic membership (ROADMAP item 4's remainder — this is what makes the
+fleet elastic UPWARD, not just shrink-on-death):
+
+* **Live join** — the router discovers registrations on every poll
+  (membership is never frozen at construction); a replica started
+  against a running fleet (:func:`scale_fleet`) registers, restores the
+  fleet's weight snapshot (``--snapshot-dir`` →
+  :class:`~tpudist.elastic.checkpoint.Checkpointer`), heartbeats, and
+  takes traffic.  First-poll members are the baseline; later
+  appearances tick ``router/joins``.
+* **Rolling weight hot-swap** — :func:`roll_weights` persists the new
+  weights to the snapshot dir (durability FIRST), then bumps
+  ``{ns}/weights/version``.  Each replica notices the bump, takes a
+  ticket (``add({ns}/weights/ticket/{v}, 1)``), and swaps only when the
+  done counter (``{ns}/weights/done/{v}``) shows every earlier ticket
+  finished — one replica at a time, so fleet capacity never drops by
+  more than one replica.  The swap itself is
+  :meth:`~tpudist.models.serving.ServeLoop.request_swap`: drain
+  in-flight decodes on the old weights, rebind, resume — zero lost or
+  version-straddling requests.  While swapping, the replica publishes
+  ``serve/swapping=1`` and the router steers admissions around it.  A
+  ticket-holder that DIES mid-chain would stall it forever; after
+  ``swap_turn_timeout_s`` a waiting replica proceeds anyway (liveness
+  over strict seriality — the race is only two replicas briefly
+  swapping at once).
+* **Router-side SLO admission** — a deadline-bearing request is SHED at
+  the router (``reason="shed"``, ``router/slo_shed``) when even the
+  best candidate's published ``serve/queue_wait_s`` percentile
+  (``slo_quantile``, default p99) predicts a miss — before the request
+  ever costs any replica a prefill.
+
 The fault-injection harness (:mod:`tpudist.runtime.faults`,
 ``TPUDIST_FAULT_*``) exercises all of this deterministically: coord-op
 errors/delays hit the retry paths, ``KILL_AFTER_SEGMENTS`` SIGKILLs a
 replica mid-decode, ``HEARTBEAT_STOP_AFTER_S`` fakes death without
-stopping the worker.
+stopping the worker, ``PUBLISH_DROP`` starves the obs plane so the
+health monitor's ``stale`` verdict steers routing without a death.
 """
 
 from __future__ import annotations
@@ -69,14 +101,15 @@ import numpy as np
 from tpudist import obs
 from tpudist.obs.aggregate import collect, MetricsPublisher
 from tpudist.obs.health import HealthMonitor
+from tpudist.obs.registry import hist_quantile
 from tpudist.runtime.coord import CoordClient, ElasticMonitor
 from tpudist.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 __all__ = ["Router", "ReplicaWorker", "build_tiny_lm",
-           "launch_local_fleet", "stop_fleet", "exit_reports",
-           "wait_live"]
+           "launch_local_fleet", "scale_fleet", "stop_fleet",
+           "exit_reports", "wait_live", "roll_weights", "wait_swapped"]
 
 DEFAULT_NAMESPACE = "fleet"
 
@@ -124,12 +157,27 @@ class ReplicaWorker:
     commits each completion to ``{ns}/done/{key}``.  On a clean exit an
     exit report (``{ns}/exit/{rid}``: served count, pool-drained flag)
     lets cross-process tests assert the no-orphaned-blocks invariant.
+
+    Elastic pieces (see the module docstring's protocol sketch):
+
+    * ``snapshot_dir`` — the fleet's shared weight snapshot.  At
+      construction the worker restores the latest committed checkpoint
+      (a JOINER starts on the fleet's current weights, keeping greedy
+      output exact-match); at a version bump it is what
+      ``restore_latest`` re-reads for the hot-swap.
+    * the source poll watches ``{ns}/weights/version`` and drives the
+      rolling one-at-a-time swap chain (ticket + done counter, turn
+      timeout for dead ticket-holders), gating the actual rebind
+      through ``loop.request_swap`` so in-flight decodes finish on the
+      weights that admitted them.
     """
 
     def __init__(self, loop, client: CoordClient, replica_id: str, *,
                  rank: int = 0, namespace: str = DEFAULT_NAMESPACE,
                  ttl_s: float = 2.0, publish_interval_s: float = 0.25,
-                 idle_wait_s: float = 0.01) -> None:
+                 idle_wait_s: float = 0.01,
+                 snapshot_dir: str | os.PathLike | None = None,
+                 swap_turn_timeout_s: float = 10.0) -> None:
         self.loop = loop
         self.client = client
         self.replica_id = replica_id
@@ -137,14 +185,35 @@ class ReplicaWorker:
         self.ns = namespace
         self.ttl_s = float(ttl_s)
         self.idle_wait_s = idle_wait_s
+        self.snapshot_dir = snapshot_dir
+        self.swap_turn_timeout_s = float(swap_turn_timeout_s)
         self._inbox = f"{namespace}/inbox/{replica_id}/"
         self._served = 0
+        self._weights_version = 0
+        self._roll: dict | None = None   # the in-progress swap-chain turn
+        self._obs_version = obs.gauge("serve/weights_version",
+                                      unit="version")
+        self._obs_swapping = obs.gauge("serve/swapping", unit="flag")
         self._hb = ElasticMonitor(client, f"{namespace}:{replica_id}",
                                   ttl_s=ttl_s,
                                   interval_s=max(ttl_s / 4, 0.05))
         self._pub = MetricsPublisher(client, self.rank, obs.registry,
                                      namespace=f"{namespace}/metrics",
                                      interval_s=publish_interval_s)
+        if snapshot_dir is not None:
+            got = self._restore_latest()
+            if got is not None:
+                step, tree, meta = got
+                import jax
+                import jax.numpy as jnp
+
+                self.loop.params = jax.tree.map(jnp.asarray, tree)
+                self._weights_version = int(
+                    (meta or {}).get("version", step))
+                log.info("replica %s: restored weights version %d from %s",
+                         replica_id, self._weights_version, snapshot_dir)
+        self._obs_version.set(self._weights_version)
+        self._obs_swapping.set(0)
 
     def register(self) -> None:
         info = {
@@ -160,14 +229,127 @@ class ReplicaWorker:
         self.client.set(f"{self.ns}/replica/{self.replica_id}",
                         json.dumps(info).encode())
 
+    # -- rolling weight hot-swap ------------------------------------------
+
+    def _restore_latest(self):
+        """``(step, tree, meta) | None`` from the fleet snapshot dir."""
+        from tpudist.elastic.checkpoint import Checkpointer
+
+        return Checkpointer(self.snapshot_dir,
+                            layout="steps").restore_latest(self.loop.params)
+
+    def _restore_params(self):
+        """The ``params_fn`` handed to ``request_swap``: the new tree,
+        or ``None`` (swap aborts, old weights stay) when the snapshot
+        is unreadable — a replica must not die over a failed roll."""
+        try:
+            got = self._restore_latest()
+        except Exception as e:  # noqa: BLE001 - torn write, fs error
+            log.warning("replica %s: weight restore failed (%s); "
+                        "keeping current weights", self.replica_id, e)
+            return None
+        return None if got is None else got[1]
+
+    def _finish_roll(self, version: int) -> None:
+        """``on_swapped``: the drain-gated rebind just landed (or was
+        aborted on a failed restore — either way this replica's TURN is
+        over).  Advance the done chain so the next ticket-holder goes,
+        and resume advertising for admissions."""
+        self._weights_version = int(version)
+        self._roll = None
+        self._obs_version.set(self._weights_version)
+        self._obs_swapping.set(0)
+        try:
+            self.client.add(f"{self.ns}/weights/done/{version}", 1)
+        except ConnectionError:
+            # peers fall back to their turn timeout; the chain still
+            # completes, just slower
+            log.warning("replica %s: could not advance swap done-chain "
+                        "for version %d", self.replica_id, version)
+        try:
+            self._pub.publish()   # the router unlearns `swapping` now
+        except Exception:  # noqa: BLE001
+            pass
+        log.info("replica %s: weights hot-swapped to version %d",
+                 self.replica_id, version)
+
+    def _check_weights_roll(self) -> None:
+        """One poll of the rolling-upgrade protocol.  Coord errors
+        abort the step (retried next poll); `add` is deliberately used
+        both to TAKE a ticket (+1) and to READ the done counter (+0).
+
+        One replica at a time: ticket ``t`` swaps when ``done >= t-1``.
+        A dead ticket-holder (SIGKILLed mid-chain — the "swap racing a
+        death" case) never advances ``done``; after
+        ``swap_turn_timeout_s`` of waiting this replica proceeds
+        anyway, trading strict seriality for liveness."""
+        if self._pending_roll_requested():
+            return
+        try:
+            raw = self.client.get(f"{self.ns}/weights/version")
+        except ConnectionError:
+            return
+        if raw is None:
+            return
+        try:
+            version = int(raw.decode())
+        except ValueError:
+            return
+        if version <= self._weights_version:
+            return
+        if self._roll is None:
+            try:
+                ticket = self.client.add(
+                    f"{self.ns}/weights/ticket/{version}", 1)
+            except ConnectionError:
+                return   # may or may not have taken one; see below
+            self._roll = {"version": version, "ticket": int(ticket),
+                          "since": time.monotonic(), "requested": False}
+            log.info("replica %s: weights version %d published; holding "
+                     "swap ticket %d", self.replica_id, version, ticket)
+        roll = self._roll
+        try:
+            done = int(self.client.add(
+                f"{self.ns}/weights/done/{roll['version']}", 0))
+        except ConnectionError:
+            return
+        waited = time.monotonic() - roll["since"]
+        if done < roll["ticket"] - 1 and waited <= self.swap_turn_timeout_s:
+            return   # an earlier ticket is still swapping
+        if waited > self.swap_turn_timeout_s and done < roll["ticket"] - 1:
+            log.warning(
+                "replica %s: swap chain for version %d stalled "
+                "(done=%d, ticket=%d) after %.1fs; proceeding "
+                "(a ticket-holder likely died)", self.replica_id,
+                roll["version"], done, roll["ticket"], waited)
+        roll["requested"] = True
+        # stop advertising for admissions BEFORE draining: the router
+        # steers around `swapping` replicas, so requests keep flowing
+        # to the rest of the fleet while this one rebinds
+        self._obs_swapping.set(1)
+        try:
+            self._pub.publish()
+        except Exception:  # noqa: BLE001
+            pass
+        self.loop.request_swap(
+            self._restore_params, version=roll["version"],
+            on_swapped=lambda v=roll["version"]: self._finish_roll(v))
+
+    def _pending_roll_requested(self) -> bool:
+        return self._roll is not None and self._roll["requested"]
+
     def _source(self):
         """One intake poll: ``None`` on a stop key (close and drain),
         else the inbox's requests in key order (the router's dispatch
-        order — its keys are zero-padded sequence numbers)."""
+        order — its keys are zero-padded sequence numbers).  Also the
+        tick of the rolling-swap protocol — it rides the same poll
+        cadence the loop already guarantees."""
         if (self.client.get(f"{self.ns}/stop") is not None
                 or self.client.get(
                     f"{self.ns}/stop/{self.replica_id}") is not None):
             return None
+        if self.snapshot_dir is not None:
+            self._check_weights_roll()
         out = []
         for key in sorted(self.client.keys(self._inbox)):
             raw = self.client.get(key)
@@ -213,6 +395,7 @@ class ReplicaWorker:
                     json.dumps({"replica": self.replica_id,
                                 "served": self._served,
                                 "pool_drained": self.pool_drained(),
+                                "weights_version": self._weights_version,
                                 "clean": clean}).encode())
             except Exception:
                 pass
@@ -240,6 +423,13 @@ class Router:
         published ``serve/rejected`` counter grew (it is shedding load).
       stale_after_s / lost_after_s: publish-age bounds handed to the
         health monitor (scaled for serve cadence, not training's).
+      slo_quantile: the ``serve/queue_wait_s`` percentile used for SLO
+        admission.  A deadline-bearing request is shed at the router
+        (``reason="shed"``) when even the BEST candidate's published
+        queue-wait at this quantile predicts the deadline is already
+        unmeetable — before the request costs any replica a prefill.
+        Replicas with no wait samples yet predict 0 (admit; the
+        replica-side deadline kill still bounds the damage).
     """
 
     def __init__(self, client: CoordClient, *,
@@ -249,12 +439,17 @@ class Router:
                  reject_backoff_s: float = 0.25,
                  stale_after_s: float = 3.0,
                  lost_after_s: float = 10.0,
+                 slo_quantile: float = 0.99,
                  use_health: bool = True) -> None:
         self.client = client
         self.ns = namespace
         self.poll_s = float(poll_s)
         self.max_redispatch = int(max_redispatch)
         self.reject_backoff_s = float(reject_backoff_s)
+        if not 0.0 < slo_quantile <= 1.0:
+            raise ValueError(
+                f"slo_quantile must be in (0, 1], got {slo_quantile}")
+        self.slo_quantile = float(slo_quantile)
         self._health = (HealthMonitor(
             client=client, namespace=f"{namespace}/metrics",
             signal="serve/queue_wait_s", skew_threshold=4.0,
@@ -262,6 +457,8 @@ class Router:
             confirm_n=2, recover_n=1) if use_health else None)
         self._seq = 0
         self._dead: set[str] = set()
+        self._known: set[str] | None = None  # live set at first poll +
+        #   every member seen since; later arrivals are JOINS
         self._backoff: dict[str, float] = {}           # rid -> until (mono)
         self._rejected_seen: dict[str, float] = {}     # rid -> watermark
         self._obs_requests = obs.counter("router/requests", unit="reqs")
@@ -274,6 +471,8 @@ class Router:
                                          unit="reqs")
         self._obs_deaths = obs.counter("router/replica_deaths",
                                        unit="replicas")
+        self._obs_joins = obs.counter("router/joins", unit="replicas")
+        self._obs_slo_shed = obs.counter("router/slo_shed", unit="reqs")
         self._obs_live = obs.gauge("router/replicas_live", unit="replicas")
         self._obs_outstanding = obs.gauge("router/outstanding", unit="reqs")
 
@@ -297,7 +496,9 @@ class Router:
 
     def loads(self, regs: dict[str, dict]) -> dict[str, dict]:
         """Published load per replica id: queue depth + free KV blocks
-        gauges and the lifetime queue-wait mean."""
+        gauges, the lifetime queue-wait mean and ``slo_quantile``
+        percentile (the SLO-admission predictor), the weights version,
+        and the mid-hot-swap flag."""
         rank_to_rid = {int(info.get("rank", -1)): rid
                        for rid, info in regs.items()}
         out: dict[str, dict] = {}
@@ -316,8 +517,14 @@ class Router:
                                    or {}).get("value"),
                 "queue_wait_mean": (wait["sum"] / wait["count"]
                                     if wait and wait["count"] else 0.0),
+                "queue_wait_q": (hist_quantile(wait, self.slo_quantile)
+                                 if wait and wait["count"] else 0.0),
                 "rejected": (counters.get("serve/rejected")
                              or {}).get("value") or 0.0,
+                "swapping": bool((gauges.get("serve/swapping")
+                                  or {}).get("value") or 0.0),
+                "weights_version": (gauges.get("serve/weights_version")
+                                    or {}).get("value"),
                 "age_s": snap.get("age_s"),
             }
         return out
@@ -425,6 +632,20 @@ class Router:
         live = self.live() - self._dead
         self._obs_live.set(len(live))
 
+        # live-join discovery: membership is re-read every poll, so a
+        # replica that registered after this router started (or even
+        # mid-run) takes traffic on the very next dispatch.  The first
+        # poll's live set is the baseline fleet, not a join.
+        if self._known is None:
+            self._known = set(live)
+        else:
+            joined = live - self._known
+            if joined:
+                self._known |= joined
+                self._obs_joins.inc(len(joined))
+                log.info("router: replica(s) %s joined the fleet",
+                         sorted(joined))
+
         # 1) consume completions FIRST: work a replica committed just
         # before dying must not be re-run
         done_prefix = f"{self.ns}/done/"
@@ -511,7 +732,11 @@ class Router:
                     unhealthy.add(rid)
         candidates = [rid for rid in sorted(live)
                       if rid not in self._backoff
-                      and rid not in unhealthy]
+                      and rid not in unhealthy
+                      # steer around a replica mid-hot-swap: it has
+                      # paused admission to drain; feeding it would just
+                      # park requests behind the rebind
+                      and not loads.get(rid, {}).get("swapping")]
         if candidates:
             assigned_counts: dict[str, int] = {}
             for e in entries.values():
@@ -519,6 +744,13 @@ class Router:
                     assigned_counts[e["assigned"]] = (
                         assigned_counts.get(e["assigned"], 0) + 1)
             wall = time.time()
+            # the SLO predictor: the best queue-wait any candidate
+            # advertises at the configured percentile — if even that
+            # replica would (probably) blow a request's deadline, no
+            # assignment can save it
+            best_wait = min(
+                (loads.get(rid, {}).get("queue_wait_q") or 0.0
+                 for rid in candidates), default=0.0)
             for k, e in entries.items():
                 if k in done or e["assigned"] is not None:
                     continue
@@ -527,6 +759,18 @@ class Router:
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32), reason="timeout"))
+                    progressed = True
+                    continue
+                if (req.deadline_s is not None and e["attempts"] == 0
+                        and wall + best_wait > req.deadline_s):
+                    # SLO admission: shed BEFORE any replica pays a
+                    # prefill.  Only ever on first dispatch — a request
+                    # already prefilled once (redispatch) is sunk cost
+                    # and races the deadline instead.
+                    self._obs_slo_shed.inc()
+                    complete(k, Completion(
+                        rid=req.rid, prompt=np.asarray(req.prompt),
+                        tokens=np.zeros((0,), np.int32), reason="shed"))
                     progressed = True
                     continue
                 rid = self._pick(candidates, loads, assigned_counts)
@@ -562,6 +806,30 @@ def build_tiny_lm(vocab: int = 64, layers: int = 2, heads: int = 4,
     return cfg, params
 
 
+def _spawn_replica(coord_addr: str, index: int, *,
+                   namespace: str,
+                   replica_args: Sequence[str] = (),
+                   env_extra: dict | None = None,
+                   platform: str = "cpu") -> subprocess.Popen:
+    """One replica worker subprocess (``replica-id r{index}``, rank
+    ``index``) — the shared spawn body of :func:`launch_local_fleet`
+    and :func:`scale_fleet`."""
+    host, port = coord_addr.rsplit(":", 1)
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                      else []))
+    env.setdefault("JAX_PLATFORMS", platform)
+    env.update({k: str(v) for k, v in (env_extra or {}).items()})
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpudist.runtime.router",
+         "--coord", f"{host}:{port}", "--replica-id", f"r{index}",
+         "--rank", str(index), "--namespace", namespace,
+         *replica_args],
+        env=env)
+
+
 def launch_local_fleet(coord_addr: str, n: int, *,
                        namespace: str = DEFAULT_NAMESPACE,
                        replica_args: Sequence[str] = (),
@@ -571,61 +839,164 @@ def launch_local_fleet(coord_addr: str, n: int, *,
     bench, CI, the example).  ``env_overrides[i]`` adds env vars to
     replica ``i`` — the fault-injection knobs go in this way, so a kill
     schedule hits exactly the replica the scenario names."""
-    host, port = coord_addr.rsplit(":", 1)
-    pkg_root = str(Path(__file__).resolve().parents[2])
-    procs = []
-    for i in range(n):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
-                          else []))
-        env.setdefault("JAX_PLATFORMS", platform)
-        env.update({k: str(v) for k, v in
-                    (env_overrides or {}).get(i, {}).items()})
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "tpudist.runtime.router",
-             "--coord", f"{host}:{port}", "--replica-id", f"r{i}",
-             "--rank", str(i), "--namespace", namespace,
-             *replica_args],
-            env=env))
-    return procs
+    return [_spawn_replica(coord_addr, i, namespace=namespace,
+                           replica_args=replica_args,
+                           env_extra=(env_overrides or {}).get(i),
+                           platform=platform)
+            for i in range(n)]
+
+
+def scale_fleet(coord_addr: str, n: int, *, start_index: int,
+                namespace: str = DEFAULT_NAMESPACE,
+                replica_args: Sequence[str] = (),
+                env_overrides: dict[int, dict] | None = None,
+                platform: str = "cpu") -> list[subprocess.Popen]:
+    """Scale a RUNNING fleet up by ``n`` joiner replicas (ids
+    ``r{start_index}..``, ranks to match — ranks key the metrics
+    namespace, so they must not collide with existing members, dead
+    ones included).  Joiners register against the live coordination
+    planes and the router admits them on its next poll; pass the same
+    ``--snapshot-dir`` the fleet was launched with so a joiner restores
+    the CURRENT weights (keeping greedy output exact-match with the
+    incumbents).  ``env_overrides`` is keyed by absolute index, as in
+    :func:`launch_local_fleet`."""
+    return [_spawn_replica(coord_addr, start_index + j,
+                           namespace=namespace,
+                           replica_args=replica_args,
+                           env_extra=(env_overrides or {}).get(
+                               start_index + j),
+                           platform=platform)
+            for j in range(n)]
 
 
 def stop_fleet(client: CoordClient, procs: Sequence[subprocess.Popen], *,
                namespace: str = DEFAULT_NAMESPACE,
-               timeout_s: float = 30.0) -> None:
-    """Set the fleet-wide stop key and reap the worker processes."""
+               timeout_s: float = 30.0) -> list[int]:
+    """Set the fleet-wide stop key, reap every worker, and return their
+    exit codes (in ``procs`` order).  Unexpected terminations are
+    SURFACED, not raised: a fault scenario's SIGKILLed replica is a
+    legitimate nonzero exit the caller asserts on, so this logs a
+    warning per casualty and leaves the verdict to the caller."""
     try:
         client.set(f"{namespace}/stop", b"1")
     except ConnectionError:
         pass
     deadline = time.monotonic() + timeout_s
+    codes: list[int] = []
     for p in procs:
         try:
             p.wait(timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
+            log.warning("stop_fleet: pid %d ignored the stop key for "
+                        "%.0fs; killing it", p.pid, timeout_s)
             p.kill()
             p.wait()
+        codes.append(p.returncode)
+        if p.returncode != 0:
+            log.warning("stop_fleet: pid %d exited with %d "
+                        "(negative = killed by that signal)",
+                        p.pid, p.returncode)
+    return codes
 
 
 def wait_live(client: CoordClient, n: int, *,
               namespace: str = DEFAULT_NAMESPACE,
-              timeout_s: float = 60.0) -> set[str]:
+              timeout_s: float = 60.0,
+              procs: Sequence[subprocess.Popen] | None = None) -> set[str]:
     """Block until ``n`` replicas hold heartbeat leases (fleet warm-up:
     replica startup is jax import + model compile, and routing before
     the fleet assembles concentrates all early requests on whichever
-    replica won the race).  Returns the live replica-id set."""
+    replica won the race).  Returns the live replica-id set.
+
+    Pass ``procs`` to FAIL FAST: a worker that exits before the fleet
+    assembles (bad args, import error) raises ``RuntimeError`` with its
+    exit code immediately instead of burning the whole timeout.  Either
+    way, the timeout diagnostic lists replicas that REGISTERED but hold
+    no lease — the registered-then-died shape that otherwise reads as
+    a silent hang."""
     mark = f"{namespace}:"
     deadline = time.monotonic() + timeout_s
+
+    def registered_not_live(live: set[str]) -> list[str]:
+        try:
+            prefix = f"{namespace}/replica/"
+            regs = {k[len(prefix):] for k in client.keys(prefix)}
+        except ConnectionError:
+            return []
+        return sorted(regs - live)
+
     while True:
         live = {name[len(mark):] for name in client.live()
                 if name.startswith(mark)}
         if len(live) >= n:
             return live
+        if procs is not None:
+            exited = [(p.pid, p.returncode) for p in procs
+                      if p.poll() is not None]
+            # a proc that exited may legitimately belong to an earlier,
+            # already-finished scenario only if the caller passed it;
+            # here any exit before assembly is fatal to the wait
+            if exited:
+                raise RuntimeError(
+                    f"fleet: worker(s) died before {n} replicas went "
+                    f"live: {[f'pid {pid} -> exit {rc}' for pid, rc in exited]} "
+                    f"(live: {sorted(live)}, registered-but-dead: "
+                    f"{registered_not_live(live)})")
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"fleet: only {sorted(live)} of {n} replicas live "
-                f"after {timeout_s:.0f}s")
+                f"after {timeout_s:.0f}s (registered-but-dead: "
+                f"{registered_not_live(live)})")
+        time.sleep(0.1)
+
+
+def roll_weights(client: CoordClient, snapshot_dir: str | os.PathLike,
+                 params, *, version: int,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 meta: dict | None = None) -> None:
+    """Publish a new weight version to a running fleet: persist
+    ``params`` to the shared snapshot dir (synchronously — DURABILITY
+    FIRST: no replica may learn of a version whose bytes are not yet
+    committed on disk), then bump ``{ns}/weights/version``, which every
+    replica's source poll watches.  The fleet then rolls one replica at
+    a time (the ticket chain in the module docstring); follow with
+    :func:`wait_swapped` to block until the roll completes."""
+    from tpudist.elastic.checkpoint import Checkpointer
+
+    m = {"version": int(version)}
+    if meta:
+        m.update(meta)
+    Checkpointer(snapshot_dir, layout="steps").save(
+        int(version), params, meta=m)
+    client.set(f"{namespace}/weights/version",
+               str(int(version)).encode())
+
+
+def wait_swapped(client: CoordClient, n: int, version: int, *,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 timeout_s: float = 60.0) -> set[int]:
+    """Block until ``n`` replicas publish ``serve/weights_version >=
+    version`` (the gauge each one bumps when its drain-gated rebind
+    lands).  Returns the swapped RANK set."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        swapped: set[int] = set()
+        try:
+            snaps = collect(client, f"{namespace}/metrics")
+        except ConnectionError:
+            snaps = {}
+        for rank, snap in snaps.items():
+            v = (snap.get("gauges", {}).get("serve/weights_version")
+                 or {}).get("value")
+            if v is not None and v >= version:
+                swapped.add(rank)
+        if len(swapped) >= n:
+            return swapped
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"fleet: only ranks {sorted(swapped)} of {n} replicas "
+                f"reached weights version {version} after "
+                f"{timeout_s:.0f}s")
         time.sleep(0.1)
 
 
@@ -680,6 +1051,15 @@ def main() -> None:  # pragma: no cover - subprocess entry point
                     help="0 = dense-capacity default")
     ap.add_argument("--max-queue", type=int, default=-1,
                     help="-1 = unbounded")
+    ap.add_argument("--snapshot-dir", default="",
+                    help="fleet weight snapshot dir (Checkpointer, "
+                         "layout=steps): restored at startup (joiners "
+                         "pick up the fleet's current weights) and on "
+                         "every weights/version bump (rolling hot-swap)")
+    ap.add_argument("--swap-turn-timeout", type=float, default=10.0,
+                    help="seconds to wait on earlier swap tickets "
+                         "before proceeding anyway (dead-holder "
+                         "liveness fallback)")
     args = ap.parse_args()
 
     from tpudist.models.serving import ServeLoop
@@ -701,7 +1081,9 @@ def main() -> None:  # pragma: no cover - subprocess entry point
     client = CoordClient(host, int(port))
     worker = ReplicaWorker(loop, client, args.replica_id,
                            rank=args.rank, namespace=args.namespace,
-                           ttl_s=args.ttl)
+                           ttl_s=args.ttl,
+                           snapshot_dir=args.snapshot_dir or None,
+                           swap_turn_timeout_s=args.swap_turn_timeout)
     log.info("replica %s (rank %d) serving on %s", args.replica_id,
              args.rank, args.namespace)
     worker.serve()
