@@ -56,7 +56,12 @@ from tpudist.models.generate import (
     serving_layout,
 )
 from tpudist.models.kv_pages import BlockPool
-from tpudist.models.speculative import _set_cache_index
+from tpudist.models.speculative import (
+    AdaptiveDraftPolicy,
+    _accept_and_next,
+    _filtered_probs,
+    _set_cache_index,
+)
 from tpudist.models.transformer import TransformerConfig, TransformerLM
 
 # placeholder page row for the dense layout's admit signature (the insert
@@ -118,6 +123,20 @@ def _index_leaves(cache: Any) -> tuple[jnp.ndarray, jnp.ndarray | None]:
     return main, side
 
 
+def _shift_index_leaves(cache: Any, delta, names) -> Any:
+    """Subtract ``delta`` from every index leaf named in ``names`` — the
+    speculative ROLLBACK: the verify chunk optimistically wrote K+1
+    tokens' K/V, and the accepted prefix kept only ``m + 1`` of them, so
+    the write cursor backs up by ``K - m`` and the next round's chunk
+    overwrites the rejected slots."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        return {k: (v - delta if k in names else walk(v))
+                for k, v in node.items()}
+    return walk(cache)
+
+
 class ServeLoop:
     """Continuous-batching server over one model.
 
@@ -170,6 +189,26 @@ class ServeLoop:
         complete immediately with ``reason="rejected"`` and tick the
         ``serve/rejected`` counter, which a router reads to back off a
         saturated replica instead of piling more work on it.
+      decode_mode: "plain" (one model step per generated token) or
+        "speculative" — the fused segment runs draft-K proposal +
+        one-chunk target verification per round
+        (:mod:`tpudist.models.speculative` folded into the serve loop),
+        emitting up to K+1 tokens per target forward.  Output follows
+        the TARGET's distribution exactly (greedy: exact-match against
+        plain decode); weight hot-swaps rebind the target only — the
+        draft may lag a version, which costs acceptance, never
+        exactness.
+      draft_cfg / draft_params: the proposal model (speculative only).
+        ``vocab_size`` must match the target and ``max_seq_len`` must
+        cover the target's (the draft cache mirrors each lane's
+        position); it is normalized via :func:`serving_layout` like the
+        target.  The draft always decodes DENSE per-row (its cache is
+        small by construction; paging it would buy nothing).
+      num_draft: draft tokens per verify round — a fixed int, or
+        "adaptive" (default) to let :class:`AdaptiveDraftPolicy` pick
+        from ``spec_ladder`` using the observed acceptance rate and
+        measured per-round costs (each ladder K compiles once).
+      spec_ladder: candidate K values for the adaptive policy.
     """
 
     def __init__(
@@ -193,6 +232,11 @@ class ServeLoop:
         kv_block_size: int = 128,
         kv_num_blocks: int | None = None,
         max_queue: int | None = None,
+        decode_mode: str = "plain",
+        draft_cfg: TransformerConfig | None = None,
+        draft_params: Any = None,
+        num_draft: int | str = "adaptive",
+        spec_ladder: Sequence[int] = (2, 4, 8),
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -240,7 +284,54 @@ class ServeLoop:
                 "whole cache instead of ~window positions",
                 stacklevel=2)
         self._select = _make_select(temperature, top_k, top_p)
+        self._temperature = float(temperature)
+        self._top_k, self._top_p = top_k, top_p
         self._key = key if key is not None else jax.random.key(0)
+        if decode_mode not in ("plain", "speculative"):
+            raise ValueError(
+                f"decode_mode must be 'plain' or 'speculative', got "
+                f"{decode_mode!r}")
+        self.decode_mode = decode_mode
+        if decode_mode == "speculative":
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "decode_mode='speculative' needs draft_cfg and "
+                    "draft_params")
+            if auto_unstack:
+                draft_cfg, draft_params = serving_layout(
+                    draft_cfg, draft_params)
+            if draft_cfg.scan_layers:
+                raise ValueError(
+                    "the draft needs the unrolled layout; pass the "
+                    "scanned checkpoint with auto_unstack=True")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}")
+            if draft_cfg.max_seq_len < cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < target "
+                    f"{cfg.max_seq_len}: the draft cache mirrors each "
+                    "lane's position, so it needs the same coverage")
+            if isinstance(num_draft, int):
+                if num_draft < 1:
+                    raise ValueError(
+                        f"num_draft must be >= 1, got {num_draft}")
+                self._spec_ladder = (int(num_draft),)
+            elif num_draft == "adaptive":
+                self._spec_ladder = tuple(sorted(
+                    int(x) for x in spec_ladder))
+                if not self._spec_ladder or self._spec_ladder[0] < 1:
+                    raise ValueError(
+                        f"spec_ladder must hold K >= 1, got {spec_ladder}")
+            else:
+                raise ValueError(
+                    f"num_draft must be an int or 'adaptive', got "
+                    f"{num_draft!r}")
+            self._k_max = self._spec_ladder[-1]
+        else:
+            self._spec_ladder = ()
+            self._k_max = 0
         # SIDE-BUFFER mode (flash, no window): steps write a segment-
         # local buffer at a SCALAR index (XLA keeps those in place;
         # per-row-indexed main-cache writes measured +0.35 ms/step on the
@@ -248,8 +339,11 @@ class ServeLoop:
         # main.  Other configurations use the direct per-row writes.
         # the paged layout is sided UNCONDITIONALLY: the pool is frozen
         # within a segment (growth happens at dispatch boundaries), so
-        # every in-segment token must stage in the side buffer
-        self.side = (steps_per_sync
+        # every in-segment token must stage in the side buffer.
+        # Speculative mode needs K_max extra slots: the last round before
+        # the emit count reaches steps_per_sync can still write a full
+        # K+1-token verify chunk past the steps_per_sync-1 already kept.
+        self.side = (steps_per_sync + self._k_max
                      if (decode_attention == "flash"
                          and cfg.attention_window is None)
                      or cache_layout == "paged" else 0)
@@ -292,6 +386,26 @@ class ServeLoop:
         if self.side:
             self.cache = self._with_side_buffers(self.cache)
         self._blank1 = _blank_cache(self._prefill_model, 1)  # prefill cache
+        if decode_mode == "speculative":
+            self.draft_cfg = draft_cfg
+            self.draft_params = draft_params
+            # the draft decodes DENSE per-row: verify chunks and single
+            # steps both go through the banded-mask path, so the CPU
+            # bench pays ONE masked matmul per draft step, and its cache
+            # is num_slots x draft_seq_len — small by construction
+            self.draft_model = TransformerLM(draft_cfg, decode=True,
+                                             decode_attention="dense")
+            d_blank = _blank_cache(self.draft_model, num_slots)
+            self.draft_cache = jax.tree.map(
+                lambda leaf: (jnp.zeros((num_slots,), jnp.int32)
+                              if leaf.ndim == 0 else leaf), d_blank)
+            self._draft_blank1 = _blank_cache(self.draft_model, 1)
+            self._spec_policy = (
+                AdaptiveDraftPolicy(self._spec_ladder)
+                if num_draft == "adaptive" else None)
+            # per-K dispatch counts: the first dispatch at each K carries
+            # its compile, so its timing is excluded from the cost model
+            self._spec_uses: dict[int, int] = {}
         self._tok = jnp.full((num_slots,), self.pad_token, jnp.int32)
         self._active = jnp.zeros((num_slots,), bool)
         self._remaining = jnp.zeros((num_slots,), jnp.int32)
@@ -329,6 +443,22 @@ class ServeLoop:
         self._obs_swaps = obs.counter("serve/swaps", unit="swaps")
         self._obs_weights_version = obs.gauge("serve/weights_version",
                                               unit="version")
+        # RTT-amortization observability: dispatches counts host round
+        # trips, steps_per_dispatch is the tokens the last drained
+        # dispatch generated — their ratio is the amortization factor
+        # the router merges per replica
+        self._obs_dispatches = obs.counter("serve/dispatches",
+                                           unit="dispatches")
+        self._obs_steps_per_dispatch = obs.gauge("serve/steps_per_dispatch",
+                                                 unit="tokens")
+        self._obs_spec_k = obs.gauge("serve/spec_k", unit="tokens")
+        self._obs_spec_accept = obs.gauge("serve/spec_accept_rate",
+                                          unit="ratio")
+        # EMA of measured seconds per generated token (dispatch -> drain
+        # wall time / tokens; an OVERestimate under pipelining, which
+        # only clamps harder) — feeds the deadline-aware segment-length
+        # clamp in _plan_steps
+        self._step_ema: float | None = None
         # donate every rebound carry: cache, tok, active, remaining, key
         # (argnums 2-4 and 6) mirror _admit_dev — their inputs are dead
         # the moment the segment returns replacements.  `first` (argnum 5)
@@ -346,6 +476,18 @@ class ServeLoop:
         # device work without touching live state
         self._prefill_one = jax.jit(self._prefill_impl,
                                     static_argnames=("true_chunk",))
+        if decode_mode == "speculative":
+            # num_draft is STATIC (the draft scan's length is a shape);
+            # each ladder K compiles once.  first (argnum 7) is NOT
+            # donated, as in the plain segment.
+            self._segment_spec = jax.jit(
+                self._segment_spec_impl,
+                donate_argnums=(2, 3, 4, 5, 6, 8),
+                static_argnames=("num_draft",))
+            self._admit_dev_spec = jax.jit(
+                self._admit_dev_spec_impl,
+                donate_argnums=(2, 3, 4, 5, 6, 7),
+                static_argnames=("true_chunk",))
 
     def _with_side_buffers(self, cache):
         def walk(node):
@@ -393,13 +535,23 @@ class ServeLoop:
     # -- compiled pieces ---------------------------------------------------
 
     def _segment_impl(self, params, cache, tok, active, remaining, first,
-                      key):
+                      key, n_steps):
+        """One fused multi-token segment: a ``lax.while_loop`` of up to
+        ``n_steps`` decode ticks (``n_steps`` is a DYNAMIC arg — the
+        deadline clamp in :meth:`_plan_steps` shortens segments without
+        recompiling) that EXITS EARLY once every lane is frozen, so an
+        almost-idle batch never pays full-length segments.  The emit
+        buffer is fixed at ``steps_per_sync`` columns (pad-filled past
+        ``n_steps``); the host slices to the dispatched length."""
         stop_arr = self._stop
         pad = jnp.int32(self.pad_token)
         S = self.cfg.max_seq_len
 
-        def step(carry, _):
-            cache, tok, active, remaining, lived, key = carry
+        def cond(carry):
+            return (carry[0] < n_steps) & jnp.any(carry[3])
+
+        def step(carry):
+            i, cache, tok, active, remaining, lived, key, E = carry
             main_idx, side_idx = _index_leaves(cache)
             pos = main_idx if side_idx is None else main_idx + side_idx
             pos = jnp.minimum(pos, S - 1)
@@ -412,27 +564,30 @@ class ServeLoop:
             key, sk = jax.random.split(key)
             nxt = self._select(logits[:, -1], sk).astype(jnp.int32)
             emit = jnp.where(active, nxt, pad)
+            E = lax.dynamic_update_slice(E, emit[:, None], (0, i))
             remaining = remaining - active.astype(jnp.int32)
             hit_stop = (jnp.isin(nxt, stop_arr)
                         if stop_arr is not None
                         else jnp.zeros_like(active))
             active = active & ~hit_stop & (remaining > 0)
             tok = jnp.where(active, nxt, pad)
-            return (mut["cache"], tok, active, remaining, lived, key), emit
+            return (i + 1, mut["cache"], tok, active, remaining, lived,
+                    key, E)
 
         lived0 = jnp.zeros((self.B,), jnp.int32)
-        (cache, tok, active, remaining, lived, key), emits = lax.scan(
-            step, (cache, tok, active, remaining, lived0, key), None,
-            length=self.steps)
+        E0 = jnp.full((self.B, self.steps), pad, jnp.int32)
+        (_, cache, tok, active, remaining, lived, key, E) = lax.while_loop(
+            cond, step,
+            (jnp.int32(0), cache, tok, active, remaining, lived0, key, E0))
         if self.side:
             # side -> main merge INSIDE the segment executable: one
             # dispatch per wave instead of two (each dispatch costs
             # multiple ms through the dev tunnel), and XLA can overlap
-            # the merge with the tail of the scan
+            # the merge with the tail of the loop
             cache = self._merge_impl(cache, lived)
         # column 0 carries the admission-deferred first tokens so ONE
         # host fetch resolves them together with the segment's emits
-        emits = jnp.concatenate([first[:, None], emits.T], axis=1)
+        emits = jnp.concatenate([first[:, None], E], axis=1)
         return cache, tok, active, remaining, key, emits
 
     def _prefill_impl(self, params, prompt_padded, true_len, key,
@@ -515,6 +670,157 @@ class ServeLoop:
         remaining = remaining.at[slot].set(max_new - 1)
         first_buf = first_buf.at[slot].set(first)
         return cache, tok, active, remaining, first_buf
+
+    def _admit_dev_spec_impl(self, params, draft_params, cache, d_cache,
+                             tok, active, remaining, first_buf,
+                             prompt_padded, true_len, slot, max_new, pages,
+                             key, *, true_chunk):
+        """Speculative admission: the target's admit (prefill + insert +
+        lane stamps) plus a DRAFT prefill of the same prompt inserted
+        into the draft cache's matching slot — both in the same dispatch,
+        still no host sync."""
+        cache, tok, active, remaining, first_buf = self._admit_dev_impl(
+            params, cache, tok, active, remaining, first_buf,
+            prompt_padded, true_len, slot, max_new, pages, key,
+            true_chunk=true_chunk)
+        d1, _ = _prefill(self.draft_model, draft_params,
+                         self._draft_blank1, prompt_padded, true_chunk)
+        d1 = _set_cache_index(d1, true_len)
+        d_cache = self._insert_impl(d_cache, d1, slot, true_len, _NO_PAGES)
+        return cache, d_cache, tok, active, remaining, first_buf
+
+    def _segment_spec_impl(self, params, draft_params, cache, d_cache,
+                           tok, active, remaining, first, key, n_steps,
+                           *, num_draft):
+        """The speculative fused segment: rounds of draft-K proposal +
+        one-chunk target verification (``lax.while_loop``) until at
+        least ``n_steps`` tokens are emitted or every lane freezes.
+
+        Each round mirrors :func:`speculative_generate.round_body`, made
+        lane-aware:
+
+        * the draft runs K+1 per-row single-token steps (the last writes
+          d_K's K/V); the target verifies ``[tok, d_1..d_K]`` as ONE
+          s=K+1 chunk through the per-row cache path;
+        * frozen lanes are masked ALL-ACCEPT in ``_accept_and_next`` so
+          they never drag the batch-min prefix down — their garbage
+          emits are padded out and their K/V writes are dropped by the
+          ``lived``-masked merge exactly as in the plain segment;
+        * both caches ROLL BACK by ``K - m`` after the verify (the side
+          counter in sided/paged layouts, per-row ``cache_index`` in the
+          dense non-sided layout) so resident K/V tracks emitted tokens
+          — the invariant the segment-boundary merge and the host's
+          page-growth accounting both rely on;
+        * a lane that hits a stop or exhausts its budget mid-round
+          contributes ``min(stop_pos + 1, remaining)`` REAL tokens (the
+          same count the host's drain rules will consume) to ``lived``
+          and freezes.
+
+        ``stats`` returns ``[emitted, rounds, accepted_sum,
+        active_row_rounds]`` — the host feeds them to the adaptive-K
+        policy and the ``serve/spec_*`` gauges."""
+        stop_arr = self._stop
+        pad = jnp.int32(self.pad_token)
+        S = self.cfg.max_seq_len
+        Sd = self.draft_cfg.max_seq_len
+        k = num_draft
+        # the last round can start at emitted == n_steps - 1 and still
+        # append a full K+1 window, so the emit buffer needs K extra
+        # columns past steps_per_sync
+        cap_out = self.steps + k
+        t_idx = jnp.arange(k + 1)
+
+        def cond(carry):
+            return (carry[0] < n_steps) & jnp.any(carry[5])
+
+        def round_body(carry):
+            (com, cache, d_cache, tok, active, remaining, lived, key, E,
+             rounds, acc_sum, act_rounds) = carry
+            main_idx, side_idx = _index_leaves(cache)
+            n_pos = main_idx if side_idx is None else main_idx + side_idx
+            key, dk, vk = jax.random.split(key, 3)
+
+            # DRAFT: K single-token proposals with their distributions;
+            # K+1 steps so the last writes d_K's K/V (the all-accepted
+            # case needs it resident), its sampled output discarded
+            def chain(chain_carry, step_key):
+                d_cache, d_tok = chain_carry
+                d_idx, _ = _index_leaves(d_cache)
+                logits, mut = self.draft_model.apply(
+                    {"params": draft_params, "cache": d_cache},
+                    d_tok[:, None],
+                    positions=jnp.minimum(d_idx, Sd - 1)[:, None],
+                    mutable=["cache"])
+                q_probs = _filtered_probs(
+                    logits[:, -1], self._temperature, self._top_k,
+                    self._top_p)
+                nxt = self._select(logits[:, -1],
+                                   step_key).astype(jnp.int32)
+                return (mut["cache"], nxt), (nxt, q_probs)
+
+            (d_cache2, _), (drafts_t, q_t) = lax.scan(
+                chain, (d_cache, tok), jax.random.split(dk, k + 1))
+            drafts = drafts_t[:k].T                            # [B, K]
+            q = jnp.moveaxis(q_t[:k], 0, 1)                    # [B, K, V]
+
+            # VERIFY: one target chunk over [tok, d_1..d_K] per lane
+            verify = jnp.concatenate([tok[:, None], drafts], axis=1)
+            positions = jnp.minimum(
+                n_pos[:, None] + t_idx[None, :], S - 1)
+            t_logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, verify,
+                positions=positions, mutable=["cache"])
+            p = _filtered_probs(t_logits, self._temperature, self._top_k,
+                                self._top_p)
+            m, emit, accepted = _accept_and_next(p, q, drafts, vk,
+                                                 active=active)
+            names = {"side_index"} if self.side else {"cache_index"}
+            cache2 = _shift_index_leaves(mut["cache"], k - m, names)
+            d_cache2 = _shift_index_leaves(d_cache2, k - m,
+                                           {"cache_index"})
+
+            # the round's emit window: accepted drafts then the verify
+            # token at column m (columns past m are garbage the host's
+            # slice/stop rules never consume)
+            e_buf = jnp.concatenate([drafts, emit[:, None]], axis=1)
+            e_buf = lax.dynamic_update_slice(e_buf, emit[:, None], (0, m))
+            hit = (jnp.isin(e_buf, stop_arr) if stop_arr is not None
+                   else jnp.zeros(e_buf.shape, bool))
+            stop_pos = jnp.min(
+                jnp.where(hit & (t_idx[None, :] <= m), t_idx[None, :],
+                          m + 1), axis=1)                      # [B]
+            no_stop = stop_pos >= m + 1
+            # REAL tokens this round = what the host's drain will
+            # consume: up to the stop (inclusive), capped by budget and
+            # the batch-min window — identical to plain-mode `lived`
+            real = jnp.where(
+                active,
+                jnp.minimum(jnp.minimum(stop_pos + 1, remaining), m + 1),
+                0)
+            lived = lived + real
+            remaining = remaining - real
+            active2 = active & no_stop & (remaining > 0)
+            cols = jnp.where(t_idx <= m, com + t_idx, cap_out)
+            E = E.at[:, cols].set(
+                jnp.where(active[:, None], e_buf, pad), mode="drop")
+            tok2 = jnp.where(active2, emit, pad)
+            return (com + m + 1, cache2, d_cache2, tok2, active2,
+                    remaining, lived, key, E, rounds + 1,
+                    acc_sum + jnp.sum(jnp.where(active, accepted, 0)),
+                    act_rounds + jnp.sum(active.astype(jnp.int32)))
+
+        lived0 = jnp.zeros((self.B,), jnp.int32)
+        E0 = jnp.full((self.B, cap_out), pad, jnp.int32)
+        (com, cache, d_cache, tok, active, remaining, lived, key, E,
+         rounds, acc_sum, act_rounds) = lax.while_loop(
+            cond, round_body,
+            (jnp.int32(0), cache, d_cache, tok, active, remaining,
+             lived0, key, E0, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+        if self.side:
+            cache = self._merge_impl(cache, lived)
+        emits = jnp.concatenate([first[:, None], E], axis=1)
+        stats = jnp.stack([com, rounds, acc_sum, act_rounds])
+        return cache, d_cache, tok, active, remaining, key, emits, stats
 
     def _merge_impl(self, cache, lived):
         """End-of-segment: scatter each layer's side buffer into the main
@@ -620,6 +926,15 @@ class ServeLoop:
             raise ValueError(
                 f"request needs {prompt.size + req.max_new_tokens} cache "
                 f"slots > max_seq_len {self.cfg.max_seq_len}")
+        if (self.decode_mode == "speculative"
+                and prompt.size + req.max_new_tokens + self._k_max - 1
+                > self.cfg.max_seq_len):
+            raise ValueError(
+                f"speculative serving needs prompt + max_new + "
+                f"num_draft - 1 <= max_seq_len "
+                f"({prompt.size + req.max_new_tokens + self._k_max - 1} "
+                f"> {self.cfg.max_seq_len}): the verify chunk writes up "
+                "to num_draft slots past the last emitted token")
         if self.pool is not None:
             need = self.pool.request_blocks(prompt.size, req.max_new_tokens)
             if need > self.pool.num_blocks:
@@ -655,13 +970,56 @@ class ServeLoop:
         padded = np.full((1, Lp), self.pad_token, np.int32)
         padded[0, :L] = prompt
         self._key, pk = jax.random.split(self._key)
-        (self.cache, self._tok, self._active, self._remaining,
-         self._first) = self._admit_dev(
-            self.params, self.cache, self._tok, self._active,
-            self._remaining, self._first, padded, np.int32(L),
-            np.int32(slot), np.int32(req.max_new_tokens), pages, pk,
-            true_chunk=chunk)
+        if self.decode_mode == "speculative":
+            (self.cache, self.draft_cache, self._tok, self._active,
+             self._remaining, self._first) = self._admit_dev_spec(
+                self.params, self.draft_params, self.cache,
+                self.draft_cache, self._tok, self._active,
+                self._remaining, self._first, padded, np.int32(L),
+                np.int32(slot), np.int32(req.max_new_tokens), pages, pk,
+                true_chunk=chunk)
+        else:
+            (self.cache, self._tok, self._active, self._remaining,
+             self._first) = self._admit_dev(
+                self.params, self.cache, self._tok, self._active,
+                self._remaining, self._first, padded, np.int32(L),
+                np.int32(slot), np.int32(req.max_new_tokens), pages, pk,
+                true_chunk=chunk)
         return {"req": req, "tokens": [], "pending_first": True}
+
+    def _plan_steps(self, slot_state) -> int:
+        """Per-dispatch segment length: ``steps_per_sync``, CLAMPED
+        against the tightest live in-flight deadline so a timeout is
+        detected within ~one token of expiry instead of up to a full
+        fixed-length segment late.  Uses the measured per-token EMA
+        (``None`` until the first dispatch drains — the first segment
+        runs full-length, which matches the old behavior)."""
+        if self._step_ema is None or self._step_ema <= 0:
+            return self.steps
+        tightest = None
+        for st in slot_state:
+            if st is None or st.get("zombie"):
+                continue
+            dl = st["req"].deadline_s
+            if dl is not None and (tightest is None or dl < tightest):
+                tightest = dl
+        if tightest is None:
+            return self.steps
+        slack = tightest - self._clock()
+        if slack <= self._step_ema:
+            return 1
+        return max(1, min(self.steps, int(slack / self._step_ema)))
+
+    def _spec_k(self, live: int) -> int:
+        """The round's draft length: the fixed ``num_draft`` or the
+        adaptive policy's pick at the current live-lane count
+        (``allow_plain=False`` — inside the fused segment a K=0 round
+        does not exist; the break-even fallback is choosing the smallest
+        ladder K)."""
+        if self._spec_policy is None:
+            return self._spec_ladder[0]
+        return int(self._spec_policy.best_k(batch=max(live, 1),
+                                            allow_plain=False))
 
     def request_swap(self, params_fn, *, version: int | None = None,
                      on_swapped=None) -> None:
@@ -738,7 +1096,8 @@ class ServeLoop:
         pending: deque[tuple[Request, float]] = deque()
         slot_state: list[dict | None] = [None] * self.B
         done: list[Completion] = []
-        inflight: deque[tuple[int, jax.Array]] = deque()
+        # (seq, emits, stats|None, n_steps, k, t_dispatch)
+        inflight: deque[tuple] = deque()
         seq = 0   # segments dispatched so far == index of the next one
         closed = source is None
 
@@ -937,34 +1296,55 @@ class ServeLoop:
             """Chain one more segment on device and start its emits'
             async device→host copy — no host block."""
             nonlocal seq
+            n = self._plan_steps(slot_state)
+            live = sum(1 for st in slot_state
+                       if st is not None and not st.get("zombie"))
+            k = (self._spec_k(live)
+                 if self.decode_mode == "speculative" else 0)
             if self.pool is not None:
                 # grow-on-decode-boundary: advance every live lane's page
                 # coverage by the segment's worst case (drawn from its
                 # admit-time reservation, so this cannot fail), then
                 # stamp the fresh table into the carry this segment
-                # consumes.  Lanes already frozen on device (host hasn't
-                # drained the stop yet) grow harmlessly within their
-                # reservation and refund it at finalize.  Zombie lanes
-                # are dead (their reservation was dropped at finalize);
-                # their held blocks just wait for the refund.
+                # consumes.  Speculative segments can emit up to n + k
+                # tokens (the last round's full K+1 window).  Lanes
+                # already frozen on device (host hasn't drained the stop
+                # yet) grow harmlessly within their reservation and
+                # refund it at finalize.  Zombie lanes are dead (their
+                # reservation was dropped at finalize); their held
+                # blocks just wait for the refund.
                 for slot in range(self.B):
                     st = slot_state[slot]
                     if st is not None and not st.get("zombie"):
-                        self.pool.grow(slot, self.steps)
+                        self.pool.grow(slot, n + k)
                 self._stamp_table()
             # the segment splits per-step keys and returns the advanced
             # key — no per-wave host-side split dispatch needed
-            with obs.span("serve/segment", steps=self.steps, seq=seq):
-                (self.cache, self._tok, self._active, self._remaining,
-                 self._key, emits) = self._segment(
-                    self.params, self.cache, self._tok, self._active,
-                    self._remaining, self._first, self._key)
+            t_disp = time.perf_counter()
+            with obs.span("serve/segment", steps=n, seq=seq):
+                if self.decode_mode == "speculative":
+                    (self.cache, self.draft_cache, self._tok,
+                     self._active, self._remaining, self._key, emits,
+                     stats) = self._segment_spec(
+                        self.params, self.draft_params, self.cache,
+                        self.draft_cache, self._tok, self._active,
+                        self._remaining, self._first, self._key,
+                        jnp.int32(n), num_draft=k)
+                    self._obs_spec_k.set(k)
+                else:
+                    stats = None
+                    (self.cache, self._tok, self._active,
+                     self._remaining, self._key, emits) = self._segment(
+                        self.params, self.cache, self._tok, self._active,
+                        self._remaining, self._first, self._key,
+                        jnp.int32(n))
             self._obs_segments.inc()
+            self._obs_dispatches.inc()
             try:
                 emits.copy_to_host_async()
             except AttributeError:  # non-jax array (test doubles)
                 pass
-            inflight.append((seq, emits))
+            inflight.append((seq, emits, stats, n, k, t_disp))
             seq += 1
             self._obs_depth.set(len(inflight))
             # fault harness: a configured kill-after-K-segments SIGKILLs
@@ -975,19 +1355,58 @@ class ServeLoop:
             """Resolve the oldest in-flight segment: block on its fetch
             (usually already landed — the copy overlapped later compute),
             then feed every lane whose stamp says this segment carries
-            its tokens."""
-            s_idx, emits_dev = inflight.popleft()
+            its tokens.  Plain segments carry exactly ``n`` emit columns
+            past the deferred-first column; speculative ones carry
+            ``stats[0]`` (the emitted count) — either way the drain
+            slices to the real width so pad columns past a short segment
+            are never consumed."""
+            s_idx, emits_dev, stats_dev, n_disp, k_disp, t_disp = (
+                inflight.popleft())
             self._obs_depth.set(len(inflight))
             if any(st is not None and not st.get("zombie")
                    and st["seq"] <= s_idx for st in slot_state):
                 t0 = time.perf_counter()
                 emits = np.asarray(emits_dev)
+                stats = (np.asarray(stats_dev)
+                         if stats_dev is not None else None)
                 self._obs_host_wait.record(time.perf_counter() - t0)
+                n_tok = n_disp if stats is None else int(stats[0])
+                dt = time.perf_counter() - t_disp
+                if n_tok > 0:
+                    # dispatch->drain wall time per token; under
+                    # pipelining this spans overlapped segments, so it
+                    # OVERestimates — which only makes the deadline
+                    # clamp more conservative
+                    per = dt / n_tok
+                    self._step_ema = (
+                        per if self._step_ema is None
+                        else 0.7 * self._step_ema + 0.3 * per)
+                self._obs_steps_per_dispatch.set(n_tok)
+                if stats is not None:
+                    rounds = int(stats[1])
+                    act_rounds = int(stats[3])
+                    if act_rounds > 0 and k_disp > 0:
+                        self._obs_spec_accept.set(
+                            float(stats[2]) / (act_rounds * k_disp))
+                        if self._spec_policy is not None:
+                            self._spec_policy.update(
+                                {"rounds": act_rounds,
+                                 "draft_accepted": int(stats[2])},
+                                batch=1, num_draft=k_disp)
+                    if self._spec_policy is not None and rounds > 0:
+                        # skip each K's first dispatch: its wall time is
+                        # compile-polluted and would poison the measured
+                        # cost model
+                        if self._spec_uses.get(k_disp, 0) >= 1:
+                            self._spec_policy.observe_round_cost(
+                                k_disp, dt / rounds)
+                        self._spec_uses[k_disp] = (
+                            self._spec_uses.get(k_disp, 0) + 1)
                 for slot in range(self.B):
                     st = slot_state[slot]
                     if (st is not None and not st.get("zombie")
                             and st["seq"] <= s_idx):
-                        drain(slot, emits[slot])
+                        drain(slot, emits[slot, :1 + n_tok])
             # zombie refund: every segment dispatched before the kill
             # (index < free_at) has drained once s_idx reaches
             # free_at - 1 — no stale merge can touch the blocks now

@@ -77,7 +77,7 @@ def _filtered_probs(logits: jnp.ndarray, temperature: float,
 
 
 def _accept_and_next(p: jnp.ndarray, q: jnp.ndarray, draft: jnp.ndarray,
-                     key: jax.Array):
+                     key: jax.Array, active: Optional[jnp.ndarray] = None):
     """The speculative accept/advance rule for one round, batched.
 
     Args:
@@ -89,6 +89,10 @@ def _accept_and_next(p: jnp.ndarray, q: jnp.ndarray, draft: jnp.ndarray,
         distribution draft token ``draft[:, j]`` was sampled from.
       draft: ``[B, K]`` proposed tokens.
       key: randomness for accept tests and residual resampling.
+      active: optional ``[B]`` bool — FROZEN rows (finished lanes inside
+        a fused serve segment) count as all-accept so they never drag the
+        batch-min ``m`` down for live rows; their emit is discarded by
+        the caller's lane masks.
 
     Returns ``(m, emit, accepted)``: the batch-min accepted prefix
     length ``m`` (scalar int32, 0..K), the ``[B]`` token to emit at
@@ -114,6 +118,8 @@ def _accept_and_next(p: jnp.ndarray, q: jnp.ndarray, draft: jnp.ndarray,
     # Greedy (one-hot p/q) reduces to: accept iff the draft token IS the
     # target argmax — p_at_draft is 1 or 0 and u < 1 almost surely.
     ok = u * jnp.maximum(q_at_draft, 1e-20) < p_at_draft     # [B, K]
+    if active is not None:
+        ok = ok | ~active[:, None]
     cum_ok = jnp.cumprod(ok.astype(jnp.int32), axis=1)
     accepted = jnp.sum(cum_ok, axis=1)                       # [B] in 0..K
     m = jnp.min(accepted)

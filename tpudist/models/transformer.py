@@ -338,9 +338,10 @@ class CausalSelfAttention(nn.Module):
             # PER-ROW cache positions (vector cache_index [B]) — the
             # continuous-batching serve mode: every slot decodes at its
             # own length (tpudist.models.serving swaps the scalar index
-            # leaves for vectors when building the slot cache).  One
-            # token per call only; prefill runs per-slot through a
-            # scalar-index side cache and is INSERTED (serving._insert).
+            # leaves for vectors when building the slot cache).  s == 1
+            # is the decode step, s > 1 the speculative verify chunk;
+            # prefill runs per-slot through a scalar-index side cache
+            # and is INSERTED (serving._insert).
             return self._serve_attend(
                 q, k, v, cached_k, cached_v, idx_var)
         k_all = jax.lax.dynamic_update_slice(
@@ -396,14 +397,15 @@ class CausalSelfAttention(nn.Module):
         live positions (:meth:`_serve_attend_sided`); the ServeLoop
         scatters side → main once per segment (amortized to ~nothing).
         ``serve_side_slots == 0`` keeps the direct per-row-write path
-        (simple, correct, slower)."""
+        (simple, correct, slower).
+
+        ``s > 1`` is the speculative VERIFY CHUNK inside a fused serve
+        segment: row ``r``'s ``s`` tokens land at its own
+        ``idx[r]..idx[r]+s-1`` and query ``j`` attends causally over the
+        row's first ``idx[r] + j + 1`` positions.  The ServeLoop rolls
+        the index back to the accepted prefix afterwards."""
         cfg = self.cfg
         b, s = q.shape[0], q.shape[1]
-        if s != 1:
-            raise ValueError(
-                "per-row cache positions decode one token per call; "
-                "prefill goes through the scalar-index path "
-                "(tpudist.models.serving handles the insertion)")
         idx = idx_var.value
         if self.decode_shard is not None:
             raise NotImplementedError(
@@ -416,20 +418,21 @@ class CausalSelfAttention(nn.Module):
 
         h_kv, d = k.shape[2], k.shape[3]
         flat = h_kv * d
-        at = jnp.minimum(idx, cfg.max_seq_len - 1)
+        at = jnp.minimum(idx, cfg.max_seq_len - s)
         k_all, v_all = cached_k.value, cached_v.value
-        kf = k.reshape(b, 1, flat)
-        vf = v.reshape(b, 1, flat)
+        kf = k.reshape(b, s, flat)
+        vf = v.reshape(b, s, flat)
         for r in range(b):
             k_all = jax.lax.dynamic_update_slice(
                 k_all, kf[r:r + 1].astype(k_all.dtype), (r, at[r], 0))
             v_all = jax.lax.dynamic_update_slice(
                 v_all, vf[r:r + 1].astype(v_all.dtype), (r, at[r], 0))
         cached_k.value, cached_v.value = k_all, v_all
-        idx_var.value = idx + 1
+        idx_var.value = idx + s
 
         n = idx + 1  # [B] valid lengths including the current token
-        if self.decode_attention == "flash" and cfg.attention_window is None:
+        if (s == 1 and self.decode_attention == "flash"
+                and cfg.attention_window is None):
             from tpudist.ops.flash_decode import flash_decode
 
             return flash_decode(q, k_all, v_all, n,
@@ -437,15 +440,20 @@ class CausalSelfAttention(nn.Module):
         # NOTE: flash + attention_window falls back to the dense masked
         # path here (the per-row kernel has no per-row window trim yet) —
         # ServeLoop warns about the bandwidth cost at construction.
-        positions = jnp.arange(cfg.max_seq_len)[None, :]        # [1, S]
-        mask = positions < n[:, None]                           # [B, S]
+        # Multi-query chunks (s > 1) are dense banded too: the chunk was
+        # just written to the main cache, so one banded mask covers main
+        # history and the in-chunk causal structure together (the flash
+        # s>1 wrapper exists for the sided/frozen-main-cache layout).
+        positions = jnp.arange(cfg.max_seq_len)[None, None, :]  # [1,1,S]
+        q_pos = idx[:, None] + jnp.arange(s)[None, :]           # [B, s]
+        mask = positions < (q_pos + 1)[:, :, None]              # [B,s,S]
         if cfg.attention_window is not None:
-            mask = mask & (idx[:, None] - positions
+            mask = mask & (q_pos[:, :, None] - positions
                            < cfg.attention_window)
         k4 = k_all.reshape(b, cfg.max_seq_len, h_kv, d)
         v4 = v_all.reshape(b, cfg.max_seq_len, h_kv, d)
         k_rep, v_rep = repeat_kv(q, k4, v4)
-        return _masked_attend(q, k_rep, v_rep, mask[:, None, None, :])
+        return _masked_attend(q, k_rep, v_rep, mask[:, None])
 
     def _serve_attend_sided(self, q, k, v, cached_k, cached_v, idx_var):
         """The side-buffer serve step (see :meth:`_serve_attend`).
@@ -465,7 +473,7 @@ class CausalSelfAttention(nn.Module):
         method used through round 4 measured +0.15–0.2 ms/step on the
         8-layer 8k bench model."""
         cfg = self.cfg
-        b = q.shape[0]
+        b, s = q.shape[0], q.shape[1]
         cap = self.serve_side_slots
         h_kv, d = k.shape[2], k.shape[3]
         flat = h_kv * d
@@ -477,14 +485,17 @@ class CausalSelfAttention(nn.Module):
             cfg.compute_dtype)
         side_idx = self.variable(
             "cache", "side_index", lambda: jnp.zeros((), jnp.int32))
-        s_at = jnp.minimum(side_idx.value, cap - 1)
+        # s > 1 writes a verify chunk (speculative decode); the chunk
+        # lands contiguously and flash_decode's multi-query wrapper gives
+        # query j visibility over side positions [0, side_idx + j].
+        s_at = jnp.minimum(side_idx.value, cap - s)
         side_k.value = jax.lax.dynamic_update_slice(
-            side_k.value, k.reshape(b, 1, flat).astype(side_k.value.dtype),
+            side_k.value, k.reshape(b, s, flat).astype(side_k.value.dtype),
             (0, s_at, 0))
         side_v.value = jax.lax.dynamic_update_slice(
-            side_v.value, v.reshape(b, 1, flat).astype(side_v.value.dtype),
+            side_v.value, v.reshape(b, s, flat).astype(side_v.value.dtype),
             (0, s_at, 0))
-        side_idx.value = side_idx.value + 1
+        side_idx.value = side_idx.value + s
 
         from tpudist.ops.flash_decode import flash_decode
 
@@ -546,11 +557,9 @@ class CausalSelfAttention(nn.Module):
                 "the paged cache decodes through per-row vector "
                 "cache_index only (ServeLoop with cache_layout='paged'); "
                 "scalar-index rollouts use the dense layout")
-        if s != 1:
-            raise ValueError(
-                "paged cache decodes one token per call; prefill goes "
-                "through a dense batch-1 side cache and serving._insert "
-                "scatters it into pages")
+        # s > 1 is the speculative verify chunk (staged in the side
+        # buffer like single steps; prefill still goes through a dense
+        # batch-1 side cache and serving._insert scatters it into pages)
         if self.decode_shard is not None:
             raise NotImplementedError(
                 "sharded decode over the paged cache is not wired yet; "
@@ -573,14 +582,15 @@ class CausalSelfAttention(nn.Module):
             cfg.compute_dtype)
         side_idx = self.variable(
             "cache", "side_index", lambda: jnp.zeros((), jnp.int32))
-        s_at = jnp.minimum(side_idx.value, cap - 1)
+        s_base = side_idx.value
+        s_at = jnp.minimum(s_base, cap - s)
         side_k.value = jax.lax.dynamic_update_slice(
             side_k.value,
-            k.reshape(b, 1, flat).astype(side_k.value.dtype), (0, s_at, 0))
+            k.reshape(b, s, flat).astype(side_k.value.dtype), (0, s_at, 0))
         side_v.value = jax.lax.dynamic_update_slice(
             side_v.value,
-            v.reshape(b, 1, flat).astype(side_v.value.dtype), (0, s_at, 0))
-        side_idx.value = side_idx.value + 1
+            v.reshape(b, s, flat).astype(side_v.value.dtype), (0, s_at, 0))
+        side_idx.value = s_base + s
 
         if self.decode_attention == "flash":
             from tpudist.ops.flash_decode import paged_flash_decode
@@ -591,22 +601,28 @@ class CausalSelfAttention(nn.Module):
                 side_v=side_v.value, side_len=side_idx.value)
         # dense fallback: gather the slot's pages into a contiguous view
         # (one full-logical-cache copy per step — fine on CPU, the reason
-        # the kernel exists on TPU) and mask main + side positions
+        # the kernel exists on TPU) and mask main + side positions;
+        # chunk query j (s > 1, speculative verify) sees side positions
+        # [0, s_base + j] — causal within the chunk it just wrote
         from tpudist.ops.flash_decode import paged_gather_kv
 
         k_main = paged_gather_kv(paged_k.value, table.value)
         v_main = paged_gather_kv(paged_v.value, table.value)
         s_all = k_main.shape[1]
-        mask_main = jnp.arange(s_all)[None, :] < idx[:, None]      # [B, S']
+        mask_main = jnp.broadcast_to(
+            (jnp.arange(s_all)[None, :] < idx[:, None])[:, None],
+            (b, s, s_all))                                     # [B, s, S']
         mask_side = jnp.broadcast_to(
-            jnp.arange(cap)[None, :] < side_idx.value, (b, cap))
-        mask = jnp.concatenate([mask_main, mask_side], axis=1)
+            jnp.arange(cap)[None, None, :]
+            < s_base + jnp.arange(s)[None, :, None] + 1,
+            (b, s, cap))                                       # [B, s, cap]
+        mask = jnp.concatenate([mask_main, mask_side], axis=2)
         k_all = jnp.concatenate([k_main, side_k.value], axis=1)
         v_all = jnp.concatenate([v_main, side_v.value], axis=1)
         k4 = k_all.reshape(b, s_all + cap, h_kv, d)
         v4 = v_all.reshape(b, s_all + cap, h_kv, d)
         k_rep, v_rep = repeat_kv(q, k4, v4)
-        return _masked_attend(q, k_rep, v_rep, mask[:, None, None, :])
+        return _masked_attend(q, k_rep, v_rep, mask[:, None])
 
     def _prefill_attend(self, q, k_all, v_all, idx):
         """Chunk prefill: queries at global positions [idx, idx+s) attend

@@ -525,6 +525,14 @@ class Router:
                                   or {}).get("value") or 0.0),
                 "weights_version": (gauges.get("serve/weights_version")
                                     or {}).get("value"),
+                # RTT-amortization factor per replica: how many tokens
+                # the last drained dispatch generated, and the lifetime
+                # host round-trip count (fused multi-token decode)
+                "steps_per_dispatch": (
+                    gauges.get("serve/steps_per_dispatch")
+                    or {}).get("value"),
+                "dispatches": (counters.get("serve/dispatches")
+                               or {}).get("value") or 0.0,
                 "age_s": snap.get("age_s"),
             }
         return out
