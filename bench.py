@@ -2325,6 +2325,183 @@ def bench_serve_elastic(on_tpu: bool) -> None:
     server.stop()
 
 
+def bench_serve_autoscale(on_tpu: bool) -> None:
+    """The fleet control plane under chaos (ISSUE 9 acceptance): a
+    1-replica fleet plus a doomed second replica (SIGKILL mid-spike)
+    takes a 12-request spike with a millisecond wait target — the
+    autoscaler must buy capacity; the idle tail (sliding-window
+    percentiles aging the spike out) must drain it back down as a
+    graceful, zero-loss exit.  Then two structural rollouts: one whose
+    green pool CORRUPTS its canary (must roll back with blue
+    untouched), one clean (kv-block-size 16 -> 8) that must commit and
+    drain blue.  The row asserts ``lost_requests=0``, ``scaled_up>=1``,
+    ``drained_down>=1``, ``rollback_works``, ``exact_match`` on every
+    burst, and drained pools on every clean exit."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        exit_reports, launch_local_fleet,
+                                        scale_fleet, stop_fleet,
+                                        wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_serve_autoscale", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    def make_requests(n, seed):
+        rng = np.random.default_rng(seed)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"q{seed}-{i}")
+                for i in range(n)]
+
+    cfg_lm, params = build_tiny_lm(seed=0)
+    ref_loop = ServeLoop(cfg_lm, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=16)
+
+    def reference(reqs):
+        return {c.rid: tuple(c.tokens.tolist())
+                for c in ref_loop.run(list(reqs))}
+
+    spike = make_requests(12, seed=0)
+    want_spike = reference(spike)
+    burst2, burst3 = make_requests(6, seed=2), make_requests(6, seed=3)
+    want2, want3 = reference(burst2), reference(burst3)
+    canary = Request(np.arange(5, dtype=np.int32), 8, rid="probe")
+    want_canary = np.asarray(
+        reference([canary])[canary.rid], np.int32)
+
+    ns = "bench-autoscale"
+    addr = f"127.0.0.1:{server.port}"
+    client = CoordClient(port=server.port)
+    args = ["--cache-layout", "paged", "--kv-block-size", "16",
+            "--ttl", "1.0"]
+    window = {"TPUDIST_SERVE_WAIT_WINDOW_S": "15"}
+    procs = launch_local_fleet(
+        addr, 2, namespace=ns, replica_args=args,
+        env_overrides={0: dict(window),
+                       1: dict(window,
+                               TPUDIST_FAULT_KILL_AFTER_SEGMENTS="6")})
+    scaler = Autoscaler(
+        CoordClient(port=server.port), coord_addr=addr, namespace=ns,
+        config=AutoscaleConfig(
+            min_replicas=1, max_replicas=3, target_wait_s=0.005,
+            low_wait_s=0.001, quantile=0.9, breach_polls=2,
+            idle_polls=4, up_cooldown_s=60.0, down_cooldown_s=25.0,
+            poll_s=0.25, max_metric_age_s=10.0),
+        replica_args=args, env_extra=dict(window))
+    before = obs.snapshot()["counters"]
+
+    def delta(name):
+        return (obs.snapshot()["counters"].get(name, {}).get("value", 0)
+                - before.get(name, {}).get("value", 0))
+
+    roll1 = roll2 = None
+    t0 = time.perf_counter()
+    try:
+        wait_live(client, 2, namespace=ns, timeout_s=120.0, procs=procs)
+        router = Router(client, namespace=ns, lost_after_s=5.0)
+        router._poll({}, {}, None)        # pin the membership baseline
+        scaler.start()
+
+        # -- phase 1: spike + mid-spike SIGKILL -> scale-up
+        t_spike = time.perf_counter()
+        comps1 = router.run(list(spike), timeout_s=240.0)
+        limit = time.perf_counter() + 90.0
+        while time.perf_counter() < limit and delta(
+                "autoscale/scale_ups") < 1:
+            time.sleep(0.5)
+        scaled_up = int(delta("autoscale/scale_ups"))
+
+        # -- SLO recovery: the windowed p90 ages the spike out
+        slo_recovery_s = -1.0
+        limit = time.perf_counter() + 120.0
+        while time.perf_counter() < limit:
+            wq = obs.snapshot()["gauges"].get(
+                "autoscale/wait_q", {}).get("value", 1e9)
+            if wq < 0.005:
+                slo_recovery_s = time.perf_counter() - t_spike
+                break
+            time.sleep(0.5)
+
+        # -- phase 2: idle tail -> graceful drain back to min_replicas
+        limit = time.perf_counter() + 120.0
+        while time.perf_counter() < limit:
+            if (delta("autoscale/drain_completed") >= 1
+                    and len(scaler.live()) <= 1):
+                break
+            time.sleep(0.5)
+        drained_down = int(delta("autoscale/drain_completed"))
+        scaler.stop()   # operator pause: no autoscaling during rollout
+
+        # -- phase 3: structural roll with a CORRUPTED green canary
+        roll1 = router.roll_structural(
+            lambda: scale_fleet(
+                addr, 1, namespace=ns,
+                replica_args=args + ["--pool", "green"],
+                env_extra=dict(window, TPUDIST_FAULT_CANARY_CORRUPT="1")),
+            1, canary=canary, expect_tokens=want_canary)
+        comps2 = router.run(list(burst2), timeout_s=240.0)
+
+        # -- phase 4: clean structural roll (paged block size 16 -> 8)
+        roll2 = router.roll_structural(
+            lambda: scale_fleet(
+                addr, 1, namespace=ns,
+                replica_args=["--cache-layout", "paged",
+                              "--kv-block-size", "8", "--ttl", "1.0",
+                              "--pool", "green"],
+                env_extra=dict(window)),
+            1, canary=canary, expect_tokens=want_canary)
+        comps3 = router.run(list(burst3), timeout_s=240.0)
+        wall = time.perf_counter() - t0
+    finally:
+        scaler.stop()
+        extra = [p for r in (roll1, roll2) if r
+                 for p in r.get("procs", [])]
+        stop_fleet(client, procs + scaler.procs + extra, namespace=ns)
+
+    got1 = {c.rid: tuple(c.tokens.tolist()) for c in comps1
+            if c.reason == "length"}
+    got2 = {c.rid: tuple(c.tokens.tolist()) for c in comps2
+            if c.reason == "length"}
+    got3 = {c.rid: tuple(c.tokens.tolist()) for c in comps3
+            if c.reason == "length"}
+    lost = ((len(spike) - len(got1)) + (len(burst2) - len(got2))
+            + (len(burst3) - len(got3)))
+    exact = (all(got1.get(r) == w for r, w in want_spike.items())
+             and all(got2.get(r) == w for r, w in want2.items())
+             and all(got3.get(r) == w for r, w in want3.items()))
+    reports = exit_reports(client, namespace=ns)
+    clean = [r for r in reports.values() if r.get("clean")]
+    _emit("serve_autoscale", round(wall, 2), "s", None,
+          requests=len(spike) + len(burst2) + len(burst3),
+          lost_requests=lost,
+          scaled_up=scaled_up,
+          drained_down=drained_down,
+          replica_deaths=int(delta("router/replica_deaths")),
+          redispatched=int(delta("router/redispatched")),
+          rollback_works=bool(roll1 and not roll1["ok"]
+                              and roll1["stage"] == "canary"),
+          rollbacks=int(delta("router/rollbacks")),
+          structural_rolls=int(delta("router/structural_rolls")),
+          roll_committed=bool(roll2 and roll2["ok"]),
+          blue_drained=bool(roll2 and roll2.get("blue_drained")),
+          exact_match=exact,
+          pool_drained=bool(clean) and all(r.get("pool_drained")
+                                           for r in clean),
+          clean_exits=len(clean),
+          slo_recovery_s=round(slo_recovery_s, 2),
+          wall_s=round(wall, 2))
+    server.stop()
+
+
 def main() -> None:
     import jax
 
@@ -2342,7 +2519,8 @@ def main() -> None:
                bench_kv_paging,
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode, bench_host_allreduce,
-               bench_serve_fleet, bench_serve_fused, bench_serve_elastic]
+               bench_serve_fleet, bench_serve_fused, bench_serve_elastic,
+               bench_serve_autoscale]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

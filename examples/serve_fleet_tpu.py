@@ -27,10 +27,25 @@ The elastic tier (ISSUE 7):
   and serves a second batch — verified token-identical to a local
   reference on the NEW weights, with zero requests lost to the roll.
 
+The control-plane tier (ISSUE 9):
+
+* ``--autoscale`` hands the fleet to the `Autoscaler` instead of
+  scaling by hand: a millisecond wait target means the request batch
+  IS a breach, so the control loop buys a replica mid-traffic
+  (``autoscale/scale_ups`` ticks) — the same loop that drains
+  capacity back down gracefully once the sliding-window percentile
+  ages the spike out.
+* ``--roll-structural`` performs a blue-green rollout of a STRUCTURAL
+  change in-place weight swaps cannot express (paged KV block size
+  16 -> 8): green pool spun up, warmed, canary exact-checked, traffic
+  shifted, blue drained — then serves a second batch on green.
+
 Run (CPU works; each replica is a separate process):
 
     python examples/serve_fleet_tpu.py --replicas 2 --requests 6 --kill
     python examples/serve_fleet_tpu.py --replicas 2 --join --hot-swap
+    python examples/serve_fleet_tpu.py --replicas 1 --autoscale
+    python examples/serve_fleet_tpu.py --replicas 1 --roll-structural
 """
 
 from __future__ import annotations
@@ -61,10 +76,21 @@ def main(argv=None) -> int:
                         help="roll new weights through the live fleet "
                              "between two batches (drain-gated, zero "
                              "lost requests)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="let the autoscaler buy capacity for the "
+                             "batch instead of scaling by hand")
+    parser.add_argument("--roll-structural", action="store_true",
+                        help="blue-green rollout of a structural "
+                             "change (paged block size 16 -> 8) with "
+                             "a canary exact-check, then a second "
+                             "batch on green")
     parser.add_argument("--ttl", type=float, default=1.0,
                         help="replica heartbeat lease (the death-"
                              "detection latency floor)")
     args = parser.parse_args(argv)
+    if args.hot_swap and args.roll_structural:
+        parser.error("--hot-swap and --roll-structural are separate "
+                     "demos; pick one")
 
     from tpudist.models.serving import Request, ServeLoop
     from tpudist.runtime.coord import CoordClient, CoordServer
@@ -121,6 +147,22 @@ def main(argv=None) -> int:
         replica_args=replica_args, env_overrides=env)
     requests = make_requests(args.requests, seed=0)
     comps2: list = []
+    scaler = None
+    if args.autoscale:
+        from tpudist.runtime.autoscaler import (AutoscaleConfig,
+                                                Autoscaler)
+
+        # a millisecond wait target makes the batch itself a breach:
+        # the control loop buys one replica mid-traffic
+        scaler = Autoscaler(
+            CoordClient(port=server.port),
+            coord_addr=f"127.0.0.1:{server.port}",
+            config=AutoscaleConfig(
+                min_replicas=1, max_replicas=args.replicas + 1,
+                target_wait_s=0.005, low_wait_s=0.001, breach_polls=2,
+                idle_polls=8, up_cooldown_s=60.0, down_cooldown_s=30.0,
+                poll_s=0.25, max_metric_age_s=10.0),
+            replica_args=replica_args)
     try:
         wait_live(client, args.replicas, timeout_s=120.0, procs=procs)
         print("fleet live; routing")
@@ -132,9 +174,45 @@ def main(argv=None) -> int:
             procs += scale_fleet(f"127.0.0.1:{server.port}", 1,
                                  start_index=args.replicas,
                                  replica_args=replica_args)
+        if scaler is not None:
+            print("autoscaler watching the fleet (target p90 wait "
+                  "5ms; the batch is a deliberate breach)")
+            scaler.start()
         t0 = time.perf_counter()
         comps = router.run(requests, timeout_s=180.0)
         wall = time.perf_counter() - t0
+        if scaler is not None:
+            from tpudist import obs
+
+            limit = time.perf_counter() + 60.0
+            ups = 0
+            while time.perf_counter() < limit and ups < 1:
+                ups = int(obs.snapshot()["counters"].get(
+                    "autoscale/scale_ups", {}).get("value", 0))
+                time.sleep(0.5)
+            scaler.stop()
+            print(f"autoscaler bought {ups} replica(s); fleet now "
+                  f"{sorted(scaler.live())}")
+        if args.roll_structural:
+            canary = Request(np.arange(5, dtype=np.int32), 8,
+                             rid="probe")
+            want_canary = np.asarray(reference(0, [canary])["probe"],
+                                     np.int32)
+            print("blue-green structural roll: paged KV block size "
+                  "16 -> 8 (canary exact-checked before traffic "
+                  "shifts)")
+            res = router.roll_structural(
+                lambda: scale_fleet(
+                    f"127.0.0.1:{server.port}", 1,
+                    replica_args=["--cache-layout", "paged",
+                                  "--kv-block-size", "8", "--ttl",
+                                  str(args.ttl), "--pool", "green"]),
+                1, canary=canary, expect_tokens=want_canary)
+            procs += res.get("procs", [])
+            print(f"roll {'committed' if res['ok'] else 'ROLLED BACK'}"
+                  f"; blue drained: {bool(res.get('blue_drained'))}")
+            comps2 = router.run(make_requests(args.requests, seed=1),
+                                timeout_s=180.0)
         if args.hot_swap:
             survivors = (args.replicas + (1 if args.join else 0)
                          - (1 if args.kill else 0))
@@ -149,7 +227,10 @@ def main(argv=None) -> int:
             comps2 = router.run(make_requests(args.requests, seed=1),
                                 timeout_s=180.0)
     finally:
-        stop_fleet(client, procs)
+        if scaler is not None:
+            scaler.stop()
+        stop_fleet(client,
+                   procs + (scaler.procs if scaler is not None else []))
         if snap_dir is not None:
             shutil.rmtree(snap_dir, ignore_errors=True)
 
@@ -162,6 +243,12 @@ def main(argv=None) -> int:
     n_want = len(requests)
     if args.hot_swap:
         want2 = reference(1, make_requests(args.requests, seed=1))
+        mismatched += [c.rid for c in comps2
+                       if c.tokens.tolist() != want2[c.rid]]
+        n_want += args.requests
+    elif args.roll_structural:
+        # same weights, different paged block size: still exact
+        want2 = reference(0, make_requests(args.requests, seed=1))
         mismatched += [c.rid for c in comps2
                        if c.tokens.tolist() != want2[c.rid]]
         n_want += args.requests

@@ -1,0 +1,366 @@
+"""The autoscaling control plane (ISSUE 9 tentpole).
+
+Two tiers:
+
+* ``TestPolicy`` / ``TestDrainMachine`` — the target-tracking policy
+  driven deterministically against a FakeCoord and an injected clock:
+  breach/recover hysteresis, cooldown blocking, min/max clamps (with
+  mid-warmup joiners counted), and the drain state machine's
+  steer -> inbox-empty -> stop -> sweep ordering.
+* ``TestAutoscaleE2E`` (slow) — a real one-replica fleet: a load spike
+  scales it up, the idle tail drains it back down, and every request
+  completes exactly.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
+
+NS = "as-test"
+
+
+class FakeCoord:
+    """In-memory CoordClient stand-in: the verbs the autoscaler reaches
+    for (keys/get/set/delete/add/live)."""
+
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+        self.live_set: set[str] = set()
+        self.counters: dict[str, int] = {}
+
+    def keys(self, prefix=""):
+        return [k for k in list(self.kv) if k.startswith(prefix)]
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value):
+        self.kv[key] = value
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+
+    def add(self, key, delta):
+        self.counters[key] = self.counters.get(key, 0) + int(delta)
+        return self.counters[key]
+
+    def live(self):
+        return set(self.live_set)
+
+
+class FakeProc:
+    """A spawned joiner: alive until .exit(), optionally never
+    heartbeating (mid-warmup)."""
+
+    def __init__(self, replica_index):
+        self.replica_index = replica_index
+        self._rc = None
+
+    def poll(self):
+        return self._rc
+
+    def exit(self, rc=0):
+        self._rc = rc
+
+
+def _register(fc, rid, rank, *, live=True):
+    fc.kv[f"{NS}/replica/{rid}"] = json.dumps(
+        {"replica_id": rid, "rank": rank}).encode()
+    if live:
+        fc.live_set.add(f"{NS}:{rid}")
+
+
+def _publish(fc, rank, *, wait_idx=None, depth=0.0, free=None):
+    """One MetricsPublisher-shaped snapshot.  ``wait_idx`` puts every
+    queue-wait observation in the ``2**wait_idx`` bucket, so every
+    quantile reads exactly ``2**wait_idx`` seconds."""
+    gauges = {"serve/queue_depth": {"value": depth}}
+    if free is not None:
+        gauges["serve/kv_blocks_free"] = {"value": free}
+    snap = {"rank": rank, "published_at": time.time(),
+            "gauges": gauges, "counters": {}, "histograms": {}}
+    if wait_idx is not None:
+        v = float(2.0 ** wait_idx)
+        snap["histograms"]["serve/queue_wait_s"] = {
+            "growth": 2.0, "count": 100, "sum": v * 100, "zero": 0,
+            "min": v, "max": v, "buckets": {str(wait_idx): 100}}
+    fc.kv[f"{NS}/metrics/{rank}"] = json.dumps(snap).encode()
+
+
+def _scaler(fc, clock, spawned, **cfg_kw):
+    kw = dict(min_replicas=1, max_replicas=4, target_wait_s=0.5,
+              low_wait_s=0.1, breach_polls=3, idle_polls=3,
+              up_cooldown_s=5.0, down_cooldown_s=5.0)
+    kw.update(cfg_kw)
+
+    def spawner(n):
+        procs = [FakeProc(100 + len(spawned) + i) for i in range(n)]
+        spawned.extend(procs)
+        return procs
+
+    return Autoscaler(fc, namespace=NS, config=AutoscaleConfig(**kw),
+                      spawner=spawner, clock=lambda: clock["t"])
+
+
+class TestConfig:
+    def test_from_env_and_defaults(self):
+        c = AutoscaleConfig.from_env({
+            "TPUDIST_AUTOSCALE_MAX_REPLICAS": "8",
+            "TPUDIST_AUTOSCALE_TARGET_WAIT_S": "2.0",
+            "TPUDIST_AUTOSCALE_BREACH_POLLS": "5"})
+        assert (c.max_replicas, c.target_wait_s, c.breach_polls) \
+            == (8, 2.0, 5)
+        assert c.low_wait_s == 0.5      # defaults to target / 4
+        assert AutoscaleConfig().low_wait_s == 0.125
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="low_wait_s"):
+            AutoscaleConfig(target_wait_s=1.0, low_wait_s=1.0)
+        with pytest.raises(ValueError, match="quantile"):
+            AutoscaleConfig(quantile=0.0)
+        with pytest.raises(ValueError, match="breach_polls"):
+            AutoscaleConfig(breach_polls=0)
+
+
+class TestPolicy:
+    def test_breach_hysteresis_then_scale_up(self):
+        """One breach poll is noise; ``breach_polls`` consecutive ones
+        are load — the scale-up fires exactly on the Kth."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _publish(fc, 0, wait_idx=6)             # p90 = 64s >> target
+        sc = _scaler(fc, clock, spawned, breach_polls=3)
+        for want_breach in (1, 2):
+            r = sc.poll()
+            assert r["action"] is None and r["breach"] == want_breach
+            assert spawned == []
+        r = sc.poll()
+        assert r["action"] == ("up", 1)
+        assert len(spawned) == 1 and r["breach"] == 0
+
+    def test_noise_poll_resets_breach(self):
+        """A calm poll between breaches restarts the count — sustained
+        means CONSECUTIVE."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        sc = _scaler(fc, clock, spawned, breach_polls=2)
+        _publish(fc, 0, wait_idx=6)
+        assert sc.poll()["breach"] == 1
+        _publish(fc, 0, wait_idx=None)          # calm: no observations
+        assert sc.poll()["breach"] == 0
+        _publish(fc, 0, wait_idx=6)
+        r = sc.poll()
+        assert r["breach"] == 1 and r["action"] is None and not spawned
+
+    def test_up_cooldown_and_pending_joiner_bound(self):
+        """After a scale-up, further breaches inside the cooldown do
+        nothing; a spawned-but-not-yet-live joiner counts toward the
+        max bound so capacity-on-the-way is never double-bought."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _publish(fc, 0, wait_idx=6)
+        sc = _scaler(fc, clock, spawned, breach_polls=1,
+                     up_cooldown_s=10.0, max_replicas=2)
+        assert sc.poll()["action"] == ("up", 1)
+        clock["t"] += 5.0                       # still cooling down
+        assert sc.poll()["action"] is None and len(spawned) == 1
+        clock["t"] += 6.0                       # cooldown expired, but
+        r = sc.poll()                           # 1 live + 1 pending = max
+        assert r["action"] is None and r["pending"] == 1
+        assert len(spawned) == 1
+
+    def test_scale_up_resumes_after_joiner_dies(self):
+        """A joiner that exits during warmup stops counting as pending
+        capacity: the next breach (past cooldown) buys a replacement."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _publish(fc, 0, wait_idx=6)
+        sc = _scaler(fc, clock, spawned, breach_polls=1,
+                     up_cooldown_s=1.0, max_replicas=2)
+        assert sc.poll()["action"] == ("up", 1)
+        spawned[0].exit(rc=1)                   # died mid-warmup
+        clock["t"] += 2.0
+        assert sc.poll()["action"] == ("up", 1)
+        assert len(spawned) == 2
+
+    def test_idle_window_drains_least_loaded(self):
+        """``idle_polls`` consecutive calm polls mark the least-loaded
+        replica draining — nothing is ever killed outright."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _register(fc, "r1", 1)
+        _publish(fc, 0, depth=0.0, free=10)
+        _publish(fc, 1, depth=0.0, free=40)     # emptiest: the victim
+        sc = _scaler(fc, clock, spawned, idle_polls=3)
+        for want_idle in (1, 2):
+            r = sc.poll()
+            assert r["action"] is None and r["idle"] == want_idle
+        r = sc.poll()
+        assert r["action"] == ("down", "r1")
+        assert fc.get(f"{NS}/draining/r1") is not None
+        assert f"{NS}:r1" in fc.live_set        # still alive: draining
+
+    def test_min_clamp_and_one_drain_at_a_time(self):
+        """At ``min_replicas`` the idle window never drains; while one
+        drain is in flight no second victim is chosen."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        sc = _scaler(fc, clock, spawned, idle_polls=1, min_replicas=1)
+        for _ in range(4):
+            assert sc.poll()["action"] is None  # 1 active == min
+        _register(fc, "r1", 1)
+        _register(fc, "r2", 2)
+        sc2 = _scaler(fc, clock, spawned, idle_polls=1, min_replicas=1,
+                      down_cooldown_s=0.0)
+        assert sc2.poll()["action"][0] == "down"
+        r = sc2.poll()                          # r-x draining: hold
+        assert r["action"] is None and len(r["draining"]) == 1
+
+    def test_middle_band_resets_both_counters(self):
+        """Between ``low_wait_s`` and ``target_wait_s`` neither
+        direction makes progress — the hysteresis band."""
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _register(fc, "r1", 1)
+        sc = _scaler(fc, clock, spawned, target_wait_s=100.0,
+                     low_wait_s=0.5, breach_polls=1, idle_polls=1,
+                     down_cooldown_s=0.0)
+        _publish(fc, 0, wait_idx=6)             # 64s: inside the band
+        for _ in range(5):
+            r = sc.poll()
+            assert r["action"] is None
+            assert r["breach"] == 0 and r["idle"] == 0
+
+
+class TestDrainMachine:
+    def test_stop_only_after_inbox_empty_then_sweep(self):
+        """The zero-loss ordering: a draining replica keeps its stop
+        key WITHHELD while requests sit in its inbox; once the inbox
+        empties the targeted stop lands; once the lease is gone the
+        coordination residue is swept and the drain counts complete."""
+        from tpudist import obs
+
+        fc, clock, spawned = FakeCoord(), {"t": 0.0}, []
+        _register(fc, "r0", 0)
+        _register(fc, "r1", 1)
+        sc = _scaler(fc, clock, spawned, idle_polls=1,
+                     down_cooldown_s=0.0)
+        fc.kv[f"{NS}/inbox/r1/00000001"] = b"{}"   # undelivered work
+        _publish(fc, 0, free=10)
+        _publish(fc, 1, free=40)
+        assert sc.poll()["action"] == ("down", "r1")
+        sc.poll()
+        assert fc.get(f"{NS}/stop/r1") is None     # inbox not empty
+        fc.delete(f"{NS}/inbox/r1/00000001")       # replica took it
+        sc.poll()
+        assert fc.get(f"{NS}/stop/r1") == b"1"     # now stop it
+        assert f"{NS}:r1" in fc.live_set
+        d0 = obs.snapshot()["counters"].get(
+            "autoscale/drain_completed", {}).get("value", 0)
+        fc.live_set.discard(f"{NS}:r1")            # clean exit
+        sc.poll()
+        for key in (f"{NS}/draining/r1", f"{NS}/stop/r1",
+                    f"{NS}/replica/r1", f"{NS}/metrics/1"):
+            assert key not in fc.kv                # residue swept
+        d1 = obs.snapshot()["counters"]["autoscale/drain_completed"][
+            "value"]
+        assert d1 - d0 == 1
+
+
+@pytest.mark.slow
+class TestAutoscaleE2E:
+    def test_spike_scales_up_idle_drains_down_zero_lost(self):
+        """One replica, a 12-request spike with a millisecond wait
+        target: the control loop buys a second replica during the
+        spike, every request completes token-exact against the local
+        reference, and the idle tail drains the fleet back to one
+        replica whose departed peer exits CLEAN with a drained pool."""
+        from tpudist import obs
+        from tpudist.models.serving import Request, ServeLoop
+        from tpudist.runtime.coord import CoordClient, CoordServer
+        from tpudist.runtime.router import (Router, build_tiny_lm,
+                                            exit_reports,
+                                            launch_local_fleet,
+                                            stop_fleet, wait_live)
+
+        def _requests(n):
+            rng = np.random.default_rng(0)
+            return [Request(rng.integers(0, 64, size=4 + i).astype(
+                np.int32), 20 + 2 * i, rid=f"q{i}") for i in range(n)]
+
+        try:
+            server = CoordServer(0)
+        except Exception as e:   # NativeUnavailable or build failure
+            pytest.skip(f"native coord store unavailable: {e}")
+        client = CoordClient("127.0.0.1", server.port)
+        ns = "as-fleet"
+        addr = f"127.0.0.1:{server.port}"
+        procs = launch_local_fleet(
+            addr, 1, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"])
+        cfg = AutoscaleConfig(
+            min_replicas=1, max_replicas=2, target_wait_s=0.005,
+            low_wait_s=0.001, quantile=0.9, breach_polls=2,
+            idle_polls=4, up_cooldown_s=60.0, down_cooldown_s=0.0,
+            poll_s=0.25, max_metric_age_s=10.0)
+        scaler = Autoscaler(
+            CoordClient("127.0.0.1", server.port), coord_addr=addr,
+            namespace=ns, config=cfg,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"])
+        u0 = obs.snapshot()["counters"].get(
+            "autoscale/scale_ups", {}).get("value", 0)
+        try:
+            wait_live(client, 1, namespace=ns, timeout_s=90.0)
+            scaler.start()
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            comps = router.run(_requests(12), timeout_s=180.0)
+
+            # zero lost, token-exact against the uninterrupted run
+            assert sorted(c.rid for c in comps) \
+                == sorted(f"q{i}" for i in range(12))
+            assert all(c.reason == "length" for c in comps)
+            lm_cfg, params = build_tiny_lm(seed=0)
+            ref = ServeLoop(lm_cfg, params, num_slots=2,
+                            steps_per_sync=4, prefill_chunk=8,
+                            cache_layout="paged", kv_block_size=16)
+            want = {c.rid: tuple(c.tokens.tolist())
+                    for c in ref.run(_requests(12))}
+            for c in comps:
+                np.testing.assert_array_equal(
+                    c.tokens, np.asarray(want[c.rid], np.int32))
+
+            # the spike bought capacity
+            deadline = time.time() + 120.0
+            while time.time() < deadline:
+                ups = obs.snapshot()["counters"].get(
+                    "autoscale/scale_ups", {}).get("value", 0) - u0
+                if ups >= 1:
+                    break
+                time.sleep(0.5)
+            assert ups >= 1, "spike never triggered a scale-up"
+
+            # the idle tail drains back down to min_replicas — the
+            # drained replica exits clean with its pool fully freed
+            deadline = time.time() + 180.0
+            while time.time() < deadline:
+                drained = obs.snapshot()["counters"].get(
+                    "autoscale/drain_completed", {}).get("value", 0)
+                if drained >= 1 and len(scaler.live()) == 1:
+                    break
+                time.sleep(0.5)
+            assert drained >= 1, "idle fleet never drained down"
+            assert len(scaler.live()) == 1
+            reports = exit_reports(client, namespace=ns)
+            gone = [r for r in reports.values() if r.get("clean")]
+            assert any(r.get("pool_drained") for r in gone)
+        finally:
+            scaler.stop()
+            stop_fleet(client, procs + scaler.procs, namespace=ns)

@@ -61,8 +61,10 @@ class TestPlan:
         assert not plan.drop_heartbeat()
         assert not plan.drop_publish()
         plan.on_segment()
-        assert plan.injected == {"coord_error": 0, "coord_delay": 0,
-                                 "heartbeat_drop": 0, "publish_drop": 0}
+        plan.on_warmup()
+        assert not plan.corrupt_canary("canary-0")
+        plan.autoscale_poll()
+        assert all(n == 0 for n in plan.injected.values())
 
     def test_probability_validation(self):
         with pytest.raises(ValueError, match="coord_error_p"):
@@ -217,3 +219,73 @@ class TestKillSchedule:
         assert res.returncode == -signal.SIGKILL
         assert "seg2" in res.stdout  # the fatal segment was dispatched
         assert "survived" not in res.stdout
+
+
+class TestControlPlaneInjections:
+    """ISSUE 9 knobs: delayed first heartbeat, warmup kill, canary
+    corruption, autoscaler poll stall."""
+
+    def test_env_parsing_new_knobs(self):
+        plan = FaultPlan.from_env({
+            "TPUDIST_FAULT_HEARTBEAT_DELAY_S": "1.5",
+            "TPUDIST_FAULT_KILL_AT_WARMUP": "1",
+            "TPUDIST_FAULT_CANARY_CORRUPT": "1",
+            "TPUDIST_FAULT_AUTOSCALE_POLL_DELAY_S": "0.25",
+        })
+        assert plan.active
+        assert plan.heartbeat_delay_s == 1.5
+        assert plan.kill_at_warmup is True
+        assert plan.canary_corrupt is True
+        assert plan.autoscale_poll_delay_s == 0.25
+
+    def test_heartbeat_delay_drops_early_then_flows(self):
+        plan = FaultPlan(heartbeat_delay_s=1e6)
+        assert plan.drop_heartbeat()          # uptime < delay: swallowed
+        assert plan.injected["heartbeat_delay"] == 1
+        plan2 = FaultPlan(heartbeat_delay_s=1e-9)
+        import time as _time
+        _time.sleep(0.01)
+        assert not plan2.drop_heartbeat()     # past the delay: flows
+
+    def test_heartbeat_delay_composes_with_stop(self):
+        # delay only suppresses EARLY beats; stop suppresses late ones
+        plan = FaultPlan(heartbeat_delay_s=1e-9,
+                         heartbeat_stop_after_s=1e6)
+        import time as _time
+        _time.sleep(0.01)
+        assert not plan.drop_heartbeat()
+
+    def test_canary_corrupt_only_hits_canary_rids(self):
+        plan = FaultPlan(canary_corrupt=True)
+        assert plan.corrupt_canary("canary-0")
+        assert not plan.corrupt_canary("req-7")
+        assert plan.injected["canary_corrupt"] == 1
+        assert not FaultPlan().corrupt_canary("canary-0")
+
+    def test_autoscale_poll_stalls(self):
+        import time as _time
+        plan = FaultPlan(autoscale_poll_delay_s=0.05)
+        t0 = _time.monotonic()
+        plan.autoscale_poll()
+        assert _time.monotonic() - t0 >= 0.05
+        assert plan.injected["autoscale_delay"] == 1
+
+    def test_kill_at_warmup_sigkills_subprocess(self, tmp_path):
+        code = (
+            "from tpudist.runtime import faults\n"
+            "faults.on_warmup()\n"
+            "print('survived')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "TPUDIST_FAULT_KILL_AT_WARMUP": "1"},
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == -signal.SIGKILL
+        assert "survived" not in proc.stdout
+
+    def test_module_hooks_inert_by_default(self):
+        faults.reset()
+        faults.on_warmup()
+        assert not faults.corrupt_canary("canary-9")
+        faults.autoscale_poll()

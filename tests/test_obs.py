@@ -131,7 +131,7 @@ class TestLazyAccumulation:
         v = jnp.float32(4.0)
         h.record(v)
         assert h._pending[0] is v             # unconverted, unfetched
-        assert h._count == 0                  # nothing folded yet
+        assert h._gens[-1]["count"] == 0      # nothing folded yet
 
     def test_plain_python_values_skip_jax_entirely(self, monkeypatch):
         r = _registry()
@@ -604,3 +604,88 @@ class TestXlaTelemetry:
             type("D", (), {"device_kind": "TPU v5e"})()) == 197.0
         assert xla.mfu(98.5, type("D", (), {"device_kind": "TPU v5e"})()) \
             == pytest.approx(0.5)
+
+
+class TestWindowedHistogram:
+    """Sliding-window mode: observations expire so control loops see the
+    last ``window_s`` seconds, not the process lifetime."""
+
+    def _h(self, window_s=10.0):
+        clock = {"t": 0.0}
+        h = obs.Histogram("w", unit="s", window_s=window_s,
+                          clock=lambda: clock["t"])
+        return h, clock
+
+    def test_unwindowed_is_lifetime(self):
+        h = obs.Histogram("h")
+        assert h.window_s is None
+        h.record(4.0)
+        s = h.summary()
+        assert s["count"] == 1
+
+    def test_rejects_nonpositive_window(self):
+        with pytest.raises(ValueError):
+            obs.Histogram("h", window_s=0.0)
+
+    def test_fresh_samples_visible(self):
+        h, clock = self._h(window_s=10.0)
+        h.record(4.0)
+        h._fold(h._take_pending())
+        assert h._snap()["count"] == 1
+        assert hist_quantile(h._snap(), 0.99) == 4.0
+
+    def test_old_samples_expire(self):
+        h, clock = self._h(window_s=10.0)
+        h.record(64.0)                      # the "old spike"
+        h._fold(h._take_pending())
+        clock["t"] = 4.0                    # still inside half-window span
+        h.record(64.0)
+        h._fold(h._take_pending())
+        assert h._snap()["count"] == 2
+        clock["t"] = 12.0                   # first gen now > window old
+        h.record(1.0)
+        h._fold(h._take_pending())
+        snap = h._snap()
+        # both 64.0 samples landed in the generation started at t=0,
+        # which expired at t>=10; only the fresh 1.0 remains
+        assert snap["count"] == 1
+        assert hist_quantile(snap, 0.99) == 1.0
+        assert snap["max"] == 1.0
+
+    def test_quiet_gap_expires_everything(self):
+        h, clock = self._h(window_s=10.0)
+        h.record(64.0)
+        h._fold(h._take_pending())
+        clock["t"] = 100.0                  # long idle gap, no traffic
+        assert h._snap()["count"] == 0
+        assert math.isnan(hist_quantile(h._snap(), 0.99))
+
+    def test_window_covers_at_least_half(self):
+        # samples newer than window_s/2 are never expired
+        h, clock = self._h(window_s=10.0)
+        clock["t"] = 6.0
+        h.record(8.0)
+        h._fold(h._take_pending())
+        clock["t"] = 10.9                   # sample is 4.9s old < half
+        assert h._snap()["count"] == 1
+
+    def test_snapshot_wire_format_carries_window(self):
+        h, clock = self._h(window_s=10.0)
+        h.record(2.0)
+        h._fold(h._take_pending())
+        snap = h._snap()
+        assert snap["window_s"] == 10.0
+        assert set(snap) >= {"unit", "growth", "count", "sum", "min",
+                             "max", "zero", "buckets"}
+        # merged snapshots still accept the shape
+        merged = obs.merge_snapshots(
+            {0: {"histograms": {"w": snap}},
+             1: {"histograms": {"w": snap}}})
+        assert merged["histograms"]["w"]["count"] == 2
+
+    def test_registry_window_kwarg(self):
+        r = obs.MetricRegistry()
+        h = r.histogram("serve/queue_wait_s", unit="s", window_s=30.0)
+        assert h.window_s == 30.0
+        # repeat registration returns the SAME windowed metric
+        assert r.histogram("serve/queue_wait_s") is h

@@ -136,6 +136,11 @@ class FakeCoord:
     def delete(self, key):
         self.kv.pop(key, None)
 
+    def add(self, key, delta):
+        self.counters = getattr(self, "counters", {})
+        self.counters[key] = self.counters.get(key, 0) + int(delta)
+        return self.counters[key]
+
     def live(self):
         return set(self.live_set)
 
@@ -323,6 +328,164 @@ class TestElasticUnit:
         assert entries["00000000"]["assigned"] == "a"
         assert _counter("router/replica_deaths") - d0 == 0
         assert "b" not in router._dead
+
+
+class TestControlPlaneUnit:
+    """PR 9's router-side control-plane mechanisms, driven against
+    FakeCoord: join grace, drain accounting/steering, pool pinning,
+    fleet-wide degradation clamp, and the replica-index add-chain."""
+
+    def _reg_only(self, fc, ns, rid, rank, pool=None):
+        """A registration WITHOUT a heartbeat lease — the coord-store
+        state of a joiner that registered and is still compiling."""
+        info = {"replica_id": rid, "rank": rank}
+        if pool is not None:
+            info["pool"] = pool
+        fc.kv[f"{ns}/replica/{rid}"] = json.dumps(info).encode()
+
+    def test_join_grace_forgives_never_live_registration(self):
+        """A registered joiner with no heartbeat yet must NOT be swept
+        as dead inside the grace window — sweeping it deletes the
+        registration out from under the warming process (the PR 7
+        false-positive-death shape)."""
+        fc = FakeCoord()
+        ns = "grace"
+        _register(fc, ns, "a", 0)
+        router = Router(fc, namespace=ns, use_health=False,
+                        join_grace_s=30.0)
+        router._poll({}, {}, None)              # baseline fleet
+        self._reg_only(fc, ns, "slow", 1)       # mid-warmup joiner
+        d0 = _counter("router/replica_deaths")
+        router._poll({}, {}, None)
+        router._poll({}, {}, None)
+        assert "slow" not in router._dead
+        assert _counter("router/replica_deaths") - d0 == 0
+        assert f"{ns}/replica/slow" in fc.kv    # registration survives
+
+    def test_join_grace_expiry_sweeps_dead_joiner(self):
+        """Past the grace window a never-live registration IS swept: a
+        joiner that died during warmup must not pin its registration
+        (and the coordination residue around it) forever."""
+        fc = FakeCoord()
+        ns = "grace2"
+        _register(fc, ns, "a", 0)
+        router = Router(fc, namespace=ns, use_health=False,
+                        join_grace_s=0.0)
+        router._poll({}, {}, None)
+        self._reg_only(fc, ns, "stillborn", 1)
+        d0 = _counter("router/replica_deaths")
+        router._poll({}, {}, None)
+        assert "stillborn" in router._dead
+        assert _counter("router/replica_deaths") - d0 == 1
+        assert f"{ns}/replica/stillborn" not in fc.kv
+
+    def test_ever_live_member_gets_no_grace(self):
+        """Grace shields only NEVER-live joiners: once a replica has
+        heartbeated, a lapsed lease means death NOW — stretching kill
+        detection by the grace window would stall redispatch."""
+        fc = FakeCoord()
+        ns = "grace3"
+        _register(fc, ns, "a", 0)
+        _register(fc, ns, "b", 1)
+        router = Router(fc, namespace=ns, use_health=False,
+                        join_grace_s=1e6)
+        router._poll({}, {}, None)
+        fc.live_set.discard(f"{ns}:b")          # lease lapses
+        d0 = _counter("router/replica_deaths")
+        router._poll({}, {}, None)
+        assert "b" in router._dead
+        assert _counter("router/replica_deaths") - d0 == 1
+
+    def test_draining_departure_is_a_drain_not_a_death(self):
+        """A replica marked draining is steered around immediately, and
+        its eventual departure ticks ``router/drains`` — not the death
+        counter that pages an operator."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "drainacct"
+        _register(fc, ns, "a", 0)
+        _register(fc, ns, "b", 1)
+        router = Router(fc, namespace=ns, use_health=False)
+        fc.kv[f"{ns}/draining/a"] = b"1"
+        prompt = np.arange(4, dtype=np.int32)
+        entries = {"00000000": _entry(Request(prompt, 8, rid="x"))}
+        router._poll(entries, {}, None)
+        assert entries["00000000"]["assigned"] == "b"   # steered away
+        fc.live_set.discard(f"{ns}:a")          # drain completes
+        d0 = _counter("router/replica_deaths")
+        g0 = _counter("router/drains")
+        router._poll({}, {}, None)
+        assert _counter("router/drains") - g0 == 1
+        assert _counter("router/replica_deaths") - d0 == 0
+        assert f"{ns}/draining/a" not in fc.kv  # residue swept
+
+    def test_pool_pin_filters_candidates(self):
+        """The ``{ns}/pool`` key pins traffic to one pool tag; absent,
+        every pool serves (pre-blue-green fleets keep working)."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "pool"
+        self._reg_only(fc, ns, "a", 0, pool="blue")
+        self._reg_only(fc, ns, "b", 1, pool="green")
+        fc.live_set |= {f"{ns}:a", f"{ns}:b"}
+        router = Router(fc, namespace=ns, use_health=False)
+        prompt = np.arange(4, dtype=np.int32)
+        fc.kv[f"{ns}/pool"] = b"green"
+        e1 = {"00000000": _entry(Request(prompt, 8, rid="x"))}
+        router._poll(e1, {}, None)
+        assert e1["00000000"]["assigned"] == "b"
+        fc.kv[f"{ns}/pool"] = b"blue"
+        e2 = {"00000001": _entry(Request(prompt, 8, rid="y"))}
+        router._poll(e2, {}, None)
+        assert e2["00000001"]["assigned"] == "a"
+
+    def test_degraded_fleet_clamps_best_effort_at_router(self):
+        """When a candidate advertises ``serve/degraded``, the router
+        clamps best-effort (priority <= 0) budgets at dispatch so the
+        overload tier shrinks work before the replica must shed it —
+        priority traffic keeps its full budget."""
+        from tpudist.models.serving import Request
+
+        fc = FakeCoord()
+        ns = "degr"
+        _register(fc, ns, "a", 0)
+        _publish(fc, ns, 0, gauges={"serve/degraded": 1.0})
+        router = Router(fc, namespace=ns, use_health=False,
+                        degrade_max_new=4)
+        prompt = np.arange(4, dtype=np.int32)
+        c0 = _counter("router/degrade_clamped")
+        entries = {
+            "00000000": _entry(Request(prompt, 16, rid="cheap")),
+            "00000001": _entry(Request(prompt, 16, rid="vip",
+                                       priority=1)),
+        }
+        router._poll(entries, {}, None)
+        sent = {json.loads(fc.kv[k])["key"]:
+                json.loads(fc.kv[k])["max_new_tokens"]
+                for k in fc.keys(f"{ns}/inbox/a/")}
+        assert sent == {"00000000": 4, "00000001": 16}
+        assert _counter("router/degrade_clamped") - c0 == 1
+        from tpudist import obs
+        assert obs.snapshot()["gauges"]["router/degraded"]["value"] == 1.0
+
+    def test_alloc_replica_indices_chain(self):
+        """Concurrent scale-ups must never collide on replica indices:
+        allocation is an atomic add-chain, and seeding only advances
+        the chain when it is behind."""
+        from tpudist.runtime.router import (_seed_replica_index,
+                                            alloc_replica_indices)
+
+        fc = FakeCoord()
+        ns = "chain"
+        assert alloc_replica_indices(fc, 3, namespace=ns) == [0, 1, 2]
+        assert alloc_replica_indices(fc, 2, namespace=ns) == [3, 4]
+        _seed_replica_index(fc, 2, namespace=ns)    # behind: no-op
+        assert alloc_replica_indices(fc, 1, namespace=ns) == [5]
+        fc2 = FakeCoord()
+        _seed_replica_index(fc2, 4, namespace=ns)   # fresh chain
+        assert alloc_replica_indices(fc2, 1, namespace=ns) == [4]
 
 
 class TestFleetE2E:
@@ -540,6 +703,53 @@ class TestFleetE2E:
                   - before.get("router/replica_deaths",
                                {}).get("value", 0))
         assert deaths == 0                  # starved obs plane != death
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0", "r1"}
+        assert all(r["clean"] and r["pool_drained"]
+                   for r in reports.values())
+
+    @pytest.mark.slow
+    def test_delayed_heartbeat_joiner_survives_grace_window(self):
+        """Satellite regression for the joiner false-positive death:
+        TPUDIST_FAULT_HEARTBEAT_DELAY_S swallows r1's heartbeats for
+        its first 10 s, so the router polls a REGISTERED rid with no
+        lease — exactly a slow-warming joiner.  The grace window must
+        forgive it (no death, registration intact); its lease then
+        lands and it finishes as a normal member with a clean exit."""
+        from tpudist import obs
+
+        server, client = _coord_pair()
+        ns = "slow-joiner"
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"],
+            env_overrides={
+                1: {"TPUDIST_FAULT_HEARTBEAT_DELAY_S": "10"}})
+        before = obs.snapshot()["counters"]
+        try:
+            wait_live(client, 1, namespace=ns, timeout_s=90.0)
+            router = Router(client, namespace=ns, lost_after_s=1e6)
+            comps = router.run(_requests(6), timeout_s=120.0)
+            assert sorted(c.rid for c in comps) \
+                == [f"q{i}" for i in range(6)]
+            assert all(c.reason == "length" for c in comps)
+            # the joiner was never swept: not dead, registration kept
+            assert "r1" not in router._dead
+            assert client.get(f"{ns}/replica/r1") is not None
+            # ... and its delayed lease does land
+            wait_live(client, 2, namespace=ns, timeout_s=60.0)
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+        deaths = (after.get("router/replica_deaths", {}).get("value", 0)
+                  - before.get("router/replica_deaths",
+                               {}).get("value", 0))
+        assert deaths == 0
+        want = self._reference(6)
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, np.asarray(want[c.rid], np.int32))
         reports = exit_reports(client, namespace=ns)
         assert set(reports) == {"r0", "r1"}
         assert all(r["clean"] and r["pool_drained"]

@@ -602,3 +602,70 @@ class TestWeightHotSwap:
         [c] = loop.run([Request(_prompt(86, 4), 6, rid="q")])
         np.testing.assert_array_equal(
             c.tokens, _want2(params_v2, req.prompt, 6))
+
+
+class TestOverloadDegradation:
+    """ISSUE 9 overload tiers: priority-ordered shedding past the hard
+    queue bound, and the soft DEGRADED watermark that clamps
+    best-effort budgets before anything must be rejected."""
+
+    def test_shed_takes_lowest_priority_newest_first(self, params):
+        """Past ``max_queue`` the victim is the NEWEST request of the
+        LOWEST priority class — priority traffic survives overload even
+        when it arrived last."""
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8, max_queue=1)
+        reqs = [Request(_prompt(60 + i, 4), 6, rid=f"q{i}",
+                        priority=(1 if i == 4 else 0))
+                for i in range(5)]
+        comps = {c.rid: c for c in loop.run(reqs)}
+        served = {r for r, c in comps.items() if c.reason == "length"}
+        shed = {r for r, c in comps.items() if c.reason == "rejected"}
+        # q0 fills the slot; q4 (priority 1, newest arrival) outranks
+        # the whole best-effort backlog for the one queue place
+        assert served == {"q0", "q4"} and shed == {"q1", "q2", "q3"}
+        for rid in served:
+            np.testing.assert_array_equal(
+                comps[rid].tokens, _want(params, comps[rid].prompt, 6))
+
+    def test_degraded_clamps_best_effort_not_priority(self, params):
+        """Past the soft watermark, best-effort admissions get a short
+        answer (budget clamped to ``degrade_max_new``) instead of a
+        later rejection; priority admissions keep their full budget.
+        Results stay exact — a clamped request IS a shorter request."""
+        from tpudist import obs
+
+        c0 = obs.snapshot()["counters"].get(
+            "serve/degrade_clamped", {}).get("value", 0)
+        loop = ServeLoop(CFG, params, num_slots=1, steps_per_sync=4,
+                         prefill_chunk=8, degrade_queue=0,
+                         degrade_max_new=2)
+        reqs = [Request(_prompt(50, 4), 6, rid="head"),
+                Request(_prompt(51, 4), 6, rid="cheap"),
+                Request(_prompt(52, 4), 6, rid="vip", priority=1)]
+        comps = {c.rid: c for c in loop.run(reqs)}
+        assert all(c.reason == "length" for c in comps.values())
+        # head admitted before the backlog built: full budget
+        assert comps["head"].tokens.shape == (6,)
+        # cheap admitted DEGRADED: clamped, but exact for its budget
+        assert comps["cheap"].tokens.shape == (2,)
+        np.testing.assert_array_equal(
+            comps["cheap"].tokens,
+            _want(params, comps["cheap"].prompt, 2))
+        # vip admitted from the same degraded backlog: untouched
+        assert comps["vip"].tokens.shape == (6,)
+        c1 = obs.snapshot()["counters"]["serve/degrade_clamped"]["value"]
+        assert c1 - c0 == 1
+        # queue emptied at the end: the loop left degraded mode
+        assert obs.snapshot()["gauges"]["serve/degraded"]["value"] == 0.0
+
+    def test_degrade_queue_defaults_and_validation(self, params):
+        loop = ServeLoop(CFG, params, num_slots=1, max_queue=8)
+        assert loop.degrade_queue == 4      # soft watermark: half hard
+        loop = ServeLoop(CFG, params, num_slots=1)
+        assert loop.degrade_queue is None   # unbounded queue: no tiers
+        with pytest.raises(ValueError, match="degrade_queue"):
+            ServeLoop(CFG, params, num_slots=1, degrade_queue=-1)
+        with pytest.raises(ValueError, match="degrade_max_new"):
+            ServeLoop(CFG, params, num_slots=1, degrade_queue=2,
+                      degrade_max_new=0)

@@ -37,6 +37,7 @@ same kernels either way.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import deque
 from typing import Any, Optional, Sequence
@@ -79,12 +80,20 @@ class Request:
     tokens; one that expires mid-decode is killed at the next segment
     boundary, completes with the tokens generated so far, and refunds
     its KV block reservation — a stuck client can never pin pool
-    capacity forever."""
+    capacity forever.
+
+    ``priority`` ranks requests for overload degradation: 0 (default) is
+    best-effort, higher values are more important.  Under pressure the
+    loop degrades best-effort traffic FIRST — clamps its
+    ``max_new_tokens`` past the soft watermark, sheds it first at the
+    hard bound — so paid/interactive traffic keeps full service until
+    best-effort is exhausted."""
 
     prompt: np.ndarray            # [L] int32 tokens, L >= 1
     max_new_tokens: int
     rid: Any = None               # caller's correlation id
     deadline_s: float | None = None
+    priority: int = 0             # 0 = best-effort; higher = keep longer
 
 
 @dataclasses.dataclass
@@ -185,10 +194,20 @@ class ServeLoop:
         restores the fully synchronous loop.
       max_queue: bound on WAITING requests (excluding the ones already
         in slots).  ``None`` (default) keeps the queue unbounded; with a
-        bound, overflow requests are load-shed newest-first — they
-        complete immediately with ``reason="rejected"`` and tick the
+        bound, overflow requests are load-shed — lowest ``priority``
+        class first, newest-first within a class — completing
+        immediately with ``reason="rejected"`` and ticking the
         ``serve/rejected`` counter, which a router reads to back off a
         saturated replica instead of piling more work on it.
+      degrade_queue: soft overload watermark (defaults to
+        ``max_queue // 2`` when ``max_queue`` is set).  While the queue
+        sits above it the loop is DEGRADED (``serve/degraded`` gauge = 1)
+        and newly admitted best-effort requests (``priority == 0``) get
+        ``max_new_tokens`` clamped to ``degrade_max_new`` — shorter
+        answers for everyone beats no answer for the tail, and the clamp
+        engages BEFORE any request is rejected outright.
+      degrade_max_new: the degraded-mode ``max_new_tokens`` clamp for
+        best-effort traffic (default 32).
       decode_mode: "plain" (one model step per generated token) or
         "speculative" — the fused segment runs draft-K proposal +
         one-chunk target verification per round
@@ -232,6 +251,8 @@ class ServeLoop:
         kv_block_size: int = 128,
         kv_num_blocks: int | None = None,
         max_queue: int | None = None,
+        degrade_queue: int | None = None,
+        degrade_max_new: int = 32,
         decode_mode: str = "plain",
         draft_cfg: TransformerConfig | None = None,
         draft_params: Any = None,
@@ -418,6 +439,18 @@ class ServeLoop:
         # obs handles cached once; recording on the serve loop is host
         # ints/floats only, never a device fetch
         self.max_queue = None if max_queue is None else int(max_queue)
+        if degrade_queue is None and max_queue is not None:
+            degrade_queue = max(1, max_queue // 2)
+        if degrade_queue is not None and degrade_queue < 0:
+            raise ValueError(
+                f"degrade_queue must be >= 0, got {degrade_queue}")
+        if degrade_max_new < 1:
+            raise ValueError(
+                f"degrade_max_new must be >= 1, got {degrade_max_new}")
+        self.degrade_queue = (None if degrade_queue is None
+                              else int(degrade_queue))
+        self.degrade_max_new = int(degrade_max_new)
+        self._degraded = False
         # deadline clock, swappable by tests (deterministic expiry
         # without real sleeps); production uses wall time because
         # Request.deadline_s crosses process boundaries via the router
@@ -431,10 +464,19 @@ class ServeLoop:
         self._obs_timeouts = obs.counter("serve/timeouts", unit="reqs")
         self._obs_segments = obs.counter("serve/segments", unit="segments")
         self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
+        self._obs_degraded = obs.gauge("serve/degraded", unit="bool")
+        self._obs_degrade_clamped = obs.counter("serve/degrade_clamped",
+                                                unit="reqs")
         self._obs_latency = obs.histogram("serve/request_latency", unit="s")
         # enqueue -> admit: how long requests sit behind busy lanes (and,
-        # paged, behind a full block pool)
-        self._obs_queue_wait = obs.histogram("serve/queue_wait_s", unit="s")
+        # paged, behind a full block pool).  Sliding-window so the SLO
+        # gate and the autoscaler react to the LAST minute, not the
+        # process lifetime; <= 0 disables the window.
+        wait_window = float(
+            os.environ.get("TPUDIST_SERVE_WAIT_WINDOW_S", "60"))
+        self._obs_queue_wait = obs.histogram(
+            "serve/queue_wait_s", unit="s",
+            window_s=wait_window if wait_window > 0 else None)
         # host_wait = time run() actually BLOCKS on a segment fetch (the
         # np.asarray tail not hidden by later segments' compute); depth
         # is the live in-flight segment count
@@ -1130,11 +1172,23 @@ class ServeLoop:
                 pending.append((req, time.perf_counter()))
 
         def shed() -> None:
-            """Load-shed the queue down to ``max_queue`` — newest first,
-            so earlier arrivals keep their FIFO place."""
+            """Overload ladder.  Past the soft ``degrade_queue``
+            watermark the loop goes DEGRADED (admissions clamp
+            best-effort budgets — see admit_free).  Past the hard
+            ``max_queue`` bound it sheds: lowest ``priority`` class
+            first, newest-first within a class, so earlier arrivals keep
+            their FIFO place and important traffic is the LAST to be
+            rejected."""
+            self._degraded = (self.degrade_queue is not None
+                              and len(pending) > self.degrade_queue)
+            self._obs_degraded.set(1.0 if self._degraded else 0.0)
             while (self.max_queue is not None
                    and len(pending) > self.max_queue):
-                req, _ = pending.pop()
+                lowest = min(r.priority for r, _ in pending)
+                victim = max(i for i, (r, _) in enumerate(pending)
+                             if r.priority == lowest)
+                req, _ = pending[victim]
+                del pending[victim]
                 complete_unadmitted(req, "rejected")
             self._obs_queue.set(len(pending))
 
@@ -1219,6 +1273,14 @@ class ServeLoop:
                         # it, which would starve long prompts
                         break
                     pending.popleft()
+                    if (self._degraded and req.priority <= 0
+                            and req.max_new_tokens > self.degrade_max_new):
+                        # degraded mode: best-effort traffic gets a short
+                        # answer instead of (later) no answer.  A copy —
+                        # the caller's Request is never mutated.
+                        req = dataclasses.replace(
+                            req, max_new_tokens=self.degrade_max_new)
+                        self._obs_degrade_clamped.inc()
                     self._obs_queue_wait.record(time.perf_counter() - t_q)
                     with obs.span("serve/admit", slot=slot):
                         slot_state[slot] = self._admit(slot, req)
@@ -1450,4 +1512,8 @@ class ServeLoop:
                     if closed:
                         break
                     time.sleep(idle_wait_s)
+            # the queue drained on the way out: an idle loop must not
+            # keep advertising DEGRADED to the router
+            self._degraded = False
+            self._obs_degraded.set(0.0)
         return done

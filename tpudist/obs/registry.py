@@ -137,23 +137,50 @@ class Histogram:
 
     ``record`` accepts scalars or arrays (host or device) and never syncs;
     arrays count one observation per element (the fused train loop's
-    stacked [n]-step metrics weigh every step)."""
+    stacked [n]-step metrics weigh every step).
+
+    With ``window_s`` set the histogram is SLIDING-WINDOW: observations
+    expire so control loops (SLO admission, the autoscaler) react to the
+    last ``window_s`` seconds instead of the process lifetime — hours-old
+    queue-wait samples can neither mask a fresh spike nor pin the fleet
+    scaled-up after it passes.  Implementation is two rotating half-window
+    generations: folds land in the newest, snapshots merge the live ones,
+    and a generation older than the window is dropped wholesale — so a
+    snapshot always covers between ``window_s/2`` and ``window_s`` of
+    history with O(1) rotation cost and no per-observation timestamps.
+    Expiry happens at fold/snapshot time (lazy, like accumulation)."""
 
     def __init__(self, name: str, unit: str = "", help: str = "",  # noqa: A002
-                 growth: float = 2.0) -> None:
+                 growth: float = 2.0, window_s: float | None = None,
+                 clock=time.monotonic) -> None:
         if growth <= 1.0:
             raise ValueError(f"histogram growth must be > 1, got {growth}")
+        if window_s is not None and window_s <= 0:
+            raise ValueError(f"histogram window_s must be > 0, got {window_s}")
         self.name = name
         self.unit = unit
         self.help = help
         self.growth = growth
-        self._buckets: dict[int, int] = {}
-        self._zero = 0
-        self._count = 0
-        self._sum = 0.0
-        self._min: float | None = None
-        self._max: float | None = None
+        self.window_s = window_s
+        self._clock = clock
+        self._gens: list[dict] = [self._new_gen()]
         self._pending: list = []
+
+    def _new_gen(self) -> dict:
+        return {"start": self._clock(), "buckets": {}, "zero": 0,
+                "count": 0, "sum": 0.0, "min": None, "max": None}
+
+    def _rotate(self) -> None:
+        if self.window_s is None:
+            return
+        now = self._clock()
+        if now - self._gens[-1]["start"] >= self.window_s / 2.0:
+            self._gens.append(self._new_gen())
+            del self._gens[:-2]
+        # after a long quiet gap even the previous generation has expired
+        if len(self._gens) == 2 and \
+                now - self._gens[0]["start"] >= self.window_s:
+            del self._gens[0]
 
     def record(self, v) -> None:
         self._pending.append(v)
@@ -165,25 +192,27 @@ class Histogram:
     def _fold(self, host_values: list) -> None:
         import numpy as np
 
+        self._rotate()
+        g = self._gens[-1]
         for v in host_values:
             flat = np.asarray(v, dtype=np.float64).reshape(-1)
             if not flat.size:
                 continue
-            self._count += int(flat.size)
-            self._sum += float(flat.sum())
+            g["count"] += int(flat.size)
+            g["sum"] += float(flat.sum())
             lo, hi = float(flat.min()), float(flat.max())
-            self._min = lo if self._min is None else min(self._min, lo)
-            self._max = hi if self._max is None else max(self._max, hi)
+            g["min"] = lo if g["min"] is None else min(g["min"], lo)
+            g["max"] = hi if g["max"] is None else max(g["max"], hi)
             pos = flat[flat > 0]
-            self._zero += int(flat.size - pos.size)
+            g["zero"] += int(flat.size - pos.size)
             if pos.size:
                 # +1e-9 absorbs the float error of log-ratio at exact
                 # bucket boundaries (log(8)/log(2) may be 2.999...96)
                 idx = np.floor(
                     np.log(pos) / math.log(self.growth) + 1e-9).astype(int)
                 for i, n in zip(*np.unique(idx, return_counts=True)):
-                    self._buckets[int(i)] = (
-                        self._buckets.get(int(i), 0) + int(n))
+                    g["buckets"][int(i)] = (
+                        g["buckets"].get(int(i), 0) + int(n))
 
     def summary(self) -> dict:
         """p50/p90/p99 + count/sum/mean/min/max (syncs this histogram's
@@ -192,17 +221,35 @@ class Histogram:
         return summarize(self._snap())
 
     def _snap(self) -> dict:
-        return {
+        self._rotate()
+        buckets: dict[int, int] = {}
+        zero = count = 0
+        total = 0.0
+        mn = mx = None
+        for g in self._gens:
+            count += g["count"]
+            total += g["sum"]
+            zero += g["zero"]
+            if g["min"] is not None:
+                mn = g["min"] if mn is None else min(mn, g["min"])
+            if g["max"] is not None:
+                mx = g["max"] if mx is None else max(mx, g["max"])
+            for i, n in g["buckets"].items():
+                buckets[i] = buckets.get(i, 0) + n
+        snap = {
             "unit": self.unit,
             "growth": self.growth,
-            "count": self._count,
-            "sum": self._sum,
-            "min": self._min,
-            "max": self._max,
-            "zero": self._zero,
+            "count": count,
+            "sum": total,
+            "min": mn,
+            "max": mx,
+            "zero": zero,
             # string keys: the snapshot is the JSON wire format
-            "buckets": {str(i): c for i, c in sorted(self._buckets.items())},
+            "buckets": {str(i): c for i, c in sorted(buckets.items())},
         }
+        if self.window_s is not None:
+            snap["window_s"] = self.window_s
+        return snap
 
 
 def hist_quantile(hist: dict, q: float) -> float:
@@ -271,8 +318,10 @@ class MetricRegistry:
         return self._get(name, Gauge, unit=unit, help=help)
 
     def histogram(self, name: str, unit: str = "", help: str = "",  # noqa: A002
-                  growth: float = 2.0) -> Histogram:
-        return self._get(name, Histogram, unit=unit, help=help, growth=growth)
+                  growth: float = 2.0,
+                  window_s: float | None = None) -> Histogram:
+        return self._get(name, Histogram, unit=unit, help=help, growth=growth,
+                         window_s=window_s)
 
     def metrics(self) -> dict:
         with self._lock:

@@ -1,0 +1,440 @@
+"""Autoscaling control plane for the serve fleet.
+
+ROADMAP item 4's policy layer: PR 6-8 built the *mechanisms* — live
+join (:func:`~tpudist.runtime.router.scale_fleet`), graceful drain
+(``{ns}/draining/{rid}`` + the replica's zero-loss close path), health
+-aware routing — but a human still had to watch queue-wait percentiles
+and call ``scale_fleet`` by hand.  This module closes the loop: a
+rank-0 control process watches the SAME merged ``serve/queue_wait_s``
+percentiles the router's SLO gate reads (sliding-window, so an
+hours-old spike can neither mask fresh load nor pin the fleet up) plus
+``serve/queue_depth`` / ``serve/kv_blocks_free``, and drives the fleet
+itself.
+
+Policy — target-tracking with ASYMMETRIC hysteresis:
+
+* **Scale up** after ``breach_polls`` CONSECUTIVE polls with the
+  watched percentile above ``target_wait_s`` (a single slow poll is
+  noise; a sustained breach is load), bounded by ``max_replicas`` and
+  an ``up_cooldown_s`` per-direction cooldown so one breach episode
+  produces one scale-up, not one per poll while the joiner compiles.
+  Joiners mid-warmup (spawned, not yet heartbeating) count toward the
+  bound for the same reason.
+* **Scale down** only after a MUCH longer sustained-idle window
+  (``idle_polls`` consecutive polls below ``low_wait_s`` with an empty
+  queue) plus ``down_cooldown_s``: adding capacity late costs SLO,
+  removing it early costs a re-scale-up — so up is eager, down is
+  reluctant.
+* **Scale-down is a graceful drain, never a kill**: the victim gets a
+  ``draining`` mark (the router steers admissions away immediately),
+  its inbox empties, THEN the targeted stop key lands — the worker's
+  close path finishes queued and in-flight work, commits every
+  completion, and exits cleanly.  The autoscaler never loses a
+  request; ``autoscale/drain_completed`` ticks only after the lease is
+  gone and the coordination residue is swept.
+
+Every knob is env-tunable (``TPUDIST_AUTOSCALE_*`` — see
+:meth:`AutoscaleConfig.from_env`), and the control loop is a plain
+:meth:`Autoscaler.poll` method so tests drive it deterministically
+against a fake coordination client; :meth:`Autoscaler.start` wraps it
+in the background thread a deployment runs.
+
+The ``TPUDIST_FAULT_AUTOSCALE_POLL_DELAY_S`` injection stalls each
+poll — a wedged control plane.  The data plane must keep serving
+through it (the autoscaler holds no locks and sits on no request
+path); scaling is merely late.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Callable, Sequence
+
+from tpudist import obs
+from tpudist.obs.aggregate import collect, merge_snapshots
+from tpudist.obs.registry import hist_quantile
+from tpudist.runtime import faults
+from tpudist.runtime.coord import CoordClient
+from tpudist.runtime.router import DEFAULT_NAMESPACE, scale_fleet
+from tpudist.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+__all__ = ["AutoscaleConfig", "Autoscaler"]
+
+ENV_PREFIX = "TPUDIST_AUTOSCALE_"
+
+
+def _env(environ, name: str) -> float | None:
+    raw = environ.get(ENV_PREFIX + name)
+    if raw is None or raw.strip() == "":
+        return None
+    return float(raw)
+
+
+class AutoscaleConfig:
+    """The target-tracking policy's knobs (all env-overridable).
+
+    ``low_wait_s`` defaults to ``target_wait_s / 4``: the idle band and
+    the breach band must not touch, or the policy oscillates at the
+    boundary."""
+
+    def __init__(self, *,
+                 min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 target_wait_s: float = 0.5,
+                 low_wait_s: float | None = None,
+                 quantile: float = 0.9,
+                 breach_polls: int = 3,
+                 idle_polls: int = 10,
+                 up_cooldown_s: float = 5.0,
+                 down_cooldown_s: float = 20.0,
+                 poll_s: float = 0.5,
+                 step: int = 1,
+                 max_metric_age_s: float = 5.0) -> None:
+        if min_replicas < 0 or max_replicas < max(min_replicas, 1):
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas (>=1), got "
+                f"{min_replicas}/{max_replicas}")
+        if target_wait_s <= 0:
+            raise ValueError(
+                f"target_wait_s must be > 0, got {target_wait_s}")
+        if low_wait_s is None:
+            low_wait_s = target_wait_s / 4.0
+        if not 0.0 <= low_wait_s < target_wait_s:
+            raise ValueError(
+                f"need 0 <= low_wait_s < target_wait_s, got "
+                f"{low_wait_s} vs {target_wait_s}")
+        if not 0.0 < quantile <= 1.0:
+            raise ValueError(f"quantile must be in (0, 1], got {quantile}")
+        if breach_polls < 1 or idle_polls < 1 or step < 1:
+            raise ValueError("breach_polls, idle_polls and step must "
+                             "all be >= 1")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.target_wait_s = float(target_wait_s)
+        self.low_wait_s = float(low_wait_s)
+        self.quantile = float(quantile)
+        self.breach_polls = int(breach_polls)
+        self.idle_polls = int(idle_polls)
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.poll_s = float(poll_s)
+        self.step = int(step)
+        self.max_metric_age_s = float(max_metric_age_s)
+
+    @classmethod
+    def from_env(cls, environ=None, **overrides) -> "AutoscaleConfig":
+        import os
+
+        env = os.environ if environ is None else environ
+        kw: dict = {}
+        for name, key, cast in (
+                ("MIN_REPLICAS", "min_replicas", int),
+                ("MAX_REPLICAS", "max_replicas", int),
+                ("TARGET_WAIT_S", "target_wait_s", float),
+                ("LOW_WAIT_S", "low_wait_s", float),
+                ("QUANTILE", "quantile", float),
+                ("BREACH_POLLS", "breach_polls", int),
+                ("IDLE_POLLS", "idle_polls", int),
+                ("UP_COOLDOWN_S", "up_cooldown_s", float),
+                ("DOWN_COOLDOWN_S", "down_cooldown_s", float),
+                ("POLL_S", "poll_s", float),
+                ("STEP", "step", int),
+                ("MAX_METRIC_AGE_S", "max_metric_age_s", float)):
+            v = _env(env, name)
+            if v is not None:
+                kw[key] = cast(v)
+        kw.update(overrides)
+        return cls(**kw)
+
+
+class Autoscaler:
+    """The rank-0 control loop.
+
+    Args:
+      client: coord client (the autoscaler's own).
+      coord_addr: ``host:port`` handed to the default spawner
+        (:func:`~tpudist.runtime.router.scale_fleet` with chain-
+        allocated indices).  Optional when ``spawner`` is injected.
+      config: the policy; defaults to :meth:`AutoscaleConfig.from_env`.
+      spawner: ``spawner(n) -> list[Popen]`` override (tests inject a
+        fake; multi-host deployments inject their pod launcher).
+      replica_args / platform: forwarded to the default spawner so
+        joiners run the same serve configuration as the fleet.
+      clock: injectable monotonic clock (deterministic cooldown tests).
+
+    :meth:`poll` is ONE control decision — observe, decide, act — and
+    returns a record of what it saw and did.  :meth:`start` runs it on
+    ``config.poll_s`` cadence in a daemon thread.
+    """
+
+    def __init__(self, client: CoordClient, *,
+                 coord_addr: str | None = None,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 config: AutoscaleConfig | None = None,
+                 spawner: Callable[[int], list] | None = None,
+                 replica_args: Sequence[str] = (),
+                 env_extra: dict | None = None,
+                 platform: str = "cpu",
+                 clock=time.monotonic) -> None:
+        self.client = client
+        self.ns = namespace
+        self.cfg = config or AutoscaleConfig.from_env()
+        self.replica_args = list(replica_args)
+        self.env_extra = dict(env_extra or {})
+        self.platform = platform
+        self._clock = clock
+        if spawner is None:
+            if coord_addr is None:
+                raise ValueError(
+                    "need coord_addr for the default scale_fleet "
+                    "spawner (or inject spawner=)")
+            spawner = self._default_spawner
+        self.coord_addr = coord_addr
+        self.spawner = spawner
+        self.procs: list = []          # joiners spawned by this loop
+        self._drains: set[str] = set()  # rids THIS loop marked draining
+        self._breach = 0
+        self._idle = 0
+        self._last_up: float | None = None
+        self._last_down: float | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._obs_ups = obs.counter("autoscale/scale_ups", unit="replicas")
+        self._obs_downs = obs.counter("autoscale/scale_downs",
+                                      unit="replicas")
+        self._obs_drained = obs.counter("autoscale/drain_completed",
+                                        unit="replicas")
+        self._obs_polls = obs.counter("autoscale/polls", unit="polls")
+        self._obs_replicas = obs.gauge("autoscale/replicas",
+                                       unit="replicas")
+        self._obs_wait = obs.gauge("autoscale/wait_q", unit="s")
+        self._obs_breach = obs.gauge("autoscale/breach_polls",
+                                     unit="polls")
+        self._obs_idle = obs.gauge("autoscale/idle_polls", unit="polls")
+
+    def _default_spawner(self, n: int) -> list:
+        return scale_fleet(self.coord_addr, n, namespace=self.ns,
+                           replica_args=self.replica_args,
+                           env_extra=self.env_extra,
+                           platform=self.platform)
+
+    # -- fleet observation -------------------------------------------------
+
+    def live(self) -> set[str]:
+        mark = f"{self.ns}:"
+        return {name[len(mark):] for name in self.client.live()
+                if name.startswith(mark)}
+
+    def draining(self) -> set[str]:
+        prefix = f"{self.ns}/draining/"
+        return {k[len(prefix):] for k in self.client.keys(prefix)}
+
+    def _registrations(self) -> dict[str, dict]:
+        out = {}
+        prefix = f"{self.ns}/replica/"
+        for key in self.client.keys(prefix):
+            raw = self.client.get(key)
+            if raw is not None:
+                out[key[len(prefix):]] = json.loads(raw.decode())
+        return out
+
+    def _observe(self) -> dict:
+        """The merged fleet view one decision is made from."""
+        live = self.live()
+        draining = self.draining()
+        snaps = collect(self.client, f"{self.ns}/metrics",
+                        max_age_s=self.cfg.max_metric_age_s)
+        merged = merge_snapshots(snaps)
+        wait = merged["histograms"].get("serve/queue_wait_s")
+        wait_q = (hist_quantile(wait, self.cfg.quantile)
+                  if wait and wait["count"] else 0.0)
+        if math.isnan(wait_q):
+            wait_q = 0.0
+        depth = (merged["gauges"].get("serve/queue_depth")
+                 or {}).get("value") or 0.0
+        free = (merged["gauges"].get("serve/kv_blocks_free")
+                or {}).get("value")
+        return {"live": live, "draining": draining, "wait_q": wait_q,
+                "queue_depth": depth, "kv_blocks_free": free,
+                "snaps": snaps}
+
+    def _pending_joiners(self, live: set[str]) -> list:
+        """Spawned-but-not-yet-heartbeating joiners: count them toward
+        the max bound (and as capacity-on-the-way) so a breach episode
+        during a joiner's compile doesn't stack a second scale-up."""
+        return [p for p in self.procs
+                if p.poll() is None
+                and f"r{getattr(p, 'replica_index', -1)}" not in live]
+
+    # -- the drain state machine (one tick per poll) -----------------------
+
+    def _tick_drains(self, live: set[str], draining: set[str]) -> None:
+        """Advance in-progress graceful drains: a draining replica with
+        an empty inbox gets its targeted stop key (its close path
+        finishes all accepted work first — zero loss); one whose lease
+        is gone gets its coordination residue swept."""
+        regs = self._registrations()
+        # union with the loop's own memory: the router's drain-
+        # departure path may sweep the coord key first (it polls on the
+        # request path and usually wins the race) — completion must be
+        # counted either way
+        for rid in sorted(draining | self._drains):
+            if rid in live:
+                if (self.client.get(f"{self.ns}/stop/{rid}") is None
+                        and not self.client.keys(
+                            f"{self.ns}/inbox/{rid}/")):
+                    self.client.set(f"{self.ns}/stop/{rid}", b"1")
+                    log.info("autoscale: replica %s inbox empty; "
+                             "stopping it", rid)
+                continue
+            for key in (f"{self.ns}/draining/{rid}",
+                        f"{self.ns}/stop/{rid}",
+                        f"{self.ns}/replica/{rid}",
+                        f"{self.ns}/metrics/"
+                        f"{regs.get(rid, {}).get('rank')}"):
+                try:
+                    self.client.delete(key)
+                except OSError:
+                    pass
+            self._drains.discard(rid)
+            self._obs_drained.inc()
+            log.info("autoscale: replica %s drain complete", rid)
+
+    def _pick_victim(self, active: set[str],
+                     snaps: dict[int, dict]) -> str | None:
+        """Least-loaded active replica: fewest queued requests, then
+        most free KV blocks — draining it strands the least work."""
+        regs = self._registrations()
+        rank_to_rid = {int(info.get("rank", -1)): rid
+                       for rid, info in regs.items()}
+        scores: dict[str, tuple] = {}
+        for rank, snap in snaps.items():
+            rid = rank_to_rid.get(rank)
+            if rid not in active:
+                continue
+            gauges = snap.get("gauges", {})
+            depth = (gauges.get("serve/queue_depth") or {}).get(
+                "value") or 0.0
+            free = (gauges.get("serve/kv_blocks_free") or {}).get("value")
+            scores[rid] = (depth, -(free if free is not None
+                                    else float("inf")))
+        if not scores:
+            return sorted(active)[0] if active else None
+        return min(sorted(scores), key=lambda r: scores[r])
+
+    # -- one control decision ----------------------------------------------
+
+    def poll(self) -> dict:
+        """Observe -> decide -> act, once.  Returns the decision record
+        (tests assert on it; the bench logs it)."""
+        faults.autoscale_poll()
+        self._obs_polls.inc()
+        view = self._observe()
+        live, draining = view["live"], view["draining"]
+        self._tick_drains(live, draining)
+        active = live - draining
+        pending = self._pending_joiners(live)
+        now = self._clock()
+        action = None
+
+        if view["wait_q"] > self.cfg.target_wait_s:
+            self._breach += 1
+            self._idle = 0
+        elif (view["wait_q"] < self.cfg.low_wait_s
+              and view["queue_depth"] <= 0):
+            self._idle += 1
+            self._breach = 0
+        else:
+            # the hysteresis band: neither direction makes progress
+            self._breach = 0
+            self._idle = 0
+
+        capacity = len(active) + len(pending)
+        if (self._breach >= self.cfg.breach_polls
+                and capacity < self.cfg.max_replicas
+                and (self._last_up is None
+                     or now - self._last_up >= self.cfg.up_cooldown_s)):
+            n = min(self.cfg.step, self.cfg.max_replicas - capacity)
+            log.info("autoscale: wait %s=%.3fs > target %.3fs for %d "
+                     "polls; scaling up by %d (active=%d pending=%d)",
+                     f"p{int(self.cfg.quantile * 100)}", view["wait_q"],
+                     self.cfg.target_wait_s, self._breach, n,
+                     len(active), len(pending))
+            self.procs.extend(self.spawner(n))
+            self._obs_ups.inc(n)
+            self._last_up = now
+            self._breach = 0
+            action = ("up", n)
+        elif (self._idle >= self.cfg.idle_polls
+                and len(active) > self.cfg.min_replicas
+                and not draining   # one graceful drain at a time
+                and not pending
+                and (self._last_down is None
+                     or now - self._last_down
+                     >= self.cfg.down_cooldown_s)):
+            victim = self._pick_victim(active, view["snaps"])
+            if victim is not None:
+                log.info("autoscale: idle for %d polls (wait=%.3fs); "
+                         "draining %s down", self._idle, view["wait_q"],
+                         victim)
+                self.client.set(f"{self.ns}/draining/{victim}", b"1")
+                self._drains.add(victim)
+                self._obs_downs.inc()
+                self._last_down = now
+                self._idle = 0
+                action = ("down", victim)
+
+        self._obs_replicas.set(len(active))
+        self._obs_wait.set(view["wait_q"])
+        self._obs_breach.set(self._breach)
+        self._obs_idle.set(self._idle)
+        return {"action": action, "wait_q": view["wait_q"],
+                "active": sorted(active), "draining": sorted(draining),
+                "pending": len(pending),
+                "queue_depth": view["queue_depth"],
+                "breach": self._breach, "idle": self._idle}
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        """Run :meth:`poll` on ``config.poll_s`` cadence in a daemon
+        thread until :meth:`stop`.  Poll errors are logged and retried
+        next tick — a flaky coord RPC must not kill the control
+        plane."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        # treat loop start as the most recent scale-down: a freshly
+        # started control plane sees an idle fleet for the first few
+        # polls (no traffic has produced metrics yet) and must not
+        # drain capacity before down_cooldown_s of real observation
+        if self._last_down is None:
+            self._last_down = self._clock()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll()
+                except Exception as e:  # noqa: BLE001
+                    # the control plane must outlive any single bad
+                    # poll (flaky RPC, torn metrics JSON, ...)
+                    log.warning("autoscale: poll failed (%r); retrying "
+                                "next tick", e)
+                self._stop.wait(self.cfg.poll_s)
+
+        self._thread = threading.Thread(target=loop,
+                                        name="tpudist-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

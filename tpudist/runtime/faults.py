@@ -53,6 +53,24 @@ Environment knobs (all optional):
                                     uptime exceeds this many seconds
                                     (heartbeats keep flowing: the replica
                                     goes ``stale``, not ``lost``)
+``TPUDIST_FAULT_HEARTBEAT_DELAY_S``
+                                    swallow heartbeats while process uptime
+                                    is BELOW this many seconds — a slow
+                                    joiner (snapshot restore + compile)
+                                    that registers long before its first
+                                    lease refresh lands
+``TPUDIST_FAULT_KILL_AT_WARMUP``    SIGKILL self at the replica warmup
+                                    point (after registration, before the
+                                    first heartbeat) — a joiner torn down
+                                    mid-warmup
+``TPUDIST_FAULT_CANARY_CORRUPT``    flip a token in every completion whose
+                                    request id starts with ``canary`` — a
+                                    green pool that warms, heartbeats, and
+                                    then serves WRONG output
+``TPUDIST_FAULT_AUTOSCALE_POLL_DELAY_S``
+                                    stall every autoscaler control poll by
+                                    this many seconds — a wedged control
+                                    plane that must not lose requests
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -66,7 +84,8 @@ import threading
 import time
 
 __all__ = ["FaultInjected", "FaultPlan", "plan", "install", "reset",
-           "coord_op", "drop_heartbeat", "drop_publish", "on_segment"]
+           "coord_op", "drop_heartbeat", "drop_publish", "on_segment",
+           "on_warmup", "corrupt_canary", "autoscale_poll"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -97,6 +116,10 @@ class FaultPlan:
         heartbeat_stop_after_s: float | None = None,
         kill_after_segments: int | None = None,
         publish_drop_after_s: float | None = None,
+        heartbeat_delay_s: float | None = None,
+        kill_at_warmup: bool = False,
+        canary_corrupt: bool = False,
+        autoscale_poll_delay_s: float | None = None,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -112,6 +135,10 @@ class FaultPlan:
         self.kill_after_segments = (None if kill_after_segments is None
                                     else int(kill_after_segments))
         self.publish_drop_after_s = publish_drop_after_s
+        self.heartbeat_delay_s = heartbeat_delay_s
+        self.kill_at_warmup = bool(kill_at_warmup)
+        self.canary_corrupt = bool(canary_corrupt)
+        self.autoscale_poll_delay_s = autoscale_poll_delay_s
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
@@ -119,11 +146,16 @@ class FaultPlan:
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
-                         "heartbeat_drop": 0, "publish_drop": 0}
+                         "heartbeat_drop": 0, "publish_drop": 0,
+                         "heartbeat_delay": 0, "canary_corrupt": 0,
+                         "autoscale_delay": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
                            or kill_after_segments is not None
-                           or publish_drop_after_s is not None)
+                           or publish_drop_after_s is not None
+                           or heartbeat_delay_s is not None
+                           or kill_at_warmup or canary_corrupt
+                           or autoscale_poll_delay_s is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -139,6 +171,10 @@ class FaultPlan:
             heartbeat_stop_after_s=hb,
             kill_after_segments=None if kill is None else int(kill),
             publish_drop_after_s=_env_float(env, "PUBLISH_DROP"),
+            heartbeat_delay_s=_env_float(env, "HEARTBEAT_DELAY_S"),
+            kill_at_warmup=bool(_env_float(env, "KILL_AT_WARMUP") or 0),
+            canary_corrupt=bool(_env_float(env, "CANARY_CORRUPT") or 0),
+            autoscale_poll_delay_s=_env_float(env, "AUTOSCALE_POLL_DELAY_S"),
             seed=int(_env_float(env, "SEED") or 0),
         )
 
@@ -163,10 +199,20 @@ class FaultPlan:
             raise FaultInjected(f"injected fault: coord {op}")
 
     def drop_heartbeat(self) -> bool:
-        """True when this process's heartbeats should be swallowed."""
+        """True when this process's heartbeats should be swallowed —
+        either forever once uptime passes ``heartbeat_stop_after_s`` (a
+        dying worker), or only WHILE uptime is below ``heartbeat_delay_s``
+        (a slow-warming joiner whose first lease refresh lags its
+        registration)."""
+        uptime = time.monotonic() - self._born
+        if self.heartbeat_delay_s is not None \
+                and uptime < self.heartbeat_delay_s:
+            with self._lock:
+                self.injected["heartbeat_delay"] += 1
+            return True
         if self.heartbeat_stop_after_s is None:
             return False
-        if time.monotonic() - self._born < self.heartbeat_stop_after_s:
+        if uptime < self.heartbeat_stop_after_s:
             return False
         with self._lock:
             self.injected["heartbeat_drop"] += 1
@@ -196,6 +242,32 @@ class FaultPlan:
             n = self._segments
         if n >= self.kill_after_segments:
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_warmup(self) -> None:
+        """SIGKILL self at the replica warmup point — a joiner that
+        registered but dies before its first heartbeat.  The router's
+        grace window must not leave its registration pinned forever."""
+        if self.kill_at_warmup:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def corrupt_canary(self, rid: str) -> bool:
+        """True when this completion's tokens should be corrupted: the
+        green-pool failure the blue-green canary check exists to catch
+        (warmed, heartbeating, and WRONG)."""
+        if not (self.canary_corrupt and rid.startswith("canary")):
+            return False
+        with self._lock:
+            self.injected["canary_corrupt"] += 1
+        return True
+
+    def autoscale_poll(self) -> None:
+        """Stall one autoscaler control poll (a wedged control plane —
+        the data plane must keep serving, just without scaling)."""
+        if self.autoscale_poll_delay_s is None:
+            return
+        with self._lock:
+            self.injected["autoscale_delay"] += 1
+        time.sleep(self.autoscale_poll_delay_s)
 
 
 _INERT = FaultPlan()
@@ -243,3 +315,20 @@ def on_segment() -> None:
     p = plan()
     if p.active:
         p.on_segment()
+
+
+def on_warmup() -> None:
+    p = plan()
+    if p.active:
+        p.on_warmup()
+
+
+def corrupt_canary(rid: str) -> bool:
+    p = plan()
+    return p.active and p.corrupt_canary(rid)
+
+
+def autoscale_poll() -> None:
+    p = plan()
+    if p.active:
+        p.autoscale_poll()

@@ -78,16 +78,58 @@ fleet elastic UPWARD, not just shrink-on-death):
   (``slo_quantile``, default p99) predicts a miss — before the request
   ever costs any replica a prefill.
 
+Fleet control plane (ISSUE 9 — the policy layer over those mechanisms):
+
+* **Join grace** — the death sweep covers registered-but-not-yet-live
+  rids (so a joiner that died during warmup cannot pin its
+  registration), but a NEVER-live registration younger than
+  ``join_grace_s`` is forgiven: a slow-warming joiner (minutes of
+  compile before its first heartbeat) must not be swept as dead.  Once
+  a replica has ever heartbeated, a lapsed lease is death NOW — grace
+  never stretches kill detection.
+* **Graceful drain** — ``{ns}/draining/{rid}`` (:func:`request_drain`)
+  steers admissions away immediately; :func:`drain_replicas` stops the
+  replica only once its inbox is empty (the worker's close path
+  finishes queued + in-flight work and commits every completion), then
+  sweeps the coordination residue.  A draining departure ticks
+  ``router/drains``, not the ``router/replica_deaths`` counter that
+  pages an operator.
+* **Blue-green structural rollout** — :meth:`Router.roll_structural`
+  spins up a tagged green pool (``--pool``), warms it, exact-checks a
+  canary request against a reference, then commits by shifting the
+  ``{ns}/pool`` pin and draining blue; any warmup/canary failure rolls
+  back with blue never touched.  The in-place hot-swap handles weight
+  DELTAS; this handles changes a running loop cannot absorb.
+* **Overload degradation** — past a replica's soft ``degrade_queue``
+  watermark it advertises ``serve/degraded`` and clamps best-effort
+  (``Request.priority <= 0``) budgets to ``degrade_max_new``; past the
+  hard ``max_queue`` bound it sheds lowest-priority-newest-first.  The
+  router mirrors the fleet's degraded state (``router/degraded``) and
+  clamps best-effort budgets at dispatch (``degrade_max_new``).
+* **Collision-safe scale-up** — replica indices come from an atomic
+  add-chain (``{ns}/replica_index`` via :func:`alloc_replica_indices`),
+  so concurrent :func:`scale_fleet` callers (autoscaler + operator)
+  can never mint the same rid.
+
+The autoscaler (:mod:`tpudist.runtime.autoscaler`) closes the loop:
+it watches the fleet-merged windowed ``serve/queue_wait_s`` percentile
+and drives :func:`scale_fleet` / the drain protocol itself.
+
 The fault-injection harness (:mod:`tpudist.runtime.faults`,
 ``TPUDIST_FAULT_*``) exercises all of this deterministically: coord-op
 errors/delays hit the retry paths, ``KILL_AFTER_SEGMENTS`` SIGKILLs a
 replica mid-decode, ``HEARTBEAT_STOP_AFTER_S`` fakes death without
 stopping the worker, ``PUBLISH_DROP`` starves the obs plane so the
-health monitor's ``stale`` verdict steers routing without a death.
+health monitor's ``stale`` verdict steers routing without a death,
+``HEARTBEAT_DELAY_S`` recreates the slow-warming joiner,
+``KILL_AT_WARMUP`` SIGKILLs a joiner between registration and its
+first heartbeat, and ``CANARY_CORRUPT`` forces the green pool to serve
+wrong canary output so the rollback path runs for real.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -102,6 +144,7 @@ from tpudist import obs
 from tpudist.obs.aggregate import collect, MetricsPublisher
 from tpudist.obs.health import HealthMonitor
 from tpudist.obs.registry import hist_quantile
+from tpudist.runtime import faults
 from tpudist.runtime.coord import CoordClient, ElasticMonitor
 from tpudist.utils.logging import get_logger
 
@@ -109,7 +152,8 @@ log = get_logger(__name__)
 
 __all__ = ["Router", "ReplicaWorker", "build_tiny_lm",
            "launch_local_fleet", "scale_fleet", "stop_fleet",
-           "exit_reports", "wait_live", "roll_weights", "wait_swapped"]
+           "exit_reports", "wait_live", "roll_weights", "wait_swapped",
+           "alloc_replica_indices", "request_drain", "drain_replicas"]
 
 DEFAULT_NAMESPACE = "fleet"
 
@@ -122,6 +166,7 @@ def _encode_request(key: str, req) -> bytes:
         "prompt": np.asarray(req.prompt).astype(int).tolist(),
         "max_new_tokens": int(req.max_new_tokens),
         "deadline_s": req.deadline_s,
+        "priority": int(getattr(req, "priority", 0)),
     }).encode()
 
 
@@ -131,7 +176,8 @@ def _decode_request(raw: bytes):
     d = json.loads(raw.decode())
     return Request(prompt=np.asarray(d["prompt"], np.int32),
                    max_new_tokens=int(d["max_new_tokens"]),
-                   rid=d["key"], deadline_s=d.get("deadline_s"))
+                   rid=d["key"], deadline_s=d.get("deadline_s"),
+                   priority=int(d.get("priority", 0)))
 
 
 def _encode_completion(replica_id: str, comp) -> bytes:
@@ -177,12 +223,17 @@ class ReplicaWorker:
                  ttl_s: float = 2.0, publish_interval_s: float = 0.25,
                  idle_wait_s: float = 0.01,
                  snapshot_dir: str | os.PathLike | None = None,
-                 swap_turn_timeout_s: float = 10.0) -> None:
+                 swap_turn_timeout_s: float = 10.0,
+                 pool: str = "default") -> None:
         self.loop = loop
         self.client = client
         self.replica_id = replica_id
         self.rank = int(rank)
         self.ns = namespace
+        # blue-green pool tag: the router only dispatches to the ACTIVE
+        # pool ({ns}/pool key); a structural rollout spawns replicas
+        # under a new tag and shifts the key after the canary passes
+        self.pool = str(pool)
         self.ttl_s = float(ttl_s)
         self.idle_wait_s = idle_wait_s
         self.snapshot_dir = snapshot_dir
@@ -225,6 +276,7 @@ class ReplicaWorker:
             "kv_num_blocks": self.loop.kv_num_blocks or None,
             "kv_block_size": self.loop.kv_block_size or None,
             "ttl_s": self.ttl_s,
+            "pool": self.pool,
         }
         self.client.set(f"{self.ns}/replica/{self.replica_id}",
                         json.dumps(info).encode())
@@ -367,6 +419,14 @@ class ReplicaWorker:
         """Commit one completion.  This write is the commit point of the
         exactly-once contract: a replica that dies before it leaves no
         trace, and the router redispatches."""
+        if faults.corrupt_canary(str(comp.rid)):
+            # injected green-pool wrongness: the replica warms, beats,
+            # and serves CORRUPT output — exactly what the blue-green
+            # canary exact-check must catch before traffic shifts
+            tokens = np.asarray(comp.tokens, np.int32)
+            tokens = (tokens + 1 if tokens.size
+                      else np.asarray([1], np.int32))
+            comp = dataclasses.replace(comp, tokens=tokens)
         self.client.set(f"{self.ns}/done/{comp.rid}",
                         _encode_completion(self.replica_id, comp))
         self._served += 1
@@ -380,6 +440,10 @@ class ReplicaWorker:
 
     def serve(self) -> None:
         self.register()
+        # registered but not yet heartbeating: the joiner-death window
+        # the router's registration grace must bound (KILL_AT_WARMUP
+        # dies here — registration persists, no lease ever appears)
+        faults.on_warmup()
         self._hb.start(0)
         self._pub.start()
         self._pub.publish()   # immediate: the router gates on load info
@@ -440,6 +504,8 @@ class Router:
                  stale_after_s: float = 3.0,
                  lost_after_s: float = 10.0,
                  slo_quantile: float = 0.99,
+                 join_grace_s: float = 30.0,
+                 degrade_max_new: int | None = None,
                  use_health: bool = True) -> None:
         self.client = client
         self.ns = namespace
@@ -450,6 +516,9 @@ class Router:
             raise ValueError(
                 f"slo_quantile must be in (0, 1], got {slo_quantile}")
         self.slo_quantile = float(slo_quantile)
+        self.join_grace_s = float(join_grace_s)
+        self.degrade_max_new = (None if degrade_max_new is None
+                                else int(degrade_max_new))
         self._health = (HealthMonitor(
             client=client, namespace=f"{namespace}/metrics",
             signal="serve/queue_wait_s", skew_threshold=4.0,
@@ -461,6 +530,14 @@ class Router:
         #   every member seen since; later arrivals are JOINS
         self._backoff: dict[str, float] = {}           # rid -> until (mono)
         self._rejected_seen: dict[str, float] = {}     # rid -> watermark
+        # registration→first-heartbeat grace bookkeeping: when each
+        # registration was FIRST observed, and which rids have ever held
+        # a lease (grace only shields never-live joiners — a member that
+        # heartbeat once and stops is a real death, not a slow warmup)
+        self._reg_seen: dict[str, float] = {}
+        self._ever_live: set[str] = set()
+        self._last_pool: str | None = None
+        self._pool_gen = 0
         self._obs_requests = obs.counter("router/requests", unit="reqs")
         self._obs_dispatched = obs.counter("router/dispatched", unit="reqs")
         self._obs_completions = obs.counter("router/completions",
@@ -473,8 +550,16 @@ class Router:
                                        unit="replicas")
         self._obs_joins = obs.counter("router/joins", unit="replicas")
         self._obs_slo_shed = obs.counter("router/slo_shed", unit="reqs")
+        self._obs_drains = obs.counter("router/drains", unit="replicas")
+        self._obs_rolls = obs.counter("router/structural_rolls",
+                                      unit="rolls")
+        self._obs_rollbacks = obs.counter("router/rollbacks", unit="rolls")
+        self._obs_degrade_clamped = obs.counter("router/degrade_clamped",
+                                                unit="reqs")
         self._obs_live = obs.gauge("router/replicas_live", unit="replicas")
         self._obs_outstanding = obs.gauge("router/outstanding", unit="reqs")
+        self._obs_pool = obs.gauge("router/pool", unit="generation")
+        self._obs_degraded = obs.gauge("router/degraded", unit="bool")
 
     # -- fleet view --------------------------------------------------------
 
@@ -493,6 +578,27 @@ class Router:
         mark = f"{self.ns}:"
         return {name[len(mark):] for name in self.client.live()
                 if name.startswith(mark)}
+
+    def draining(self) -> set[str]:
+        """Replica ids marked for graceful drain (``{ns}/draining/{rid}``
+        — set by the autoscaler's scale-down or a blue-green commit):
+        the router stops dispatching to them, their in-flight work
+        finishes, and their eventual departure counts as a DRAIN, not a
+        death."""
+        prefix = f"{self.ns}/draining/"
+        try:
+            return {k[len(prefix):] for k in self.client.keys(prefix)}
+        except ConnectionError:
+            return set()
+
+    def _active_pool(self) -> str | None:
+        """The pool tag traffic is pinned to (``{ns}/pool`` key), or
+        ``None`` before any structural rollout — every pool eligible."""
+        try:
+            raw = self.client.get(f"{self.ns}/pool")
+        except ConnectionError:
+            return self._last_pool
+        return raw.decode() if raw is not None else None
 
     def loads(self, regs: dict[str, dict]) -> dict[str, dict]:
         """Published load per replica id: queue depth + free KV blocks
@@ -522,6 +628,8 @@ class Router:
                 "rejected": (counters.get("serve/rejected")
                              or {}).get("value") or 0.0,
                 "swapping": bool((gauges.get("serve/swapping")
+                                  or {}).get("value") or 0.0),
+                "degraded": bool((gauges.get("serve/degraded")
                                   or {}).get("value") or 0.0),
                 "weights_version": (gauges.get("serve/weights_version")
                                     or {}).get("value"),
@@ -575,7 +683,8 @@ class Router:
             except ConnectionError:
                 pass
         for key in (f"{self.ns}/replica/{rid}",
-                    f"{self.ns}/metrics/{regs.get(rid, {}).get('rank')}"):
+                    f"{self.ns}/metrics/{regs.get(rid, {}).get('rank')}",
+                    f"{self.ns}/draining/{rid}"):
             try:
                 self.client.delete(key)
             except ConnectionError:
@@ -639,6 +748,18 @@ class Router:
         regs = self.replicas()
         live = self.live() - self._dead
         self._obs_live.set(len(live))
+        now_mono = time.monotonic()
+        self._ever_live |= live
+        for rid in regs:
+            self._reg_seen.setdefault(rid, now_mono)
+        draining = self.draining()
+        pool = self._active_pool()
+        if pool != self._last_pool:
+            self._pool_gen += 1
+            log.info("router: active pool is now %r (generation %d)",
+                     pool, self._pool_gen)
+            self._last_pool = pool
+        self._obs_pool.set(self._pool_gen)
 
         # live-join discovery: membership is re-read every poll, so a
         # replica that registered after this router started (or even
@@ -699,16 +820,42 @@ class Router:
                 pass
         assigned_to = {e["assigned"] for e in entries.values()
                        if e["assigned"] is not None}
-        for rid in sorted(assigned_to):
-            if rid in live and rid not in verdict_lost:
+        # scan every rid with assigned work OR a registration: a
+        # registered replica whose lease is gone is swept even when
+        # idle, so a joiner that died at warmup doesn't pin its
+        # registration (and rank/metrics slot) forever
+        for rid in sorted((assigned_to | set(regs)) - self._dead):
+            lost = rid in verdict_lost
+            if rid in live and not lost:
                 continue
-            # dead: lease lapsed (SIGKILL, heartbeat drop) or publisher
-            # lost.  Drain its inbox, redispatch its outstanding.
+            if not lost and rid not in self._ever_live:
+                # registration→first-heartbeat grace: a slow-warming
+                # joiner (jax import + compile) registers long before
+                # its first lease refresh lands.  Declaring it dead now
+                # would permanently ban a healthy replica and
+                # pointlessly drain its (empty) inbox — wait the grace
+                # out first.  Members that HAVE held a lease get no
+                # grace: their lapse is the real death signal.
+                if now_mono - self._reg_seen.get(rid, now_mono) \
+                        < self.join_grace_s:
+                    continue
+            # dead or drained: lease lapsed (SIGKILL, heartbeat drop,
+            # clean drain exit) or publisher lost.  Drain its inbox,
+            # redispatch its outstanding.
             self._dead.add(rid)
             live.discard(rid)
-            self._obs_deaths.inc()
-            log.warning("router: replica %s presumed dead; "
-                        "redispatching its outstanding requests", rid)
+            if rid in draining:
+                # graceful scale-down/pool-drain departure: expected,
+                # not a failure — but the sweep + redispatch below
+                # still runs, so even a drain that raced a final
+                # dispatch loses nothing
+                self._obs_drains.inc()
+                log.info("router: replica %s drained and left the "
+                         "fleet", rid)
+            else:
+                self._obs_deaths.inc()
+                log.warning("router: replica %s presumed dead; "
+                            "redispatching its outstanding requests", rid)
             self._sweep_dead(rid, regs)
             for k, e in entries.items():
                 if k in done or e["assigned"] != rid:
@@ -741,10 +888,22 @@ class Router:
         candidates = [rid for rid in sorted(live)
                       if rid not in self._backoff
                       and rid not in unhealthy
+                      # graceful drain: admissions steer away; in-flight
+                      # work finishes before the replica stops
+                      and rid not in draining
+                      # blue-green: traffic is pinned to the active pool
+                      and (pool is None or regs.get(rid, {})
+                           .get("pool", "default") == pool)
                       # steer around a replica mid-hot-swap: it has
                       # paused admission to drain; feeding it would just
                       # park requests behind the rebind
                       and not loads.get(rid, {}).get("swapping")]
+        # fleet-wide overload state: any candidate replica in degraded
+        # mode puts the ROUTER in degraded mode too — new best-effort
+        # dispatches get their budgets clamped at the wire
+        degraded = any(loads.get(rid, {}).get("degraded")
+                       for rid in candidates)
+        self._obs_degraded.set(1.0 if degraded else 0.0)
         if candidates:
             assigned_counts: dict[str, int] = {}
             for e in entries.values():
@@ -784,13 +943,163 @@ class Router:
                 rid = self._pick(candidates, loads, assigned_counts)
                 if rid is None:
                     break
+                send = req
+                if (degraded and self.degrade_max_new is not None
+                        and getattr(req, "priority", 0) <= 0
+                        and req.max_new_tokens > self.degrade_max_new):
+                    # degrade tier 1: clamp best-effort budgets at the
+                    # wire while the fleet is overloaded — a short
+                    # answer now beats a rejection later.  Higher
+                    # priority classes keep full budgets.
+                    send = dataclasses.replace(
+                        req, max_new_tokens=self.degrade_max_new)
+                    self._obs_degrade_clamped.inc()
                 self.client.set(f"{self.ns}/inbox/{rid}/{k}",
-                                _encode_request(k, req))
+                                _encode_request(k, send))
                 e["assigned"] = rid
                 assigned_counts[rid] = assigned_counts.get(rid, 0) + 1
                 progressed = True
                 self._obs_dispatched.inc()
         return progressed
+
+
+    # -- blue-green structural rollout -------------------------------------
+
+    def roll_structural(self, spawn, n: int, *, canary, expect_tokens,
+                        green_pool: str = "green",
+                        warmup_timeout_s: float = 180.0,
+                        canary_timeout_s: float = 60.0,
+                        drain_timeout_s: float = 60.0) -> dict:
+        """Blue-green rollout for STRUCTURAL changes (tokenizer/config
+        version bumps the in-place weight hot-swap cannot express).
+
+        State machine::
+
+            spawn green --> warm (n registered+heartbeating) --> canary
+              (exact-check) --> COMMIT (shift {ns}/pool, drain blue)
+            any warmup/canary failure --> ROLLBACK (stop green, blue
+              keeps serving untouched)
+
+        Args:
+          spawn: zero-arg callable launching the green replicas (e.g. a
+            :func:`scale_fleet` closure with the new model args and
+            ``--pool green``) and returning their ``Popen``\\ s.
+          n: green replicas to wait for before the canary runs.
+          canary: the probe :class:`~tpudist.models.serving.Request`.
+          expect_tokens: the EXACT token sequence the green pool must
+            produce for the canary (computed against a reference loop
+            under the new config) — a warmed, heartbeating pool serving
+            WRONG output is precisely the failure health checks miss.
+
+        Returns a dict: ``ok``, ``stage`` (``done`` | the stage that
+        failed), ``green``/``blue`` rid lists, ``procs`` (the green
+        workers — the CALLER reaps them; on rollback they are already
+        stopped), and ``blue_drained`` on commit.
+
+        Composes with death detection: a BLUE replica dying mid-rollout
+        is redispatched by the normal poll machinery (this method never
+        touches blue until commit), and a GREEN death during
+        warmup/canary triggers rollback, with blue traffic never
+        shifted."""
+        regs0 = self.replicas()
+        blue = sorted(rid for rid, info in regs0.items()
+                      if info.get("pool", "default") != green_pool)
+        blue_pool = (regs0[blue[0]].get("pool", "default") if blue
+                     else "default")
+        procs = list(spawn())
+
+        def rollback(stage: str, why: str) -> dict:
+            self._obs_rollbacks.inc()
+            log.warning("roll_structural: %s failed (%s); rolling back "
+                        "to pool %r", stage, why, blue_pool)
+            regs = self.replicas()
+            green_now = sorted(rid for rid, info in regs.items()
+                               if info.get("pool", "default") == green_pool)
+            for rid in green_now:
+                try:
+                    self.client.set(f"{self.ns}/stop/{rid}", b"1")
+                except ConnectionError:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=15.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            for rid in green_now:
+                self._sweep_dead(rid, regs)
+                try:
+                    self.client.delete(f"{self.ns}/stop/{rid}")
+                except ConnectionError:
+                    pass
+            return {"ok": False, "stage": stage, "reason": why,
+                    "green": green_now, "blue": blue, "procs": procs}
+
+        # -- warm: n green replicas registered AND heartbeating
+        deadline = time.monotonic() + warmup_timeout_s
+        green: list[str] = []
+        while True:
+            live = self.live()
+            green = sorted(rid for rid, info in self.replicas().items()
+                           if info.get("pool", "default") == green_pool
+                           and rid in live)
+            if len(green) >= n:
+                break
+            exited = [(p.pid, p.returncode) for p in procs
+                      if p.poll() is not None]
+            if exited:
+                return rollback("warmup",
+                                f"green worker(s) died: {exited}")
+            if time.monotonic() > deadline:
+                return rollback("warmup", f"only {len(green)} of {n} "
+                                f"green replicas live after "
+                                f"{warmup_timeout_s:.0f}s")
+            time.sleep(0.1)
+
+        # -- canary: one probe request, exact-checked.  The key's
+        # "canary" prefix keeps it out of the request sequence space
+        # (and is what the CANARY_CORRUPT injection targets).
+        key = f"canary-{self._seq:08d}"
+        self._seq += 1
+        target = green[0]
+        self.client.set(f"{self.ns}/inbox/{target}/{key}",
+                        _encode_request(key, canary))
+        deadline = time.monotonic() + canary_timeout_s
+        tokens = None
+        while time.monotonic() < deadline:
+            try:
+                raw = self.client.get(f"{self.ns}/done/{key}")
+            except ConnectionError:
+                raw = None
+            if raw is not None:
+                self.client.delete(f"{self.ns}/done/{key}")
+                tokens = np.asarray(json.loads(raw.decode())["tokens"],
+                                    np.int32)
+                break
+            if any(p.poll() is not None for p in procs):
+                return rollback("canary", "green worker died mid-canary")
+            time.sleep(0.05)
+        if tokens is None:
+            return rollback("canary", "canary timed out")
+        expect = np.asarray(expect_tokens, np.int32)
+        if not np.array_equal(tokens, expect):
+            return rollback(
+                "canary", f"output mismatch (got {tokens.tolist()}, "
+                f"expected {expect.tolist()})")
+
+        # -- commit: shift traffic, then drain blue gracefully (steer
+        # admissions away, let in-flight finish, stop, wait out the
+        # lease) — zero requests lost on either side of the cut
+        self.client.set(f"{self.ns}/pool", green_pool.encode())
+        self._obs_rolls.inc()
+        log.info("roll_structural: canary exact-matched; pool shifted "
+                 "to %r, draining blue %s", green_pool, blue)
+        blue_drained = drain_replicas(self.client, blue,
+                                      namespace=self.ns,
+                                      timeout_s=drain_timeout_s)
+        return {"ok": True, "stage": "done", "green": green,
+                "blue": blue, "procs": procs,
+                "blue_drained": blue_drained}
 
 
 # -- fleet process helpers (tests, bench, example, CI) ---------------------
@@ -812,6 +1121,89 @@ def build_tiny_lm(vocab: int = 64, layers: int = 2, heads: int = 4,
     params = TransformerLM(cfg).init(
         jax.random.key(seed), jnp.zeros((1, 2), jnp.int32))["params"]
     return cfg, params
+
+
+def request_drain(client: CoordClient, rids: Sequence[str], *,
+                  namespace: str = DEFAULT_NAMESPACE) -> None:
+    """Mark replicas for graceful drain (``{ns}/draining/{rid}``): the
+    router steers new admissions away immediately; the replicas keep
+    working their queues.  Follow with :func:`drain_replicas` (or the
+    autoscaler's poll loop) to stop them once empty."""
+    for rid in rids:
+        client.set(f"{namespace}/draining/{rid}", b"1")
+
+
+def drain_replicas(client: CoordClient, rids: Sequence[str], *,
+                   namespace: str = DEFAULT_NAMESPACE,
+                   timeout_s: float = 60.0,
+                   poll_s: float = 0.1) -> bool:
+    """Gracefully drain replicas to a stop, never losing a request:
+    mark them draining (router admissions steer away), wait for each
+    inbox to empty (everything dispatched has been picked up), set the
+    targeted stop key (the worker's close path finishes its queued and
+    in-flight work, commits every completion, then exits cleanly), and
+    wait the heartbeat lease out.  Returns True when every replica is
+    gone within ``timeout_s`` (residual coordination keys are cleaned
+    up), False on timeout (drain keys left in place — the replicas are
+    still steered away from, just not yet stopped)."""
+    request_drain(client, rids, namespace=namespace)
+    regs: dict[str, dict] = {}
+    prefix = f"{namespace}/replica/"
+    for rid in rids:
+        raw = client.get(f"{prefix}{rid}")
+        if raw is not None:
+            regs[rid] = json.loads(raw.decode())
+    mark = f"{namespace}:"
+    deadline = time.monotonic() + timeout_s
+    stopped: set[str] = set()
+    while True:
+        live = {n[len(mark):] for n in client.live()
+                if n.startswith(mark)}
+        remaining = [rid for rid in rids
+                     if rid in live or rid not in stopped]
+        for rid in remaining:
+            if rid not in live:
+                stopped.add(rid)   # already gone (death beat the drain)
+            elif (rid not in stopped
+                    and not client.keys(f"{namespace}/inbox/{rid}/")):
+                client.set(f"{namespace}/stop/{rid}", b"1")
+                stopped.add(rid)
+        if not [rid for rid in rids
+                if rid in live or rid not in stopped]:
+            for rid in rids:   # residue: this drain owns the cleanup
+                for key in (f"{namespace}/draining/{rid}",
+                            f"{namespace}/stop/{rid}",
+                            f"{namespace}/replica/{rid}",
+                            f"{namespace}/metrics/"
+                            f"{regs.get(rid, {}).get('rank')}"):
+                    try:
+                        client.delete(key)
+                    except ConnectionError:
+                        pass
+            return True
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def alloc_replica_indices(client: CoordClient, n: int, *,
+                          namespace: str = DEFAULT_NAMESPACE) -> list[int]:
+    """Reserve ``n`` fresh replica indices from the fleet's add-chain
+    (``{ns}/replica_index``).  The coord ``add`` is atomic, so an
+    operator-initiated and an autoscaler-initiated scale-up racing each
+    other get DISJOINT index ranges — two replicas can never collide on
+    a registration key, rank, or metrics slot."""
+    end = int(client.add(f"{namespace}/replica_index", int(n)))
+    return list(range(end - int(n), end))
+
+
+def _seed_replica_index(client: CoordClient, upto: int, *,
+                        namespace: str = DEFAULT_NAMESPACE) -> None:
+    """Advance the index chain to at least ``upto`` (covers indices an
+    explicit ``start_index`` caller placed outside the chain)."""
+    cur = int(client.add(f"{namespace}/replica_index", 0))
+    if cur < upto:
+        client.add(f"{namespace}/replica_index", upto - cur)
 
 
 def _spawn_replica(coord_addr: str, index: int, *,
@@ -846,7 +1238,15 @@ def launch_local_fleet(coord_addr: str, n: int, *,
     """Spawn ``n`` replica worker subprocesses on this host (tests,
     bench, CI, the example).  ``env_overrides[i]`` adds env vars to
     replica ``i`` — the fault-injection knobs go in this way, so a kill
-    schedule hits exactly the replica the scenario names."""
+    schedule hits exactly the replica the scenario names.  Also seeds
+    the fleet's replica-index add-chain past ``n`` so later
+    :func:`scale_fleet` calls allocate collision-free indices."""
+    try:
+        host, port = coord_addr.rsplit(":", 1)
+        _seed_replica_index(CoordClient(host, int(port)), n,
+                            namespace=namespace)
+    except (ConnectionError, OSError):
+        pass   # chain seeds lazily on the first scale-up instead
     return [_spawn_replica(coord_addr, i, namespace=namespace,
                            replica_args=replica_args,
                            env_extra=(env_overrides or {}).get(i),
@@ -854,27 +1254,52 @@ def launch_local_fleet(coord_addr: str, n: int, *,
             for i in range(n)]
 
 
-def scale_fleet(coord_addr: str, n: int, *, start_index: int,
+def scale_fleet(coord_addr: str, n: int, *,
+                start_index: int | None = None,
                 namespace: str = DEFAULT_NAMESPACE,
                 replica_args: Sequence[str] = (),
                 env_overrides: dict[int, dict] | None = None,
+                env_extra: dict | None = None,
                 platform: str = "cpu") -> list[subprocess.Popen]:
-    """Scale a RUNNING fleet up by ``n`` joiner replicas (ids
-    ``r{start_index}..``, ranks to match — ranks key the metrics
-    namespace, so they must not collide with existing members, dead
-    ones included).  Joiners register against the live coordination
-    planes and the router admits them on its next poll; pass the same
-    ``--snapshot-dir`` the fleet was launched with so a joiner restores
-    the CURRENT weights (keeping greedy output exact-match with the
-    incumbents).  ``env_overrides`` is keyed by absolute index, as in
-    :func:`launch_local_fleet`."""
-    return [_spawn_replica(coord_addr, start_index + j,
-                           namespace=namespace,
+    """Scale a RUNNING fleet up by ``n`` joiner replicas.  Joiners
+    register against the live coordination planes and the router admits
+    them on its next poll; pass the same ``--snapshot-dir`` the fleet
+    was launched with so a joiner restores the CURRENT weights (keeping
+    greedy output exact-match with the incumbents).
+
+    Indices (ids ``r{i}``, ranks to match — ranks key the metrics
+    namespace, so they must never collide with existing members, dead
+    ones included) are allocated from the fleet's atomic add-chain by
+    default (:func:`alloc_replica_indices`), so two scale-ups racing
+    each other — an operator and the autoscaler, say — get disjoint
+    ranges.  An explicit ``start_index`` keeps the legacy caller-picked
+    layout and advances the chain past it.
+
+    ``env_overrides`` is keyed by absolute index (explicit
+    ``start_index`` callers); ``env_extra`` applies to every joiner
+    (chain-allocated callers don't know indices up front).  Each
+    returned ``Popen`` carries its ``replica_index`` attribute."""
+    host, port = coord_addr.rsplit(":", 1)
+    if start_index is None:
+        indices = alloc_replica_indices(CoordClient(host, int(port)), n,
+                                        namespace=namespace)
+    else:
+        indices = list(range(start_index, start_index + n))
+        try:
+            _seed_replica_index(CoordClient(host, int(port)),
+                                start_index + n, namespace=namespace)
+        except (ConnectionError, OSError):
+            pass
+    procs = []
+    for i in indices:
+        extra = dict(env_extra or {})
+        extra.update((env_overrides or {}).get(i) or {})
+        p = _spawn_replica(coord_addr, i, namespace=namespace,
                            replica_args=replica_args,
-                           env_extra=(env_overrides or {}).get(
-                               start_index + j),
-                           platform=platform)
-            for j in range(n)]
+                           env_extra=extra, platform=platform)
+        p.replica_index = i
+        procs.append(p)
+    return procs
 
 
 def stop_fleet(client: CoordClient, procs: Sequence[subprocess.Popen], *,
@@ -1059,6 +1484,15 @@ def main() -> None:  # pragma: no cover - subprocess entry point
                     help="0 = dense-capacity default")
     ap.add_argument("--max-queue", type=int, default=-1,
                     help="-1 = unbounded")
+    ap.add_argument("--degrade-queue", type=int, default=-1,
+                    help="soft overload watermark (-1 = max-queue/2 "
+                         "when max-queue is set)")
+    ap.add_argument("--degrade-max-new", type=int, default=32,
+                    help="degraded-mode max_new_tokens clamp for "
+                         "priority-0 traffic")
+    ap.add_argument("--pool", default="default",
+                    help="blue-green pool tag; the router only "
+                         "dispatches to the active pool")
     ap.add_argument("--snapshot-dir", default="",
                     help="fleet weight snapshot dir (Checkpointer, "
                          "layout=steps): restored at startup (joiners "
@@ -1084,14 +1518,18 @@ def main() -> None:  # pragma: no cover - subprocess entry point
         cache_layout=args.cache_layout,
         kv_block_size=args.kv_block_size,
         kv_num_blocks=args.kv_num_blocks or None,
-        max_queue=None if args.max_queue < 0 else args.max_queue)
+        max_queue=None if args.max_queue < 0 else args.max_queue,
+        degrade_queue=None if args.degrade_queue < 0
+        else args.degrade_queue,
+        degrade_max_new=args.degrade_max_new)
     host, port = args.coord.rsplit(":", 1)
     client = CoordClient(host, int(port))
     worker = ReplicaWorker(loop, client, args.replica_id,
                            rank=args.rank, namespace=args.namespace,
                            ttl_s=args.ttl,
                            snapshot_dir=args.snapshot_dir or None,
-                           swap_turn_timeout_s=args.swap_turn_timeout)
+                           swap_turn_timeout_s=args.swap_turn_timeout,
+                           pool=args.pool)
     log.info("replica %s (rank %d) serving on %s", args.replica_id,
              args.rank, args.namespace)
     worker.serve()
