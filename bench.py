@@ -1905,6 +1905,10 @@ def bench_serve_fleet(on_tpu: bool) -> None:
         env = ({1: {"TPUDIST_FAULT_KILL_AFTER_SEGMENTS": "4"}}
                if kill else None)
         client = CoordClient(port=server.port)
+        # fresh trace/SLO state per row: rows reuse request rids, and a
+        # stale ring would fold a previous row's timelines into this one
+        obs.events.clear()
+        obs.slo.clear()
         procs = launch_local_fleet(
             f"127.0.0.1:{server.port}", n_replicas, namespace=ns,
             replica_args=["--cache-layout", "paged",
@@ -1939,6 +1943,23 @@ def bench_serve_fleet(on_tpu: bool) -> None:
         merged = merge_snapshots(collect(client, f"{ns}/metrics"))
         wait_h = merged["histograms"].get("serve/queue_wait_s")
         have_wait = bool(wait_h) and wait_h["count"] > 0
+        # fleet-wide request timelines: the router's local ring (enqueue
+        # / dispatch / redispatch / terminal decisions) merged with every
+        # replica's published ring (admit / segment / done_commit — a
+        # SIGKILLed replica's last publish persists in the KV store).
+        # trace_complete counts requests whose merged timeline passes
+        # obs.is_complete: enqueue-rooted, terminal, and with a
+        # dispatch for every redispatch.
+        trace_doc = obs.merge_events(
+            collected=obs.collect_events(client, f"{ns}/events"),
+            router=obs.events.snapshot())
+        timelines = obs.group_timelines(trace_doc["events"])
+        trace_complete = sum(
+            1 for tl in timelines.values() if obs.is_complete(tl))
+        burn = obs.slo.burn_rates()
+        if kill:
+            obs.atomic_write_json("/tmp/serve_fleet_trace_events.json",
+                                  trace_doc, indent=1)
         _emit("serve_fleet_tokens_per_s",
               round(sum(len(t) for t in got.values()) / wall, 1),
               "tokens/sec", None, replicas=n_replicas, killed=kill,
@@ -1954,6 +1975,13 @@ def bench_serve_fleet(on_tpu: bool) -> None:
                                 if have_wait else None),
               queue_wait_p99_s=(round(hist_quantile(wait_h, 0.99), 4)
                                 if have_wait else None),
+              trace_complete=trace_complete,
+              trace_total=len(timelines),
+              burn_rate_live=round(burn[min(burn)], 4) if burn else None,
+              router_decisions={
+                  r: int(delta(f"router/decisions/{r}"))
+                  for r in ("completed", "shed", "rejected", "failed",
+                            "timeout")},
               wall_s=round(wall, 2))
     server.stop()
 

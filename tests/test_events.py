@@ -1,0 +1,343 @@
+"""Per-request distributed tracing (ISSUE 10): trace contexts, the
+request-event ring + publisher/merge, timeline reconstruction and the
+``python -m tpudist.obs.timeline`` tool, SLO burn-rate accounting, and
+the atomic-write / Prometheus-HELP satellites."""
+
+import json
+import os
+
+import pytest
+
+from tpudist.obs.events import (
+    EVENTS_SCHEMA, EventPublisher, RequestEventLog, SLOTracker,
+    TraceContext, collect_events, group_timelines, is_complete,
+    merge_events, timeline_for_rid)
+
+
+class FakeKV:
+    """Just the set/keys/get verbs the event publisher/collector use."""
+
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+
+    def set(self, key, value):
+        self.kv[key] = value
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def keys(self, prefix=""):
+        return [k for k in self.kv if k.startswith(prefix)]
+
+
+class TestTraceContext:
+    def test_mint_and_wire_roundtrip(self):
+        tc = TraceContext.mint("00000042", parent="outer")
+        assert tc.trace_id.startswith("00000042-")
+        assert tc.enqueued_at is not None
+        back = TraceContext.from_wire(tc.to_wire())
+        assert back == tc
+
+    def test_mint_is_unique_across_restarts(self):
+        # two routers both start their key sequence at 00000000; the
+        # random suffix keeps their traces distinct
+        a, b = TraceContext.mint("00000000"), TraceContext.mint("00000000")
+        assert a.trace_id != b.trace_id
+
+    def test_from_wire_none_safe(self):
+        assert TraceContext.from_wire(None) is None
+        assert TraceContext.from_wire({}) is None
+        assert TraceContext.from_wire({"id": None}) is None
+
+
+class TestRequestEventLog:
+    def test_record_and_order(self):
+        log = RequestEventLog()
+        log.record("enqueue", trace="t1", key="00000000")
+        log.record("dispatch", trace="t1", replica="r0")
+        evs = log.events()
+        assert [e["kind"] for e in evs] == ["enqueue", "dispatch"]
+        assert [e["i"] for e in evs] == [0, 1]
+        assert all(e["trace"] == "t1" for e in evs)
+        assert evs[0]["key"] == "00000000"
+        assert evs[0]["t"] <= evs[1]["t"]
+
+    def test_ring_overflow_keeps_tail(self):
+        log = RequestEventLog(capacity=3)
+        for i in range(5):
+            log.record("e", n=i)
+        assert [e["n"] for e in log.events()] == [2, 3, 4]
+        assert log.dropped == 2
+        assert [e["n"] for e in log.tail(2)] == [3, 4]
+
+    def test_clear_resets_seq_and_dropped(self):
+        log = RequestEventLog(capacity=1)
+        log.record("a")
+        log.record("b")
+        assert log.dropped == 1
+        log.clear()
+        assert log.events() == [] and log.dropped == 0
+        log.record("c")
+        assert log.events()[0]["i"] == 0
+
+    def test_snapshot_shape(self):
+        log = RequestEventLog()
+        log.record("x")
+        snap = log.snapshot()
+        assert snap["schema"] == EVENTS_SCHEMA
+        assert snap["dropped"] == 0 and len(snap["events"]) == 1
+
+
+class TestPublishMerge:
+    def test_publish_collect_merge_dedups_repeat_publishes(self):
+        kv = FakeKV()
+        log = RequestEventLog()
+        log.record("enqueue", trace="t1")
+        pub = EventPublisher(kv, 0, log, namespace="ns/events")
+        pub.publish()
+        log.record("done", trace="t1")
+        pub.publish()   # second publish re-sends the enqueue event
+        collected = collect_events(kv, "ns/events")
+        assert set(collected) == {0}
+        assert collected[0]["age_s"] is not None
+        merged = merge_events(collected=collected)
+        assert [e["kind"] for e in merged["events"]] == ["enqueue", "done"]
+        assert all(e["src"] == "r0" for e in merged["events"])
+
+    def test_merge_local_and_collected_sources(self):
+        kv = FakeKV()
+        replica = RequestEventLog()
+        replica.record("admit", trace="t1", slot=0)
+        EventPublisher(kv, 1, replica, namespace="ns/events").publish()
+        local = RequestEventLog()
+        local.record("enqueue", trace="t1")
+        merged = merge_events(collected=collect_events(kv, "ns/events"),
+                              router=local.snapshot())
+        assert sorted(merged["sources"]) == ["r1", "router"]
+        assert {e["src"] for e in merged["events"]} == {"r1", "router"}
+
+    def test_publish_respects_fault_drop(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_FAULT_PUBLISH_DROP", "0")
+        from tpudist.runtime import faults
+
+        faults.reset()
+        try:
+            kv = FakeKV()
+            log = RequestEventLog()
+            log.record("x")
+            EventPublisher(kv, 0, log, namespace="ns/events").publish()
+            assert kv.kv == {}   # starved obs plane: no store write
+        finally:
+            monkeypatch.delenv("TPUDIST_FAULT_PUBLISH_DROP")
+            faults.reset()
+
+
+class TestTimelines:
+    def _events(self, kinds, trace="t1", t0=1000.0):
+        return [{"t": t0 + i, "i": i, "kind": k, "trace": trace}
+                for i, k in enumerate(kinds)]
+
+    def test_group_and_complete_served(self):
+        evs = self._events(["enqueue", "dispatch", "admit", "segment",
+                            "done_commit", "done"])
+        tl = group_timelines(evs)["t1"]
+        assert is_complete(tl)
+
+    def test_complete_requires_dispatch_per_redispatch(self):
+        ok = self._events(["enqueue", "dispatch", "redispatch",
+                           "dispatch", "done"])
+        assert is_complete(group_timelines(ok)["t1"])
+        gap = self._events(["enqueue", "dispatch", "redispatch", "done"])
+        assert not is_complete(group_timelines(gap)["t1"])
+
+    def test_shed_timeout_failed_are_terminal(self):
+        for term in ("shed", "timeout", "failed"):
+            assert is_complete(self._events(["enqueue", term]))
+
+    def test_incomplete_shapes(self):
+        assert not is_complete(None)
+        assert not is_complete([])
+        # no terminal event / not enqueue-rooted
+        assert not is_complete(self._events(["enqueue", "dispatch"]))
+        assert not is_complete(self._events(["dispatch", "done"]))
+
+    def test_timeline_for_rid_newest_enqueue_wins(self):
+        old = [{"t": 1.0, "i": 0, "kind": "enqueue", "trace": "a",
+                "rid": "q0"}]
+        new = [{"t": 2.0, "i": 0, "kind": "enqueue", "trace": "b",
+                "rid": "q0"}]
+        tls = {"a": old, "b": new, None: []}
+        assert timeline_for_rid(tls, "q0") is new
+        assert timeline_for_rid(tls, "missing") is None
+
+
+class TestSLOTracker:
+    def test_burn_rate_definition(self):
+        clock = lambda: 100.0  # noqa: E731
+        slo = SLOTracker(target=0.99, windows=(60.0,), clock=clock)
+        for _ in range(99):
+            slo.observe("stop")
+        slo.observe("timeout")
+        good, bad = slo.counts(60.0)
+        assert (good, bad) == (99, 1)
+        # 1% bad on a 1% budget burns exactly at pace
+        assert slo.burn_rates()[60.0] == pytest.approx(1.0)
+
+    def test_windows_age_out(self):
+        now = {"t": 0.0}
+        slo = SLOTracker(target=0.9, windows=(10.0, 100.0),
+                         clock=lambda: now["t"])
+        slo.observe("failed")
+        now["t"] = 50.0
+        slo.observe("stop")
+        # short window forgot the failure; long one still burns
+        assert slo.burn_rates()[10.0] == 0.0
+        assert slo.burn_rates()[100.0] == pytest.approx(5.0)
+
+    def test_no_traffic_is_not_a_breach(self):
+        slo = SLOTracker()
+        assert all(v == 0.0 for v in slo.burn_rates().values())
+
+    def test_gauges_ride_the_registry(self):
+        from tpudist.obs.export import to_prometheus
+        from tpudist.obs.registry import MetricRegistry
+
+        reg = MetricRegistry()
+        slo = SLOTracker(registry=reg, target=0.99, windows=(60.0,))
+        slo.observe("shed")
+        snap = reg.snapshot()
+        assert snap["counters"]["slo/bad"]["value"] == 1
+        assert snap["gauges"]["slo/burn_rate_60s"]["value"] \
+            == pytest.approx(100.0)
+        text = to_prometheus(snap)
+        assert "# HELP slo_burn_rate_60s" in text
+        assert "# TYPE slo_burn_rate_60s gauge" in text
+
+    def test_good_override_and_clear(self):
+        slo = SLOTracker(target=0.5, windows=(60.0,))
+        slo.observe("weird-reason", good=True)
+        assert slo.counts(60.0) == (1, 0)
+        slo.clear()
+        assert slo.counts(60.0) == (0, 0)
+
+
+class TestAtomicWrites:
+    def test_atomic_write_json_no_temp_residue(self, tmp_path):
+        from tpudist.obs.spans import atomic_write_json
+
+        path = tmp_path / "out.json"
+        atomic_write_json(str(path), {"a": 1})
+        assert json.load(open(path)) == {"a": 1}
+        atomic_write_json(str(path), {"a": 2})   # overwrite in place
+        assert json.load(open(path)) == {"a": 2}
+        assert os.listdir(tmp_path) == ["out.json"]
+
+    def test_atomic_write_cleans_up_on_failure(self, tmp_path):
+        from tpudist.obs.spans import atomic_write_json
+
+        path = tmp_path / "out.json"
+        with pytest.raises(TypeError):
+            atomic_write_json(str(path), {"bad": object()})
+        assert os.listdir(tmp_path) == []   # no partial or temp file
+
+    def test_span_tracer_write_is_atomic(self, tmp_path):
+        from tpudist.obs.spans import SpanTracer
+
+        tracer = SpanTracer()
+        with tracer.span("step"):
+            pass
+        path = tmp_path / "trace.json"
+        tracer.write(str(path))
+        doc = json.load(open(path))
+        assert doc["traceEvents"]
+        assert os.listdir(tmp_path) == ["trace.json"]
+
+    def test_recorder_bundle_carries_request_events(self, tmp_path):
+        from tpudist.obs.recorder import FlightRecorder
+
+        events = RequestEventLog()
+        events.record("enqueue", trace="t1")
+        events.record("done", trace="t1")
+        rec = FlightRecorder(directory=str(tmp_path),
+                             request_events=events)
+        rec.record("serve_admit", slot=0)
+        bundle = rec.bundle()
+        assert [e["kind"] for e in bundle["request_events"]] \
+            == ["enqueue", "done"]
+        assert bundle["request_events_dropped"] == 0
+        path = rec.dump()
+        doc = json.load(open(path))
+        assert doc["request_events"][0]["trace"] == "t1"
+
+
+class TestTimelineTool:
+    def _doc(self, kinds, trace="t1", rid="q0"):
+        evs = []
+        for i, k in enumerate(kinds):
+            ev = {"t": 1000.0 + i, "i": i, "kind": k, "trace": trace,
+                  "src": "router"}
+            if k == "enqueue":
+                ev["rid"] = rid
+            evs.append(ev)
+        return {"schema": EVENTS_SCHEMA, "sources": ["router"],
+                "dropped": 0, "events": evs}
+
+    def test_load_events_shapes(self, tmp_path):
+        from tpudist.obs.timeline import load_events
+
+        doc = self._doc(["enqueue", "done"])
+        p1 = tmp_path / "merged.json"
+        p1.write_text(json.dumps(doc))
+        assert len(load_events(str(p1))) == 2
+        p2 = tmp_path / "raw.json"
+        p2.write_text(json.dumps(doc["events"]))
+        assert len(load_events(str(p2))) == 2
+        p3 = tmp_path / "postmortem.json"
+        p3.write_text(json.dumps({"schema": "tpudist.postmortem/1",
+                                  "request_events": doc["events"]}))
+        assert len(load_events(str(p3))) == 2
+        p4 = tmp_path / "junk.json"
+        p4.write_text(json.dumps({"nope": 1}))
+        with pytest.raises(ValueError):
+            load_events(str(p4))
+
+    def test_main_renders_and_exports_chrome(self, tmp_path, capsys):
+        from tpudist.obs.timeline import main
+
+        path = tmp_path / "events.json"
+        path.write_text(json.dumps(
+            self._doc(["enqueue", "dispatch", "done"])))
+        chrome = tmp_path / "chrome.json"
+        assert main([str(path), "--rid", "q0",
+                     "--chrome", str(chrome)]) == 0
+        out = capsys.readouterr().out
+        assert "trace t1 [complete]" in out
+        assert "enqueue" in out and "dispatch" in out
+        trace = json.load(open(chrome))
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "thread_name" in names and "done" in names
+
+    def test_require_complete_gates(self, tmp_path):
+        from tpudist.obs.timeline import main
+
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(
+            self._doc(["enqueue", "dispatch", "done"])))
+        assert main([str(good), "--require-complete"]) == 0
+        # a resolved trace with a recorded-owner gap fails the gate
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(
+            self._doc(["enqueue", "dispatch", "redispatch", "done"])))
+        assert main([str(bad), "--require-complete"]) == 1
+        # an UNresolved trace (still in flight) is not a gate failure
+        open_tl = tmp_path / "open.json"
+        open_tl.write_text(json.dumps(self._doc(["enqueue", "dispatch"])))
+        assert main([str(open_tl), "--require-complete"]) == 0
+
+    def test_missing_trace_or_rid_exits_2(self, tmp_path):
+        from tpudist.obs.timeline import main
+
+        path = tmp_path / "events.json"
+        path.write_text(json.dumps(self._doc(["enqueue", "done"])))
+        assert main([str(path), "--trace", "nope"]) == 2
+        assert main([str(path), "--rid", "nope"]) == 2

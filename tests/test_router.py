@@ -86,6 +86,7 @@ class TestWireFormat:
         assert got.max_new_tokens == 9
         assert got.rid == "00000007"  # router key, not caller rid
         assert got.deadline_s == 123.5
+        assert got.trace is None   # traceless stays traceless
 
         comp = Completion(rid="00000007", prompt=req.prompt,
                           tokens=np.array([5, 6], np.int32),
@@ -95,6 +96,19 @@ class TestWireFormat:
         d = json.loads(_encode_completion("r1", comp).decode())
         assert d == {"key": "00000007", "tokens": [5, 6],
                      "reason": "length", "replica": "r1"}
+
+    def test_request_roundtrip_preserves_trace(self):
+        from tpudist.models.serving import Request
+        from tpudist.obs.events import TraceContext
+
+        tc = TraceContext.mint("00000003", parent="span-9")
+        req = Request(np.array([2, 7], np.int32), 5, rid="caller",
+                      trace=tc)
+        got = _decode_request(_encode_request("00000003", req))
+        assert got.trace is not None
+        assert got.trace.trace_id == tc.trace_id
+        assert got.trace.parent == "span-9"
+        assert got.trace.enqueued_at == tc.enqueued_at
 
 
 class TestNoHang:
@@ -488,6 +502,71 @@ class TestControlPlaneUnit:
         assert alloc_replica_indices(fc2, 1, namespace=ns) == [4]
 
 
+class TestTracingUnit:
+    def test_redispatch_preserves_trace_id(self):
+        """A request redispatched off a dead replica carries the SAME
+        trace context to the survivor: both inbox payloads decode to
+        one trace id, and the local ring shows enqueue -> dispatch ->
+        redispatch -> dispatch -> done under that id."""
+        from tpudist import obs
+        from tpudist.obs.events import group_timelines, is_complete
+
+        fc = FakeCoord()
+        ns = "trace-redis"
+        _register(fc, ns, "a", 0)
+        sent = []   # (replica, decoded request) in inbox-write order
+
+        def on_set(key, value):
+            if not key.startswith(f"{ns}/inbox/"):
+                return
+            sent.append((key.split("/")[2], _decode_request(value)))
+            if len(sent) == 1:   # first dispatch landed on a: kill it
+                fc.live_set.discard(f"{ns}:a")
+                _register(fc, ns, "b", 1)
+            else:                # survivor b serves the redispatch
+                req = sent[-1][1]
+                fc.kv[f"{ns}/done/{req.rid}"] = json.dumps(
+                    {"key": req.rid, "tokens": [1, 2],
+                     "reason": "length", "replica": "b"}).encode()
+
+        fc.on_set = on_set
+        obs.events.clear()
+        router = Router(fc, namespace=ns, use_health=False, poll_s=0.001)
+        comps = router.run(_requests(1), timeout_s=10.0)
+        assert [c.reason for c in comps] == ["length"]
+        assert [rid for rid, _ in sent] == ["a", "b"]
+        traces = [r.trace for _, r in sent]
+        assert all(t is not None for t in traces)
+        assert traces[0].trace_id == traces[1].trace_id
+        tl = group_timelines(obs.events.events())[traces[0].trace_id]
+        kinds = [e["kind"] for e in tl]
+        assert kinds[0] == "enqueue" and kinds[-1] == "done"
+        assert kinds.count("dispatch") == 2 and "redispatch" in kinds
+        assert is_complete(tl)
+
+    def test_decision_counters_per_reason(self):
+        """router/decisions/{reason} splits terminal outcomes: the
+        redispatch-cap scenario resolves every request as `failed`, and
+        decisions() surfaces the per-reason counts."""
+        fc = FakeCoord()
+        ns = "decide"
+        _register(fc, ns, "a", 0)
+
+        def on_set(key, value):
+            if key.startswith(f"{ns}/inbox/"):
+                fc.live_set.clear()   # the whole fleet dies immediately
+
+        fc.on_set = on_set
+        router = Router(fc, namespace=ns, use_health=False,
+                        max_redispatch=0, poll_s=0.001)
+        f0 = _counter("router/decisions/failed")
+        comps = router.run(_requests(2), timeout_s=10.0)
+        assert [c.reason for c in comps] == ["failed"] * 2
+        assert _counter("router/decisions/failed") - f0 == 2
+        assert set(router.decisions()) == {
+            "completed", "shed", "rejected", "failed", "timeout"}
+
+
 class TestFleetE2E:
     def _route(self, client, procs, n_requests, *, namespace,
                lost_after_s=5.0):
@@ -513,18 +592,25 @@ class TestFleetE2E:
         return {c.rid: tuple(c.tokens.tolist())
                 for c in loop.run(_requests(n_requests))}
 
-    def test_kill_mid_decode_every_request_completes_exact(self):
+    def test_kill_mid_decode_every_request_completes_exact(
+            self, tmp_path):
         """THE acceptance E2E: 2 replicas, replica r1 SIGKILLs itself
         after 4 dispatched segments (uncatchable, mid-decode).  Every
         admitted request must still return a Completion, redispatched
         greedy output must be token-identical to an uninterrupted run,
         the survivor's pool must drain fully free, and the whole run
         must finish inside the TTL + redispatch bound (timeout_s=120
-        would raise TimeoutError — not hitting it IS the bound check)."""
+        would raise TimeoutError — not hitting it IS the bound check).
+        ISSUE 10 rides along: merging the router's local event ring
+        with the replicas' published rings must yield ONE complete
+        timeline per request — enqueue, dispatch, (redispatch,) done
+        under a single trace id, reconstructable by the timeline
+        tool across the SIGKILL."""
         from tpudist import obs
 
         server, client = _coord_pair()
         ns = "kill-fleet"
+        obs.events.clear()   # this process's ring: router-side events
         procs = launch_local_fleet(
             f"127.0.0.1:{server.port}", 2, namespace=ns,
             replica_args=["--cache-layout", "paged",
@@ -559,6 +645,41 @@ class TestFleetE2E:
         assert set(reports) == {"r0"}
         assert reports["r0"]["pool_drained"] is True
         assert reports["r0"]["clean"] is True
+
+        # -- ISSUE 10: one complete merged timeline per request --------
+        from tpudist.obs import timeline as timeline_tool
+        from tpudist.obs.events import (group_timelines, is_complete,
+                                        timeline_for_rid)
+
+        doc = obs.merge_events(
+            collected=obs.collect_events(client, f"{ns}/events"),
+            router=obs.events.snapshot())
+        timelines = group_timelines(doc["events"])
+        redispatched_traces = 0
+        for i in range(6):
+            tl = timeline_for_rid(timelines, f"q{i}")
+            assert tl is not None, f"q{i}: no timeline"
+            kinds = [e["kind"] for e in tl]
+            assert kinds[0] == "enqueue" and kinds[-1] == "done", kinds
+            assert is_complete(tl), (f"q{i}", kinds)
+            if "redispatch" in kinds:
+                redispatched_traces += 1
+                # the redispatch healed: one more dispatch than deaths
+                assert kinds.count("dispatch") == \
+                    kinds.count("redispatch") + 1, kinds
+        assert redispatched_traces >= 1
+        # the survivor's final publish carries replica-side events
+        # (admit/segment/done_commit) into the merged view
+        assert any(e["kind"] == "done_commit" for e in doc["events"])
+        # the timeline tool reconstructs the same story from disk
+        path = tmp_path / "events.json"
+        chrome = tmp_path / "chrome.json"
+        obs.atomic_write_json(str(path), doc)
+        rc = timeline_tool.main([str(path), "--rid", "q0",
+                                 "--chrome", str(chrome),
+                                 "--require-complete"])
+        assert rc == 0
+        assert json.load(open(chrome))["traceEvents"]
 
     def test_two_replicas_share_load_no_faults(self):
         """Happy path: both replicas serve, output exact-matches the

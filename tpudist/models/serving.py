@@ -87,13 +87,21 @@ class Request:
     loop degrades best-effort traffic FIRST — clamps its
     ``max_new_tokens`` past the soft watermark, sheds it first at the
     hard bound — so paid/interactive traffic keeps full service until
-    best-effort is exhausted."""
+    best-effort is exhausted.
+
+    ``trace`` is the distributed-tracing context
+    (:class:`tpudist.obs.events.TraceContext`, ``None`` for untraced
+    local runs): minted by the router at submit, it rides the fleet
+    wire format and keys every lifecycle event this loop records —
+    admit, segments, degrade clamps, timeouts, finalize — to the one
+    fleet-wide id that survives a SIGKILL + redispatch."""
 
     prompt: np.ndarray            # [L] int32 tokens, L >= 1
     max_new_tokens: int
     rid: Any = None               # caller's correlation id
     deadline_s: float | None = None
     priority: int = 0             # 0 = best-effort; higher = keep longer
+    trace: Any = None             # TraceContext | None (fleet tracing)
 
 
 @dataclasses.dataclass
@@ -1142,11 +1150,20 @@ class ServeLoop:
         inflight: deque[tuple] = deque()
         seq = 0   # segments dispatched so far == index of the next one
         closed = source is None
+        swap_pause_logged = False   # one swap_pause event per barrier
 
         def emit(comp: Completion) -> None:
             done.append(comp)
             if sink is not None:
                 sink(comp)
+
+        def tev(kind: str, req: Request, **fields) -> None:
+            """One request-lifecycle event into the tracing ring —
+            only for TRACED requests (fleet traffic); untraced local
+            runs stay out of the ring entirely."""
+            tc = getattr(req, "trace", None)
+            if tc is not None:
+                obs.events.record(kind, trace=tc.trace_id, **fields)
 
         def complete_unadmitted(req: Request, reason: str) -> None:
             """Finalize a request that never reached a slot (shed,
@@ -1155,6 +1172,7 @@ class ServeLoop:
                 self._obs_rejected.inc()
             elif reason == "timeout":
                 self._obs_timeouts.inc()
+            tev(reason, req, stage="queue")
             emit(Completion(
                 rid=req.rid, prompt=np.asarray(req.prompt),
                 tokens=np.zeros((0,), np.int32), reason=reason))
@@ -1195,6 +1213,8 @@ class ServeLoop:
         def finalize(slot: int, reason: str, *,
                      free_pool: bool = True) -> None:
             st = slot_state[slot]
+            tev("finalize", st["req"], slot=slot, reason=reason,
+                tokens=len(st["tokens"]))
             emit(Completion(
                 rid=st["req"].rid, prompt=np.asarray(st["req"].prompt),
                 tokens=np.asarray(st["tokens"], np.int32), reason=reason))
@@ -1231,6 +1251,8 @@ class ServeLoop:
                 self._obs_timeouts.inc()
                 obs.recorder.record("serve_timeout", slot=slot, seq=seq,
                                     tokens=len(st["tokens"]))
+                tev("timeout", st["req"], stage="decode", slot=slot,
+                    tokens=len(st["tokens"]))
                 if self.pool is not None and inflight:
                     finalize(slot, "timeout", free_pool=False)
                     slot_state[slot] = {"zombie": True, "free_at": seq}
@@ -1242,7 +1264,7 @@ class ServeLoop:
             queue; a new admission's tokens first surface in the NEXT
             dispatched segment (index ``seq``), so its drain is gated
             on that stamp."""
-            nonlocal pending
+            nonlocal pending, swap_pause_logged
             if pending:
                 now = None
                 kept: deque[tuple[Request, float]] = deque()
@@ -1259,6 +1281,11 @@ class ServeLoop:
                 # swap barrier: no new admissions until the rebind lands
                 # (queued-deadline expiry above still runs — a request
                 # cannot outlive its deadline waiting on a swap)
+                if not swap_pause_logged:
+                    swap_pause_logged = True
+                    for req, _ in pending:
+                        tev("swap_pause", req, queued=len(pending),
+                            version=self._pending_swap.get("version"))
                 self._obs_queue.set(len(pending))
                 return
             for slot in range(self.B):
@@ -1281,6 +1308,8 @@ class ServeLoop:
                         req = dataclasses.replace(
                             req, max_new_tokens=self.degrade_max_new)
                         self._obs_degrade_clamped.inc()
+                        tev("degrade_clamp", req, stage="replica",
+                            max_new=self.degrade_max_new)
                     self._obs_queue_wait.record(time.perf_counter() - t_q)
                     with obs.span("serve/admit", slot=slot):
                         slot_state[slot] = self._admit(slot, req)
@@ -1291,6 +1320,9 @@ class ServeLoop:
                     self._obs_requests.inc()
                     obs.recorder.record(
                         "serve_admit", slot=slot, seq=seq,
+                        prompt_len=int(np.asarray(req.prompt).size),
+                        max_new=req.max_new_tokens)
+                    tev("admit", req, slot=slot, seq=seq,
                         prompt_len=int(np.asarray(req.prompt).size),
                         max_new=req.max_new_tokens)
             self._obs_queue.set(len(pending))
@@ -1335,10 +1367,12 @@ class ServeLoop:
             under the old weights and must finalize against them) and
             no occupied lanes (zombies included — their pool blocks are
             refunded by the drain that just ran)."""
+            nonlocal swap_pause_logged
             if (self._pending_swap is None or inflight
                     or any(st is not None for st in slot_state)):
                 return
             swap, self._pending_swap = self._pending_swap, None
+            swap_pause_logged = False   # barrier is down; next swap re-logs
             with obs.span("serve/swap", version=swap["version"]):
                 tree = swap["fn"]()
                 if tree is not None:
@@ -1402,6 +1436,11 @@ class ServeLoop:
                         jnp.int32(n))
             self._obs_segments.inc()
             self._obs_dispatches.inc()
+            for slot in range(self.B):
+                st = slot_state[slot]
+                if st is not None and not st.get("zombie"):
+                    tev("segment", st["req"], slot=slot, seq=seq,
+                        steps=n, tokens=len(st["tokens"]))
             try:
                 emits.copy_to_host_async()
             except AttributeError:  # non-jax array (test doubles)

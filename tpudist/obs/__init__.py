@@ -17,6 +17,11 @@ The subsystem every layer reports through (see docs/OBSERVABILITY.md):
   over the published snapshots, with hysteresis.
 * :mod:`tpudist.obs.recorder` — bounded flight-recorder ring and crash
   post-mortem bundles (``with obs.recorder.guard("trainer"): ...``).
+* :mod:`tpudist.obs.events` — per-request distributed tracing: trace
+  contexts riding the serve fleet's wire format, the request-event
+  ring each process records lifecycle transitions into, fleet-wide
+  timeline merge (``python -m tpudist.obs.timeline`` renders it), and
+  SLO burn-rate accounting (:class:`SLOTracker`).
 * :mod:`tpudist.obs.xla` — XLA compile/memory/cost telemetry: compile
   counts and durations, per-device HBM gauges, live MFU.
 
@@ -37,6 +42,17 @@ from tpudist.obs.aggregate import (
     collect_and_merge,
     merge_snapshots,
 )
+from tpudist.obs.events import (
+    EventPublisher,
+    RequestEventLog,
+    SLOTracker,
+    TraceContext,
+    collect_events,
+    group_timelines,
+    is_complete,
+    merge_events,
+    timeline_for_rid,
+)
 from tpudist.obs.export import (
     MetricsServer,
     jsonl_line,
@@ -53,7 +69,7 @@ from tpudist.obs.registry import (
     hist_quantile,
     summarize,
 )
-from tpudist.obs.spans import SpanTracer
+from tpudist.obs.spans import SpanTracer, atomic_write_json
 from tpudist.obs.xla import (
     install_compile_telemetry,
     mfu,
@@ -65,6 +81,7 @@ from tpudist.obs.xla import (
 
 __all__ = [
     "Counter",
+    "EventPublisher",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
@@ -74,15 +91,24 @@ __all__ = [
     "MetricsPublisher",
     "MetricsServer",
     "POSTMORTEM_SCHEMA",
+    "RequestEventLog",
+    "SLOTracker",
     "SpanTracer",
+    "TraceContext",
+    "atomic_write_json",
     "collect",
     "collect_and_merge",
+    "collect_events",
     "counter",
+    "events",
     "gauge",
+    "group_timelines",
     "histogram",
     "hist_quantile",
     "install_compile_telemetry",
+    "is_complete",
     "jsonl_line",
+    "merge_events",
     "merge_snapshots",
     "mfu",
     "note_compile",
@@ -90,21 +116,26 @@ __all__ = [
     "peak_tflops",
     "recorder",
     "registry",
+    "slo",
     "snapshot",
     "snapshot_to_jsonl",
     "span",
     "summarize",
+    "timeline_for_rid",
     "to_prometheus",
     "tracer",
     "update_memory_gauges",
 ]
 
-# process-global registry + tracer + flight recorder: instrumentation all
-# over the stack reports here; snapshot()/tracer.dump()/recorder.dump()
-# read it out
+# process-global registry + tracer + event ring + SLO tracker + flight
+# recorder: instrumentation all over the stack reports here;
+# snapshot()/tracer.dump()/events.snapshot()/recorder.dump() read it out
 registry = MetricRegistry()
 tracer = SpanTracer()
-recorder = FlightRecorder(registry=registry, tracer=tracer)
+events = RequestEventLog()
+slo = SLOTracker(registry=registry)
+recorder = FlightRecorder(registry=registry, tracer=tracer,
+                          request_events=events)
 
 counter = registry.counter
 gauge = registry.gauge

@@ -173,6 +173,8 @@ def merge_snapshots(snapshots: dict[int, dict]) -> dict:
                 if m["value"] is not None:
                     slot["value"] += m["value"]
                 slot["per_worker"][str(rank)] = m["value"]
+                if m.get("help") and "help" not in slot:
+                    slot["help"] = m["help"]
         for name, h in snap.get("histograms", {}).items():
             slot = merged["histograms"].get(name)
             if slot is None:
@@ -180,6 +182,8 @@ def merge_snapshots(snapshots: dict[int, dict]) -> dict:
                     "unit": h.get("unit", ""), "growth": h["growth"],
                     "count": 0, "sum": 0.0, "min": None, "max": None,
                     "zero": 0, "buckets": {}, "per_worker": {}}
+            if h.get("help") and "help" not in slot:
+                slot["help"] = h["help"]
             _merge_hist(slot, h, rank)
     # canonical bucket order for stable JSON / prometheus output
     for h in merged["histograms"].values():

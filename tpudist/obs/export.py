@@ -75,7 +75,9 @@ def _prom_num(v) -> str:
 
 def to_prometheus(snapshot: dict) -> str:
     """Prometheus text exposition of a registry or merged snapshot.
-    Histograms are cumulative: ``le`` edges are the log-bucket UPPER
+    Metrics registered with a ``help`` string get a ``# HELP`` line
+    ahead of their ``# TYPE``.  Histograms are cumulative: ``le`` edges
+    are the log-bucket UPPER
     bounds (``growth**(idx+1)``; the zero bucket folds into the smallest
     edge since its values are <= 0 < every positive edge), closing with
     ``+Inf``, ``_sum`` and ``_count``.  Merged cluster snapshots keep
@@ -83,6 +85,13 @@ def to_prometheus(snapshot: dict) -> str:
     ``{name}{{worker="r"}}`` sample per rank from its ``per_worker``
     map."""
     out: list[str] = []
+
+    def help_line(pname: str, m: dict) -> None:
+        h = m.get("help")
+        if h:
+            # the exposition format's escapes: backslash and newline
+            h = h.replace("\\", "\\\\").replace("\n", "\\n")
+            out.append(f"# HELP {pname} {h}")
 
     def scalar_lines(pname: str, m: dict) -> None:
         out.append(f"{pname} {_prom_num(m['value'])}")
@@ -92,14 +101,17 @@ def to_prometheus(snapshot: dict) -> str:
 
     for name, m in snapshot.get("counters", {}).items():
         pname = _prom_name(name)
+        help_line(pname, m)
         out.append(f"# TYPE {pname} counter")
         scalar_lines(pname, m)
     for name, m in snapshot.get("gauges", {}).items():
         pname = _prom_name(name)
+        help_line(pname, m)
         out.append(f"# TYPE {pname} gauge")
         scalar_lines(pname, m)
     for name, h in snapshot.get("histograms", {}).items():
         pname = _prom_name(name)
+        help_line(pname, h)
         out.append(f"# TYPE {pname} histogram")
         growth = h["growth"]
         cum = h.get("zero", 0)

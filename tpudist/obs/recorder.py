@@ -15,6 +15,9 @@ bundles, as ONE JSON document:
   must not block the dump, so it degrades to the host-only view);
 * the span tail (the last ``span_tail`` completed spans from the
   tracer, crash-adjacent timeline context);
+* the request-event tail (the last entries of the per-request tracing
+  ring, :mod:`tpudist.obs.events` — which requests this process was
+  serving, and what lifecycle decisions it had just made);
 * environment and topology: the ``TPUDIST_*``/``JAX_*``/``XLA_FLAGS``
   env surface, pid/host, and the jax device/process layout when a
   backend is up;
@@ -35,7 +38,6 @@ docs/OBSERVABILITY.md for the field-by-field contract.
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import socket
 import threading
@@ -44,6 +46,7 @@ import traceback
 from collections import deque
 from typing import Any
 
+from tpudist.obs.spans import atomic_write_json
 from tpudist.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -89,7 +92,8 @@ class FlightRecorder:
     step)."""
 
     def __init__(self, capacity: int = 512, directory: str | None = None,
-                 registry: Any = None, tracer: Any = None) -> None:
+                 registry: Any = None, tracer: Any = None,
+                 request_events: Any = None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
@@ -99,6 +103,10 @@ class FlightRecorder:
         self.last_dump_path: str | None = None
         self._registry = registry
         self._tracer = tracer
+        # the per-request tracing ring (tpudist.obs.events): its tail
+        # joins the bundle so a crash dump carries the fleet's recent
+        # request-lifecycle decisions next to the metric/span state
+        self._request_events = request_events
         self._events: deque[dict] = deque(maxlen=capacity)
         self._lock = threading.Lock()
 
@@ -156,6 +164,13 @@ class FlightRecorder:
                 spans = self._tracer.events()[-span_tail:]
             except Exception:  # noqa: BLE001
                 spans = None
+        request_events = request_events_dropped = None
+        if self._request_events is not None:
+            try:
+                request_events = self._request_events.tail(span_tail * 4)
+                request_events_dropped = self._request_events.dropped
+            except Exception:  # noqa: BLE001
+                request_events = None
         exc_doc = None
         if exc is not None:
             exc_doc = {
@@ -178,6 +193,8 @@ class FlightRecorder:
             "events_dropped": self.dropped,
             "snapshot": snapshot,
             "spans": spans,
+            "request_events": request_events,
+            "request_events_dropped": request_events_dropped,
             "last_hlo": self.last_hlo,
         }
 
@@ -196,8 +213,7 @@ class FlightRecorder:
             path = os.path.join(
                 directory,
                 f"postmortem-{os.getpid()}-{int(doc['time'] * 1000)}.json")
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1)
+        atomic_write_json(path, doc, indent=1)
         self.last_dump_path = path
         return path
 

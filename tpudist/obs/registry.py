@@ -88,7 +88,10 @@ class Counter:
         return self._total
 
     def _snap(self) -> dict:
-        return {"value": self._total, "unit": self.unit}
+        snap = {"value": self._total, "unit": self.unit}
+        if self.help:
+            snap["help"] = self.help
+        return snap
 
 
 class Gauge:
@@ -124,7 +127,10 @@ class Gauge:
         return self._value
 
     def _snap(self) -> dict:
-        return {"value": self._value, "unit": self.unit}
+        snap = {"value": self._value, "unit": self.unit}
+        if self.help:
+            snap["help"] = self.help
+        return snap
 
 
 class Histogram:
@@ -249,6 +255,8 @@ class Histogram:
         }
         if self.window_s is not None:
             snap["window_s"] = self.window_s
+        if self.help:
+            snap["help"] = self.help
         return snap
 
 

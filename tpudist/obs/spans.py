@@ -33,12 +33,37 @@ import collections
 import contextlib
 import json
 import os
+import tempfile
 import threading
 import time
 
 from tpudist.utils.config import env_flag
 
-__all__ = ["SpanTracer"]
+__all__ = ["SpanTracer", "atomic_write_json"]
+
+
+def atomic_write_json(path: str | os.PathLike, doc,
+                      indent: int | None = None) -> str:
+    """Write ``doc`` as JSON via temp file + atomic rename, so a crash
+    mid-dump (the exact moment traces and post-mortems get written)
+    can never leave a truncated/unparseable file at ``path``."""
+    path = os.fspath(path)
+    fd, tmp = tempfile.mkstemp(
+        prefix=".tmp-" + os.path.basename(path) + "-",
+        dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=indent)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 def _trace_annotation(name: str):
@@ -130,9 +155,7 @@ class SpanTracer:
         return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
 
     def write(self, path: str) -> str:
-        with open(path, "w") as f:
-            json.dump(self.dump(), f)
-        return path
+        return atomic_write_json(path, self.dump())
 
     def clear(self) -> None:
         with self._lock:

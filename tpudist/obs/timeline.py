@@ -1,0 +1,182 @@
+"""Request-timeline reconstruction: ``python -m tpudist.obs.timeline``.
+
+Loads a fleet event log — the merged ``tpudist.events/1`` document
+(:func:`tpudist.obs.events.merge_events` output, e.g. the file the
+``serve_fleet`` bench writes), a ``tpudist.postmortem/1`` crash bundle
+(whose ``request_events`` tail this tool understands), or a raw
+published ring snapshot — and reconstructs each request's causal
+history: one time-ordered timeline per trace id, spanning every
+process the request touched (router enqueue/dispatch, replica
+admit/segments, a SIGKILL's redispatch, the replica-side done-commit,
+the router-side done).
+
+Text mode prints each timeline with per-event offsets from its enqueue;
+``--chrome OUT`` additionally exports the merged view as Chrome-trace
+JSON (chrome://tracing / Perfetto): each trace id becomes one track,
+consecutive lifecycle events become the "X" slices between them, so a
+request's wait / decode / redispatch phases are visible as bars.
+
+Usage::
+
+    python -m tpudist.obs.timeline events.json                # all traces
+    python -m tpudist.obs.timeline events.json --trace ID     # one trace
+    python -m tpudist.obs.timeline events.json --rid q3       # by caller rid
+    python -m tpudist.obs.timeline events.json --chrome t.json
+    python -m tpudist.obs.timeline events.json --require-complete
+
+``--require-complete`` exits 1 unless every resolved trace passes
+:func:`tpudist.obs.events.is_complete` — the CI gate that no completed
+request has a gap in its recorded history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpudist.obs.events import (
+    EVENTS_SCHEMA,
+    group_timelines,
+    is_complete,
+    timeline_for_rid,
+)
+from tpudist.obs.spans import atomic_write_json
+
+__all__ = ["load_events", "render_timeline", "to_chrome", "main"]
+
+
+def load_events(path: str) -> list[dict]:
+    """Events from any of the recognized on-disk shapes (see module
+    docstring); raises ``ValueError`` on an unrecognizable document."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict):
+        if doc.get("schema") == EVENTS_SCHEMA or "events" in doc and \
+                not doc.get("schema", "").startswith("tpudist.postmortem"):
+            evs = doc.get("events")
+            if isinstance(evs, list):
+                return evs
+        if "request_events" in doc:    # a postmortem bundle's tail
+            return doc["request_events"] or []
+    raise ValueError(
+        f"{path}: not an event log ({EVENTS_SCHEMA}), postmortem "
+        f"bundle, or raw event list")
+
+
+def render_timeline(trace_id: str, timeline: list[dict]) -> list[str]:
+    """Human-readable causal history, offsets relative to the first
+    event (the router enqueue when the timeline is complete)."""
+    if not timeline:
+        return [f"trace {trace_id}: (no events)"]
+    t0 = timeline[0].get("t", 0.0)
+    status = "complete" if is_complete(timeline) else "INCOMPLETE"
+    lines = [f"trace {trace_id} [{status}] "
+             f"({len(timeline)} events over "
+             f"{timeline[-1].get('t', t0) - t0:.3f}s)"]
+    for ev in timeline:
+        detail = " ".join(
+            f"{k}={ev[k]}" for k in sorted(ev)
+            if k not in ("t", "i", "kind", "trace", "src")
+            and ev[k] is not None)
+        lines.append(f"  +{ev.get('t', t0) - t0:9.4f}s "
+                     f"{ev.get('src', '?'):>8} {ev.get('kind', '?'):<14}"
+                     f" {detail}".rstrip())
+    return lines
+
+
+def to_chrome(events: list[dict]) -> dict:
+    """Chrome-trace JSON of the merged view: one tid per trace id
+    (trace-less fleet events land on tid 0), consecutive events drawn
+    as the slice between them, terminal events as instants."""
+    timelines = group_timelines(events)
+    tids = {tid: n for n, tid in enumerate(
+        sorted((t for t in timelines if t is not None)), start=1)}
+    out: list[dict] = []
+    for tid, timeline in sorted(timelines.items(),
+                                key=lambda kv: str(kv[0])):
+        track = tids.get(tid, 0)
+        out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": track,
+                    "args": {"name": f"trace {tid or '(fleet)'}"}})
+        for ev, nxt in zip(timeline, timeline[1:]):
+            out.append({
+                "name": ev.get("kind", "?"), "ph": "X",
+                "ts": ev.get("t", 0.0) * 1e6,
+                "dur": max(1.0, (nxt.get("t", 0.0) - ev.get("t", 0.0))
+                           * 1e6),
+                "pid": 0, "tid": track,
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("t", "kind") and v is not None}})
+        if timeline:
+            last = timeline[-1]
+            out.append({
+                "name": last.get("kind", "?"), "ph": "i", "s": "t",
+                "ts": last.get("t", 0.0) * 1e6, "pid": 0, "tid": track,
+                "args": {k: v for k, v in last.items()
+                         if k not in ("t", "kind") and v is not None}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpudist.obs.timeline",
+        description="Reconstruct per-request fleet timelines from a "
+                    "merged event log (see tpudist.obs.events).")
+    ap.add_argument("path", help="event log / postmortem JSON")
+    ap.add_argument("--trace", help="show only this trace id")
+    ap.add_argument("--rid", help="show only the trace whose enqueue "
+                                  "carries this caller rid")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also write Chrome-trace JSON (atomic)")
+    ap.add_argument("--require-complete", action="store_true",
+                    help="exit 1 unless every resolved trace is "
+                         "gap-free (CI gate)")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.path)
+    timelines = group_timelines(events)
+
+    selected = timelines
+    if args.trace is not None:
+        if args.trace not in timelines:
+            print(f"trace {args.trace!r} not in log "
+                  f"({len(timelines)} traces)", file=sys.stderr)
+            return 2
+        selected = {args.trace: timelines[args.trace]}
+    elif args.rid is not None:
+        tl = timeline_for_rid(timelines, args.rid)
+        if tl is None:
+            print(f"no trace with enqueue rid={args.rid!r}",
+                  file=sys.stderr)
+            return 2
+        selected = {tl[0].get("trace"): tl}
+
+    for tid, timeline in sorted(selected.items(),
+                                key=lambda kv: str(kv[0])):
+        if tid is None:
+            continue   # trace-less fleet events: chrome export only
+        print("\n".join(render_timeline(tid, timeline)))
+
+    if args.chrome:
+        atomic_write_json(args.chrome, to_chrome(events))
+        print(f"chrome trace: {args.chrome}", file=sys.stderr)
+
+    if args.require_complete:
+        bad = [tid for tid, tl in timelines.items()
+               if tid is not None
+               and any(e.get("kind") in ("done", "shed", "timeout",
+                                         "failed") for e in tl)
+               and not is_complete(tl)]
+        if bad:
+            print(f"INCOMPLETE timelines: {bad}", file=sys.stderr)
+            return 1
+        print(f"all {sum(1 for t in timelines if t is not None)} "
+              f"timelines complete", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,7 +94,8 @@ class AutoscaleConfig:
                  down_cooldown_s: float = 20.0,
                  poll_s: float = 0.5,
                  step: int = 1,
-                 max_metric_age_s: float = 5.0) -> None:
+                 max_metric_age_s: float = 5.0,
+                 max_burn_rate: float | None = None) -> None:
         if min_replicas < 0 or max_replicas < max(min_replicas, 1):
             raise ValueError(
                 f"need 0 <= min_replicas <= max_replicas (>=1), got "
@@ -125,6 +126,16 @@ class AutoscaleConfig:
         self.poll_s = float(poll_s)
         self.step = int(step)
         self.max_metric_age_s = float(max_metric_age_s)
+        if max_burn_rate is not None and max_burn_rate <= 0:
+            raise ValueError(
+                f"max_burn_rate must be > 0, got {max_burn_rate}")
+        # SLO burn-rate pressure: a sustained burn above this (fleet
+        # merged slo/burn_rate_* gauges, OR the local tracker) counts as
+        # a breach poll even while queue-wait looks fine — bad outcomes
+        # (sheds, timeouts, failures) scale the fleet up too, not just
+        # slow queues.  None disables the signal.
+        self.max_burn_rate = (None if max_burn_rate is None
+                              else float(max_burn_rate))
 
     @classmethod
     def from_env(cls, environ=None, **overrides) -> "AutoscaleConfig":
@@ -144,7 +155,8 @@ class AutoscaleConfig:
                 ("DOWN_COOLDOWN_S", "down_cooldown_s", float),
                 ("POLL_S", "poll_s", float),
                 ("STEP", "step", int),
-                ("MAX_METRIC_AGE_S", "max_metric_age_s", float)):
+                ("MAX_METRIC_AGE_S", "max_metric_age_s", float),
+                ("MAX_BURN_RATE", "max_burn_rate", float)):
             v = _env(env, name)
             if v is not None:
                 kw[key] = cast(v)
@@ -216,6 +228,10 @@ class Autoscaler:
         self._obs_breach = obs.gauge("autoscale/breach_polls",
                                      unit="polls")
         self._obs_idle = obs.gauge("autoscale/idle_polls", unit="polls")
+        self._obs_burn = obs.gauge(
+            "autoscale/burn_rate", unit="x",
+            help="SLO burn rate the scaling decision saw (max of fleet "
+                 "gauges and the local tracker's shortest window)")
 
     def _default_spawner(self, n: int) -> list:
         return scale_fleet(self.coord_addr, n, namespace=self.ns,
@@ -259,9 +275,23 @@ class Autoscaler:
                  or {}).get("value") or 0.0
         free = (merged["gauges"].get("serve/kv_blocks_free")
                 or {}).get("value")
+        # burn rate: worst across the fleet's published slo/burn_rate_*
+        # gauges (per_worker max — summing rates across replicas would
+        # overstate) and the local tracker's shortest window (a rank-0
+        # router records its own terminal decisions into obs.slo)
+        burn = 0.0
+        for name, g in merged["gauges"].items():
+            if name.startswith("slo/burn_rate_"):
+                vals = [v for v in g.get("per_worker", {}).values()
+                        if v is not None]
+                if vals:
+                    burn = max(burn, max(vals))
+        local = obs.slo.burn_rates()
+        if local:
+            burn = max(burn, local[min(local)])
         return {"live": live, "draining": draining, "wait_q": wait_q,
                 "queue_depth": depth, "kv_blocks_free": free,
-                "snaps": snaps}
+                "burn_rate": burn, "snaps": snaps}
 
     def _pending_joiners(self, live: set[str]) -> list:
         """Spawned-but-not-yet-heartbeating joiners: count them toward
@@ -342,7 +372,9 @@ class Autoscaler:
         now = self._clock()
         action = None
 
-        if view["wait_q"] > self.cfg.target_wait_s:
+        burning = (self.cfg.max_burn_rate is not None
+                   and view["burn_rate"] > self.cfg.max_burn_rate)
+        if view["wait_q"] > self.cfg.target_wait_s or burning:
             self._breach += 1
             self._idle = 0
         elif (view["wait_q"] < self.cfg.low_wait_s
@@ -393,10 +425,12 @@ class Autoscaler:
         self._obs_wait.set(view["wait_q"])
         self._obs_breach.set(self._breach)
         self._obs_idle.set(self._idle)
+        self._obs_burn.set(view["burn_rate"])
         return {"action": action, "wait_q": view["wait_q"],
                 "active": sorted(active), "draining": sorted(draining),
                 "pending": len(pending),
                 "queue_depth": view["queue_depth"],
+                "burn_rate": view["burn_rate"],
                 "breach": self._breach, "idle": self._idle}
 
     # -- background loop ---------------------------------------------------

@@ -142,6 +142,7 @@ import numpy as np
 
 from tpudist import obs
 from tpudist.obs.aggregate import collect, MetricsPublisher
+from tpudist.obs.events import EventPublisher, TraceContext
 from tpudist.obs.health import HealthMonitor
 from tpudist.obs.registry import hist_quantile
 from tpudist.runtime import faults
@@ -161,13 +162,20 @@ DEFAULT_NAMESPACE = "fleet"
 # -- wire format (JSON over the KV store) ---------------------------------
 
 def _encode_request(key: str, req) -> bytes:
-    return json.dumps({
+    doc = {
         "key": key,
         "prompt": np.asarray(req.prompt).astype(int).tolist(),
         "max_new_tokens": int(req.max_new_tokens),
         "deadline_s": req.deadline_s,
         "priority": int(getattr(req, "priority", 0)),
-    }).encode()
+    }
+    # distributed tracing: the trace context rides the wire so the
+    # replica's lifecycle events join the router's under one trace id
+    # (and SURVIVE a redispatch — the router re-sends the same context)
+    trace = getattr(req, "trace", None)
+    if trace is not None:
+        doc["trace"] = trace.to_wire()
+    return json.dumps(doc).encode()
 
 
 def _decode_request(raw: bytes):
@@ -177,7 +185,8 @@ def _decode_request(raw: bytes):
     return Request(prompt=np.asarray(d["prompt"], np.int32),
                    max_new_tokens=int(d["max_new_tokens"]),
                    rid=d["key"], deadline_s=d.get("deadline_s"),
-                   priority=int(d.get("priority", 0)))
+                   priority=int(d.get("priority", 0)),
+                   trace=TraceContext.from_wire(d.get("trace")))
 
 
 def _encode_completion(replica_id: str, comp) -> bytes:
@@ -251,6 +260,15 @@ class ReplicaWorker:
         self._pub = MetricsPublisher(client, self.rank, obs.registry,
                                      namespace=f"{namespace}/metrics",
                                      interval_s=publish_interval_s)
+        # request-event ring publisher: per-replica lifecycle events flow
+        # to {ns}/events/{rank}; rank 0 (or the bench) merges them into
+        # the fleet-wide timeline.  rid -> TraceContext for requests this
+        # replica picked up, so the done-commit event can be recorded
+        # without widening the completion wire format.
+        self._epub = EventPublisher(client, self.rank, obs.events,
+                                    namespace=f"{namespace}/events",
+                                    interval_s=publish_interval_s)
+        self._traces: dict[str, Any] = {}
         if snapshot_dir is not None:
             got = self._restore_latest()
             if got is not None:
@@ -409,10 +427,14 @@ class ReplicaWorker:
             if raw is None:   # racing a router sweep of a presumed death
                 continue
             try:
-                out.append(_decode_request(raw))
+                req = _decode_request(raw)
             except (ValueError, KeyError) as e:
                 log.warning("replica %s: dropping undecodable request "
                             "%s: %s", self.replica_id, key, e)
+                continue
+            if req.trace is not None:
+                self._traces[str(req.rid)] = req.trace
+            out.append(req)
         return out
 
     def _sink(self, comp) -> None:
@@ -430,6 +452,13 @@ class ReplicaWorker:
         self.client.set(f"{self.ns}/done/{comp.rid}",
                         _encode_completion(self.replica_id, comp))
         self._served += 1
+        trace = self._traces.pop(str(comp.rid), None)
+        if trace is not None:
+            # the exactly-once commit point, in the timeline: everything
+            # after this is router-side consumption
+            obs.events.record("done_commit", trace=trace.trace_id,
+                              replica=self.replica_id, reason=comp.reason,
+                              tokens=int(np.asarray(comp.tokens).size))
 
     def pool_drained(self) -> bool | None:
         pool = self.loop.pool
@@ -447,6 +476,7 @@ class ReplicaWorker:
         self._hb.start(0)
         self._pub.start()
         self._pub.publish()   # immediate: the router gates on load info
+        self._epub.start()
         clean = False
         try:
             self.loop.run((), source=self._source, sink=self._sink,
@@ -464,6 +494,7 @@ class ReplicaWorker:
             except Exception:
                 pass
             self._pub.stop(final_publish=True)
+            self._epub.stop(final_publish=True)
             self._hb.stop(graceful=True)
 
 
@@ -560,6 +591,38 @@ class Router:
         self._obs_outstanding = obs.gauge("router/outstanding", unit="reqs")
         self._obs_pool = obs.gauge("router/pool", unit="generation")
         self._obs_degraded = obs.gauge("router/degraded", unit="bool")
+        # per-reason terminal-decision counters: how each request LEFT
+        # the router (completed normally, shed at admission, timed out,
+        # failed past max_redispatch) plus the non-terminal re-route.
+        # Surfaced by loads()' fleet view and the bench JSONL.
+        self._obs_decisions = {
+            reason: obs.counter(
+                f"router/decisions/{reason}", unit="reqs",
+                help=f"requests resolved by the router as {reason!r}")
+            for reason in ("completed", "shed", "rejected", "failed",
+                           "timeout")}
+
+    def _decide(self, reason: str, e: dict | None = None,
+                **fields) -> None:
+        """Count a routing decision, feed the SLO tracker, and (for a
+        traced request) append the matching timeline event."""
+        c = self._obs_decisions.get(reason)
+        if c is not None:
+            c.inc()
+        if reason != "rejected":   # re-routes are not terminal outcomes
+            obs.slo.observe(reason if reason != "completed"
+                            else fields.get("serve_reason", "stop"))
+        trace = (e or {}).get("trace")
+        if trace is not None:
+            kind = {"completed": "done", "rejected": "reroute"}.get(
+                reason, reason)
+            obs.events.record(kind, trace=trace.trace_id, **fields)
+
+    def decisions(self) -> dict[str, float]:
+        """Per-reason terminal decision counts (plus re-routes under
+        ``rejected``): ``{reason: count}``."""
+        return {reason: c.value()
+                for reason, c in self._obs_decisions.items()}
 
     # -- fleet view --------------------------------------------------------
 
@@ -626,6 +689,8 @@ class Router:
                 "queue_wait_q": (hist_quantile(wait, self.slo_quantile)
                                  if wait and wait["count"] else 0.0),
                 "rejected": (counters.get("serve/rejected")
+                             or {}).get("value") or 0.0,
+                "timeouts": (counters.get("serve/timeouts")
                              or {}).get("value") or 0.0,
                 "swapping": bool((gauges.get("serve/swapping")
                                   or {}).get("value") or 0.0),
@@ -706,7 +771,15 @@ class Router:
         for req in requests:
             key = f"{self._seq:08d}"
             self._seq += 1
-            entries[key] = {"req": req, "assigned": None, "attempts": 0}
+            # mint the trace context here — submit IS the trace root.
+            # It lives in the router entry (not just the request), so a
+            # redispatch re-sends the SAME context and the replica-side
+            # events of both attempts merge under one trace id.
+            tc = TraceContext.mint(key)
+            entries[key] = {"req": req, "assigned": None, "attempts": 0,
+                            "trace": tc}
+            obs.events.record("enqueue", trace=tc.trace_id, key=key,
+                              rid=str(req.rid))
             order.append(key)
         self._obs_requests.inc(len(order))
         done: dict[str, Completion] = {}
@@ -802,8 +875,13 @@ class Router:
                 self._obs_rerouted.inc()
                 self._backoff[payload.get("replica", "")] = (
                     time.monotonic() + self.reject_backoff_s)
+                self._decide("rejected", e,
+                             replica=payload.get("replica"))
             else:
                 complete(k, comp)
+                self._decide("completed", e, serve_reason=comp.reason,
+                             replica=payload.get("replica"),
+                             tokens=int(np.asarray(comp.tokens).size))
 
         # 2) death detection + drain/redispatch
         verdict_lost: set[str] = set()
@@ -864,12 +942,18 @@ class Router:
                 e["attempts"] += 1
                 progressed = True
                 self._obs_redispatched.inc()
+                trace = e.get("trace")
+                if trace is not None:
+                    obs.events.record("redispatch", trace=trace.trace_id,
+                                      from_replica=rid,
+                                      attempts=e["attempts"])
                 if e["attempts"] > self.max_redispatch:
                     req = e["req"]
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32),
                         reason="failed"))
+                    self._decide("failed", e, attempts=e["attempts"])
 
         # 3) dispatch unassigned requests least-loaded
         now = time.monotonic()
@@ -926,6 +1010,7 @@ class Router:
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32), reason="timeout"))
+                    self._decide("timeout", e, stage="router")
                     progressed = True
                     continue
                 if (req.deadline_s is not None and e["attempts"] == 0
@@ -938,12 +1023,15 @@ class Router:
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32), reason="shed"))
+                    self._decide("shed", e, predicted_wait_s=best_wait)
                     progressed = True
                     continue
                 rid = self._pick(candidates, loads, assigned_counts)
                 if rid is None:
                     break
-                send = req
+                trace = e.get("trace")
+                send = req if trace is None else dataclasses.replace(
+                    req, trace=trace)
                 if (degraded and self.degrade_max_new is not None
                         and getattr(req, "priority", 0) <= 0
                         and req.max_new_tokens > self.degrade_max_new):
@@ -952,14 +1040,23 @@ class Router:
                     # answer now beats a rejection later.  Higher
                     # priority classes keep full budgets.
                     send = dataclasses.replace(
-                        req, max_new_tokens=self.degrade_max_new)
+                        send, max_new_tokens=self.degrade_max_new)
                     self._obs_degrade_clamped.inc()
+                    if trace is not None:
+                        obs.events.record(
+                            "degrade_clamp", trace=trace.trace_id,
+                            stage="router",
+                            max_new=self.degrade_max_new)
                 self.client.set(f"{self.ns}/inbox/{rid}/{k}",
                                 _encode_request(k, send))
                 e["assigned"] = rid
                 assigned_counts[rid] = assigned_counts.get(rid, 0) + 1
                 progressed = True
                 self._obs_dispatched.inc()
+                if trace is not None:
+                    obs.events.record("dispatch", trace=trace.trace_id,
+                                      replica=rid,
+                                      attempt=e["attempts"])
         return progressed
 
 
