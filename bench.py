@@ -36,12 +36,45 @@ from pathlib import Path
 
 _EMITTED: list[dict] = []  # every metric line, re-printed in the recap
 
+# row provenance (ISSUE 11 satellite): every emitted line says which
+# schema revision produced it, at which commit, under which seed, from
+# which bench — so a BENCH_*.json artifact is self-describing when it
+# is compared across runs.  Schema 2 = schema 1 + these four keys.
+_BENCH_SCHEMA = 2
+_GIT_SHA: str | None | bool = False   # False = not resolved yet
+_CURRENT_BENCH: str | None = None
+
+
+def _git_sha() -> str | None:
+    global _GIT_SHA
+    if _GIT_SHA is False:
+        import subprocess
+        try:
+            _GIT_SHA = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=Path(__file__).parent).stdout.strip() or None
+        except Exception:  # noqa: BLE001 - not a git checkout
+            _GIT_SHA = None
+    return _GIT_SHA
+
+
+def _bench_seed() -> int:
+    import os
+    try:
+        return int(os.environ.get("TPUDIST_BENCH_SEED", "0"))
+    except ValueError:
+        return 0
+
 
 def _emit(metric, value, unit, vs_baseline=None, **extra) -> None:
     # formatting goes through the obs JSONL exporter (same schema this
     # function always printed; BENCH_*.json parsers see identical lines)
     from tpudist.obs.export import jsonl_line
 
+    prov = {"bench_schema": _BENCH_SCHEMA, "git_sha": _git_sha(),
+            "seed": _bench_seed(), "bench": _CURRENT_BENCH}
+    extra.update((k, v) for k, v in prov.items() if k not in extra)
     line = jsonl_line(metric, value, unit, vs_baseline, **extra)
     _EMITTED.append(json.loads(line))
     print(line, flush=True)
@@ -2530,6 +2563,156 @@ def bench_serve_autoscale(on_tpu: bool) -> None:
     server.stop()
 
 
+def bench_scenario_matrix(on_tpu: bool) -> None:
+    """The scenario regression matrix (ISSUE 11 tentpole): every
+    builtin scenario runs through the offline fleet simulator — the
+    REAL router + autoscaler on a virtual clock — and emits one
+    ``scenario/{name}`` row in the shared summary schema, already
+    checked against its own SLO envelope.  CI gates on these rows via
+    ``python -m tpudist.sim.envelope``; one scenario failing emits an
+    ERROR row instead of muting the rest of the matrix."""
+    from tpudist.sim.scenario import builtin, names
+    from tpudist.sim.simulator import FleetSim
+
+    for name in names():
+        try:
+            row = FleetSim(builtin(name)).run()
+        except Exception as e:  # noqa: BLE001 - keep the matrix going
+            _emit(f"ERROR_scenario_{name}", 0, "error", None,
+                  error=str(e)[:200])
+            continue
+        _emit(f"scenario/{name}", row["completed_ok"], "reqs", None,
+              **{k: v for k, v in row.items() if k != "completed_ok"})
+
+
+def _first_up_rel(decision_log, action_seq, target_wait_s):
+    """Polls between the first breach observation and the first
+    scale-up — the hysteresis distance both execution paths must agree
+    on (absolute poll indices differ by when each loop started; the
+    breach-relative index is the policy's own invariant)."""
+    breaches = [r["poll"] for r in decision_log
+                if r["wait_q"] > target_wait_s]
+    ups = [a["poll"] for a in action_seq if a["kind"] == "up"]
+    if not breaches or not ups:
+        return None
+    return ups[0] - breaches[0]
+
+
+def bench_sim_replay(on_tpu: bool) -> None:
+    """Simulator-vs-live agreement (ISSUE 11 acceptance): a live
+    1-replica fleet takes a spike under a millisecond wait target (the
+    autoscaler buys capacity), the run is recorded as a merged
+    ``tpudist.events/1`` trace + the autoscaler's decision log; then
+    the OFFLINE simulator replays the trace — same arrival offsets,
+    recorded seconds-per-token, identical ``AutoscaleConfig`` — and
+    must reproduce the scale-up decision sequence within one poll of
+    the breach, >= 100x faster than the live run took."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request
+    from tpudist.obs.events import collect_events, merge_events
+    from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, launch_local_fleet,
+                                        stop_fleet, wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_sim_replay", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    autoscale = dict(
+        min_replicas=1, max_replicas=2, target_wait_s=0.005,
+        low_wait_s=0.001, quantile=0.9, breach_polls=2, idle_polls=50,
+        up_cooldown_s=60.0, down_cooldown_s=600.0, poll_s=0.25,
+        max_metric_age_s=10.0)
+    ns = "bench-replay"
+    addr = f"127.0.0.1:{server.port}"
+    client = CoordClient(port=server.port)
+    args = ["--cache-layout", "paged", "--kv-block-size", "16",
+            "--ttl", "1.0"]
+    window = {"TPUDIST_SERVE_WAIT_WINDOW_S": "15"}
+    rng = np.random.default_rng(_bench_seed())
+    spike = [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                     16, rid=f"rp-{i}") for i in range(16)]
+    # the recorded trace must not carry enqueue events from earlier
+    # benches in this process — the replayer would re-arrive them too
+    obs.events.clear()
+    procs = launch_local_fleet(addr, 1, namespace=ns, replica_args=args,
+                               env_overrides={0: dict(window)})
+    scaler = Autoscaler(
+        CoordClient(port=server.port), coord_addr=addr, namespace=ns,
+        config=AutoscaleConfig(**autoscale),
+        replica_args=args, env_extra=dict(window))
+    try:
+        wait_live(client, 1, namespace=ns, timeout_s=120.0, procs=procs)
+        router = Router(client, namespace=ns, lost_after_s=5.0)
+        router._poll({}, {}, None)        # pin the membership baseline
+        t0 = time.perf_counter()
+        scaler.start()
+        comps = router.run(list(spike), timeout_s=240.0)
+        limit = time.perf_counter() + 90.0
+        while (time.perf_counter() < limit
+               and not any(a["kind"] == "up"
+                           for a in scaler.action_seq())):
+            time.sleep(0.5)
+        live_wall_s = time.perf_counter() - t0
+        scaler.stop()
+    finally:
+        scaler.stop()
+        stop_fleet(client, procs + scaler.procs, namespace=ns)
+
+    doc = merge_events(collect_events(client, f"{ns}/events"),
+                       router=obs.events.snapshot())
+    server.stop()
+    live_log = list(scaler.decision_log)
+    live_acts = scaler.action_seq()
+    live_rel = _first_up_rel(live_log, live_acts, autoscale["target_wait_s"])
+
+    import os
+    record_to = os.environ.get("TPUDIST_SIM_REPLAY_RECORD")
+    if record_to:
+        # check-in-able fixture: the recorded live run the offline
+        # agreement test (tests/test_sim.py) replays without a fleet
+        with open(record_to, "w") as f:
+            json.dump({"schema": "tpudist.sim_replay_fixture/1",
+                       "autoscale": autoscale,
+                       "decision_log": live_log,
+                       "action_seq": live_acts,
+                       "live_wall_s": round(live_wall_s, 2),
+                       "events": doc}, f)
+
+    from tpudist.sim.simulator import FleetSim
+
+    sim = FleetSim.from_trace(doc, autoscale=autoscale, replicas=1)
+    t0 = time.perf_counter()
+    sim_row = sim.run()
+    sim_wall_s = time.perf_counter() - t0
+    sim_acts = sim.scaler.action_seq()
+    sim_rel = _first_up_rel(sim.scaler.decision_log, sim_acts,
+                            autoscale["target_wait_s"])
+    live_ups = sum(1 for a in live_acts if a["kind"] == "up")
+    sim_ups = sum(1 for a in sim_acts if a["kind"] == "up")
+    decision_match = bool(
+        live_ups == sim_ups and live_rel is not None
+        and sim_rel is not None and abs(live_rel - sim_rel) <= 1)
+    speedup = live_wall_s / sim_wall_s if sim_wall_s > 0 else None
+    _emit("sim_replay", round(speedup, 1) if speedup else 0, "x", None,
+          decision_match=decision_match,
+          live_ups=live_ups, sim_ups=sim_ups,
+          live_first_up_rel=live_rel, sim_first_up_rel=sim_rel,
+          live_wall_s=round(live_wall_s, 2),
+          sim_wall_s=round(sim_wall_s, 4),
+          requests=len(spike),
+          completed=sum(1 for c in comps
+                        if c.reason in ("stop", "length")),
+          replay_lost=sim_row["lost_requests"],
+          replay_events=len(doc.get("events", [])))
+
+
 def main() -> None:
     import jax
 
@@ -2548,7 +2731,8 @@ def main() -> None:
                bench_pipeline_spans, bench_tp_flash_decode,
                bench_speculative_decode, bench_host_allreduce,
                bench_serve_fleet, bench_serve_fused, bench_serve_elastic,
-               bench_serve_autoscale]
+               bench_serve_autoscale, bench_scenario_matrix,
+               bench_sim_replay]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches
@@ -2572,11 +2756,14 @@ def main() -> None:
     if pats:
         benches = [b for b in benches
                    if any(p in b.__name__ for p in pats)]
+    global _CURRENT_BENCH
     for bench in benches:
+        _CURRENT_BENCH = bench.__name__.removeprefix("bench_")
         try:
             bench(on_tpu)
         except Exception as e:  # noqa: BLE001 - one failure must not mute the rest
             _emit(f"ERROR_{bench.__name__}", 0, "error", None, error=str(e)[:200])
+    _CURRENT_BENCH = None
     _recap()
 
 

@@ -11,7 +11,7 @@ import pytest
 from tpudist.obs.events import (
     EVENTS_SCHEMA, EventPublisher, RequestEventLog, SLOTracker,
     TraceContext, collect_events, group_timelines, is_complete,
-    merge_events, timeline_for_rid)
+    merge_events, slo_class, timeline_for_rid)
 
 
 class FakeKV:
@@ -219,6 +219,51 @@ class TestSLOTracker:
         assert slo.counts(60.0) == (1, 0)
         slo.clear()
         assert slo.counts(60.0) == (0, 0)
+
+
+class TestPerClassSLO:
+    def test_slo_class_mapping(self):
+        assert slo_class(0) == "best_effort"
+        assert slo_class(None) == "best_effort"
+        assert slo_class(3) == "priority"
+
+    def test_classes_burn_separate_budgets(self):
+        slo = SLOTracker(target=0.9, windows=(60.0,),
+                         clock=lambda: 100.0)
+        slo.observe("stop", priority=0)
+        slo.observe("shed", priority=0)      # best-effort burns...
+        slo.observe("stop", priority=2)      # ...priority does not
+        assert slo.counts(60.0) == (2, 1)
+        assert slo.counts(60.0, cls="best_effort") == (1, 1)
+        assert slo.counts(60.0, cls="priority") == (1, 0)
+        assert slo.burn_rates(cls="priority")[60.0] == 0.0
+        assert slo.burn_rates(cls="best_effort")[60.0] \
+            == pytest.approx(5.0)
+
+    def test_class_counters_render_as_prometheus_labels(self):
+        from tpudist.obs.export import _split_labels, to_prometheus
+        from tpudist.obs.registry import MetricRegistry
+
+        assert _split_labels("slo/bad~class=priority") \
+            == ("slo/bad", {"class": "priority"})
+        assert _split_labels("plain/name") == ("plain/name", {})
+
+        reg = MetricRegistry()
+        slo = SLOTracker(registry=reg, target=0.99, windows=(60.0,))
+        slo.observe("timeout", priority=1)
+        slo.observe("stop", priority=0)
+        snap = reg.snapshot()
+        counters = snap["counters"]
+        assert counters["slo/bad~class=priority"]["value"] == 1
+        assert counters["slo/good~class=best_effort"]["value"] == 1
+        assert counters["slo/bad~class=best_effort"]["value"] == 0
+        text = to_prometheus(snap)
+        assert 'slo_bad{class="priority"} 1.0' in text
+        assert 'slo_good{class="best_effort"} 1.0' in text
+        assert 'slo_burn_rate_60s{class="priority"}' in text
+        # labeled series share ONE TYPE line per base metric (the
+        # exposition format forbids duplicates)
+        assert text.count("# TYPE slo_bad counter") == 1
 
 
 class TestAtomicWrites:

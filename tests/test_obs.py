@@ -322,8 +322,13 @@ class TestExporters:
         bench._emit("smoke_metric", 1.5, "s", None, extra=2)
         out = capsys.readouterr().out.strip().splitlines()[-1]
         obj = json.loads(out)
-        assert obj == {"metric": "smoke_metric", "value": 1.5, "unit": "s",
-                       "vs_baseline": None, "extra": 2}
+        core = {k: obj[k] for k in ("metric", "value", "unit",
+                                    "vs_baseline", "extra")}
+        assert core == {"metric": "smoke_metric", "value": 1.5, "unit": "s",
+                        "vs_baseline": None, "extra": 2}
+        # every row carries provenance (caller-supplied keys win)
+        assert obj["bench_schema"] == bench._BENCH_SCHEMA
+        assert set(obj) >= {"git_sha", "seed", "bench"}
         assert bench._EMITTED[n0:] == [obj]
         del bench._EMITTED[n0:]
 

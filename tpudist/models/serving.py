@@ -507,8 +507,15 @@ class ServeLoop:
         # EMA of measured seconds per generated token (dispatch -> drain
         # wall time / tokens; an OVERestimate under pipelining, which
         # only clamps harder) — feeds the deadline-aware segment-length
-        # clamp in _plan_steps
+        # clamp in _plan_steps.  Published as a gauge (and stamped into
+        # segment events) so the offline fleet simulator and postmortem
+        # bundles can read REAL service rates from recorded traces.
         self._step_ema: float | None = None
+        self._obs_spt = obs.gauge(
+            "serve/seconds_per_token", unit="s",
+            help="EMA of realized seconds per generated token "
+                 "(dispatch->drain wall / tokens; the replica's "
+                 "service rate)")
         # donate every rebound carry: cache, tok, active, remaining, key
         # (argnums 2-4 and 6) mirror _admit_dev — their inputs are dead
         # the moment the segment returns replacements.  `first` (argnum 5)
@@ -1440,7 +1447,9 @@ class ServeLoop:
                 st = slot_state[slot]
                 if st is not None and not st.get("zombie"):
                     tev("segment", st["req"], slot=slot, seq=seq,
-                        steps=n, tokens=len(st["tokens"]))
+                        steps=n, tokens=len(st["tokens"]),
+                        spt=(round(self._step_ema, 6)
+                             if self._step_ema is not None else None))
             try:
                 emits.copy_to_host_async()
             except AttributeError:  # non-jax array (test doubles)
@@ -1482,6 +1491,7 @@ class ServeLoop:
                     self._step_ema = (
                         per if self._step_ema is None
                         else 0.7 * self._step_ema + 0.3 * per)
+                    self._obs_spt.set(self._step_ema)
                 self._obs_steps_per_dispatch.set(n_tok)
                 if stats is not None:
                     rounds = int(stats[1])

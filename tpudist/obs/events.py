@@ -55,11 +55,13 @@ __all__ = [
     "EventPublisher",
     "RequestEventLog",
     "SLOTracker",
+    "SLO_CLASSES",
     "TraceContext",
     "collect_events",
     "group_timelines",
     "is_complete",
     "merge_events",
+    "slo_class",
     "timeline_for_rid",
 ]
 
@@ -328,17 +330,35 @@ def is_complete(timeline: list[dict] | None) -> bool:
     return n_dispatch >= n_again + 1
 
 
-class SLOTracker:
-    """Multi-window good/bad request counts and burn rates.
+SLO_CLASSES = ("best_effort", "priority")
 
-    ``observe(reason)`` classifies one completion (``stop``/``length``
-    are good; shed/timeout/failed/rejected/invalid burn budget), prunes
+
+def slo_class(priority: int | None) -> str:
+    """The SLO accounting class for a request priority: paying traffic
+    (``priority > 0``) and best-effort get separate error budgets."""
+    return "priority" if priority is not None and priority > 0 \
+        else "best_effort"
+
+
+class SLOTracker:
+    """Multi-window good/bad request counts and burn rates, split by
+    priority class.
+
+    ``observe(reason, priority=...)`` classifies one completion
+    (``stop``/``length`` are good; shed/timeout/failed/rejected/invalid
+    burn budget) under its class (:func:`slo_class`), prunes
     observations older than the longest window, and refreshes the
     per-window gauges:
 
-    * ``slo/good`` / ``slo/bad`` — lifetime counters;
+    * ``slo/good`` / ``slo/bad`` — lifetime counters (all classes);
+    * ``slo/good~class={cls}`` / ``slo/bad~class={cls}`` — per-class
+      lifetime counters (the ``~class=`` suffix renders as a
+      ``{class="..."}`` label in the Prometheus exporter);
     * ``slo/burn_rate_{W}s`` — per window W, the bad fraction over the
-      last W seconds divided by the error budget ``1 - target``.
+      last W seconds divided by the error budget ``1 - target``;
+    * ``slo/burn_rate_{W}s~class={cls}`` — the same, per class, so
+      best-effort sheds under degradation cannot mask (or masquerade
+      as) priority-traffic budget burn.
 
     Registering the gauges on a :class:`~tpudist.obs.registry
     .MetricRegistry` makes the rates ride every existing export path
@@ -356,10 +376,12 @@ class SLOTracker:
         self._budget = 1.0 - self.target
         self._clock = clock
         self._lock = threading.Lock()
-        self._obs: deque[tuple[float, bool]] = deque()
+        self._obs: deque[tuple[float, bool, str]] = deque()
         self._registry = registry
         self._good = self._bad = None
+        self._cls_counters: dict[tuple[str, bool], Any] = {}
         self._gauges: dict[float, Any] = {}
+        self._cls_gauges: dict[tuple[float, str], Any] = {}
         if registry is not None:
             self._good = registry.counter(
                 "slo/good", unit="reqs",
@@ -368,45 +390,72 @@ class SLOTracker:
                 "slo/bad", unit="reqs",
                 help="Requests that burned error budget "
                      "(shed/timeout/failed/rejected/invalid)")
+            for cls in SLO_CLASSES:
+                self._cls_counters[(cls, True)] = registry.counter(
+                    f"slo/good~class={cls}", unit="reqs",
+                    help=f"In-SLO completions of {cls} traffic")
+                self._cls_counters[(cls, False)] = registry.counter(
+                    f"slo/bad~class={cls}", unit="reqs",
+                    help=f"Budget-burning completions of {cls} traffic")
             for w in self.windows:
                 self._gauges[w] = registry.gauge(
                     f"slo/burn_rate_{int(w)}s", unit="ratio",
                     help=f"Error-budget burn rate over the last {int(w)}s "
                          f"(bad fraction / {self._budget:.3g} budget)")
+                for cls in SLO_CLASSES:
+                    self._cls_gauges[(w, cls)] = registry.gauge(
+                        f"slo/burn_rate_{int(w)}s~class={cls}",
+                        unit="ratio",
+                        help=f"{cls} error-budget burn rate over the "
+                             f"last {int(w)}s")
 
     def observe(self, reason: str | None = None, *,
-                good: bool | None = None) -> None:
+                good: bool | None = None, priority: int = 0) -> None:
         """Record one completed request (by Completion ``reason``, or
-        an explicit ``good=`` override) and refresh the gauges."""
+        an explicit ``good=`` override) under its priority class and
+        refresh the gauges."""
         if good is None:
             good = reason in GOOD_REASONS
+        cls = slo_class(priority)
         now = self._clock()
         with self._lock:
-            self._obs.append((now, bool(good)))
+            self._obs.append((now, bool(good), cls))
             horizon = now - self.windows[-1]
             while self._obs and self._obs[0][0] < horizon:
                 self._obs.popleft()
         if self._good is not None:
             (self._good if good else self._bad).inc()
+            self._cls_counters[(cls, bool(good))].inc()
         for w, rate in self.burn_rates().items():
             g = self._gauges.get(w)
             if g is not None:
                 g.set(rate)
+        for c in SLO_CLASSES:
+            for w, rate in self.burn_rates(cls=c).items():
+                g = self._cls_gauges.get((w, c))
+                if g is not None:
+                    g.set(rate)
 
-    def counts(self, window_s: float) -> tuple[int, int]:
-        """(good, bad) over the trailing ``window_s`` seconds."""
+    def counts(self, window_s: float,
+               cls: str | None = None) -> tuple[int, int]:
+        """(good, bad) over the trailing ``window_s`` seconds — all
+        classes, or one class with ``cls=``."""
         cutoff = self._clock() - window_s
         with self._lock:
-            good = sum(1 for t, g in self._obs if t >= cutoff and g)
-            bad = sum(1 for t, g in self._obs if t >= cutoff and not g)
+            good = sum(1 for t, g, c in self._obs
+                       if t >= cutoff and g and (cls is None or c == cls))
+            bad = sum(1 for t, g, c in self._obs
+                      if t >= cutoff and not g
+                      and (cls is None or c == cls))
         return good, bad
 
-    def burn_rates(self) -> dict[float, float]:
+    def burn_rates(self, cls: str | None = None) -> dict[float, float]:
         """{window_s: burn rate} — 0.0 for a window with no traffic
-        (no evidence is not a breach)."""
+        (no evidence is not a breach).  ``cls`` narrows to one
+        priority class; default is the aggregate."""
         out: dict[float, float] = {}
         for w in self.windows:
-            good, bad = self.counts(w)
+            good, bad = self.counts(w, cls=cls)
             total = good + bad
             out[w] = (bad / total) / self._budget if total else 0.0
         return out

@@ -65,6 +65,22 @@ def _prom_name(name: str) -> str:
     return "_" + out if out[:1].isdigit() else out
 
 
+def _split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """Registry names may carry labels as ``~key=value`` suffixes
+    (e.g. ``slo/good~class=priority`` from the per-class SLO split);
+    the flat registry stays label-free while Prometheus consumers get
+    real label sets.  Returns (base name, {label: value})."""
+    base, *parts = name.split("~")
+    labels: dict[str, str] = {}
+    for p in parts:
+        k, _, v = p.partition("=")
+        if k and v:
+            labels[k] = v
+        else:
+            base += "~" + p   # not a label suffix; keep it in the name
+    return base, labels
+
+
 def _prom_num(v) -> str:
     if v is None:
         return "NaN"
@@ -83,8 +99,11 @@ def to_prometheus(snapshot: dict) -> str:
     ``+Inf``, ``_sum`` and ``_count``.  Merged cluster snapshots keep
     their per-host attribution: each counter/gauge additionally emits one
     ``{name}{{worker="r"}}`` sample per rank from its ``per_worker``
-    map."""
+    map.  Registry names carrying ``~key=value`` suffixes (the per-class
+    SLO series, e.g. ``slo/bad~class=priority``) render as one base
+    metric with a real label set (``slo_bad{class="priority"}``)."""
     out: list[str] = []
+    typed: set[str] = set()
 
     def help_line(pname: str, m: dict) -> None:
         h = m.get("help")
@@ -93,22 +112,38 @@ def to_prometheus(snapshot: dict) -> str:
             h = h.replace("\\", "\\\\").replace("\n", "\\n")
             out.append(f"# HELP {pname} {h}")
 
-    def scalar_lines(pname: str, m: dict) -> None:
-        out.append(f"{pname} {_prom_num(m['value'])}")
+    def label_str(labels: dict[str, str]) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return "{" + inner + "}"
+
+    def scalar_lines(pname: str, labels: dict, m: dict) -> None:
+        out.append(f"{pname}{label_str(labels)} {_prom_num(m['value'])}")
         for rank in sorted(m.get("per_worker", {}), key=int):
-            out.append(f'{pname}{{worker="{rank}"}} '
-                       f"{_prom_num(m['per_worker'][rank])}")
+            out.append(
+                f"{pname}{label_str({**labels, 'worker': rank})} "
+                f"{_prom_num(m['per_worker'][rank])}")
+
+    def type_line(pname: str, kind: str, m: dict) -> None:
+        # one HELP/TYPE per base name even when several labeled series
+        # share it (the exposition format forbids duplicates)
+        if pname in typed:
+            return
+        typed.add(pname)
+        help_line(pname, m)
+        out.append(f"# TYPE {pname} {kind}")
 
     for name, m in snapshot.get("counters", {}).items():
-        pname = _prom_name(name)
-        help_line(pname, m)
-        out.append(f"# TYPE {pname} counter")
-        scalar_lines(pname, m)
+        base, labels = _split_labels(name)
+        pname = _prom_name(base)
+        type_line(pname, "counter", m)
+        scalar_lines(pname, labels, m)
     for name, m in snapshot.get("gauges", {}).items():
-        pname = _prom_name(name)
-        help_line(pname, m)
-        out.append(f"# TYPE {pname} gauge")
-        scalar_lines(pname, m)
+        base, labels = _split_labels(name)
+        pname = _prom_name(base)
+        type_line(pname, "gauge", m)
+        scalar_lines(pname, labels, m)
     for name, h in snapshot.get("histograms", {}).items():
         pname = _prom_name(name)
         help_line(pname, h)

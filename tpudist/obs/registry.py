@@ -39,6 +39,7 @@ __all__ = [
     "MetricRegistry",
     "hist_quantile",
     "summarize",
+    "values_to_hist",
 ]
 
 _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
@@ -277,6 +278,36 @@ def hist_quantile(hist: dict, q: float) -> float:
         if k <= cum:
             return float(hist["growth"] ** idx)
     return float(hist["max"]) if hist["max"] is not None else float("nan")
+
+
+def values_to_hist(values, *, growth: float = 2.0,
+                   unit: str = "") -> dict:
+    """A histogram SNAPSHOT dict built directly from host values — the
+    same wire shape :class:`Histogram` produces, without a registry.
+    The offline fleet simulator's fake replicas publish these so the
+    REAL router/autoscaler percentile code reads simulated queue waits
+    through the same format live ``MetricsPublisher`` snapshots use."""
+    if growth <= 1.0:
+        raise ValueError(f"histogram growth must be > 1, got {growth}")
+    vals = [float(v) for v in values]
+    buckets: dict[int, int] = {}
+    zero = 0
+    for v in vals:
+        if v <= 0.0:
+            zero += 1
+        else:
+            idx = int(math.floor(math.log(v) / math.log(growth) + 1e-9))
+            buckets[idx] = buckets.get(idx, 0) + 1
+    return {
+        "unit": unit,
+        "growth": growth,
+        "count": len(vals),
+        "sum": float(sum(vals)),
+        "min": min(vals) if vals else None,
+        "max": max(vals) if vals else None,
+        "zero": zero,
+        "buckets": {str(i): c for i, c in sorted(buckets.items())},
+    }
 
 
 def summarize(hist: dict) -> dict:

@@ -22,6 +22,7 @@ Usage::
     python -m tpudist.obs.timeline events.json --trace ID     # one trace
     python -m tpudist.obs.timeline events.json --rid q3       # by caller rid
     python -m tpudist.obs.timeline events.json --chrome t.json
+    python -m tpudist.obs.timeline events.json --summary   # percentiles
     python -m tpudist.obs.timeline events.json --require-complete
 
 ``--require-complete`` exits 1 unless every resolved trace passes
@@ -43,7 +44,8 @@ from tpudist.obs.events import (
 )
 from tpudist.obs.spans import atomic_write_json
 
-__all__ = ["load_events", "render_timeline", "to_chrome", "main"]
+__all__ = ["load_events", "render_timeline", "summarize_timelines",
+           "render_summary", "to_chrome", "main"]
 
 
 def load_events(path: str) -> list[dict]:
@@ -84,6 +86,102 @@ def render_timeline(trace_id: str, timeline: list[dict]) -> list[str]:
         lines.append(f"  +{ev.get('t', t0) - t0:9.4f}s "
                      f"{ev.get('src', '?'):>8} {ev.get('kind', '?'):<14}"
                      f" {detail}".rstrip())
+    return lines
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolated percentile of an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    pos = (len(sorted_vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def summarize_timelines(timelines: dict) -> dict:
+    """Per-stage latency samples across every trace: where requests
+    actually spend their time, fleet-wide.
+
+    Stages (seconds):
+
+    * ``enqueue_to_admit`` — router submit to the replica slot admit
+      (queueing + dispatch + inbox transit: the congestion signal);
+    * ``admit_to_first_token`` — admit to the first ``segment`` drain
+      (prefill + first decode segment: the TTFT proxy);
+    * ``inter_token`` — per-token pace inside decode: each consecutive
+      segment gap divided by the later segment's ``steps``;
+    * ``enqueue_to_terminal`` — the whole request, submit to its
+      terminal router event.
+
+    Plus ``redispatches`` — ``{count: requests}`` over redispatch
+    events per trace (the death-recovery tail: anything over 0 means a
+    request outlived a replica).
+    """
+    stages: dict[str, list[float]] = {
+        "enqueue_to_admit": [], "admit_to_first_token": [],
+        "inter_token": [], "enqueue_to_terminal": []}
+    redispatches: dict[int, int] = {}
+    n_traces = 0
+    for tid, tl in timelines.items():
+        if tid is None:
+            continue
+        n_traces += 1
+        t_enq = t_admit = None
+        segs: list[dict] = []
+        n_re = 0
+        t_term = None
+        for ev in tl:
+            kind, t = ev.get("kind"), ev.get("t")
+            if kind == "enqueue" and t_enq is None:
+                t_enq = t
+            elif kind == "admit" and t_admit is None:
+                t_admit = t
+            elif kind == "segment":
+                segs.append(ev)
+            elif kind == "redispatch":
+                n_re += 1
+            elif kind in ("done", "shed", "timeout", "failed"):
+                t_term = t
+        redispatches[n_re] = redispatches.get(n_re, 0) + 1
+        if t_enq is not None and t_admit is not None:
+            stages["enqueue_to_admit"].append(t_admit - t_enq)
+        if t_admit is not None and segs:
+            stages["admit_to_first_token"].append(
+                segs[0]["t"] - t_admit)
+        for a, b in zip(segs, segs[1:]):
+            steps = int(b.get("steps") or 1)
+            if steps > 0 and b["t"] >= a["t"]:
+                stages["inter_token"].append((b["t"] - a["t"]) / steps)
+        if t_enq is not None and t_term is not None:
+            stages["enqueue_to_terminal"].append(t_term - t_enq)
+    out: dict = {"traces": n_traces, "redispatches": dict(sorted(
+        redispatches.items()))}
+    for stage, vals in stages.items():
+        vals.sort()
+        out[stage] = {
+            "n": len(vals),
+            "p50": _pct(vals, 0.50), "p90": _pct(vals, 0.90),
+            "p99": _pct(vals, 0.99),
+            "max": vals[-1] if vals else float("nan")}
+    return out
+
+
+def render_summary(summary: dict) -> list[str]:
+    lines = [f"per-stage latency percentiles over "
+             f"{summary['traces']} traces:"]
+    for stage in ("enqueue_to_admit", "admit_to_first_token",
+                  "inter_token", "enqueue_to_terminal"):
+        s = summary[stage]
+        lines.append(
+            f"  {stage:<22} n={s['n']:<6} "
+            f"p50={s['p50']:.4f}s p90={s['p90']:.4f}s "
+            f"p99={s['p99']:.4f}s max={s['max']:.4f}s"
+            if s["n"] else f"  {stage:<22} n=0      (no samples)")
+    redis = summary["redispatches"]
+    lines.append("redispatches per request: " + (" ".join(
+        f"{k}x{v}" for k, v in redis.items()) or "(none)"))
     return lines
 
 
@@ -131,6 +229,11 @@ def main(argv=None) -> int:
                                   "carries this caller rid")
     ap.add_argument("--chrome", metavar="OUT",
                     help="also write Chrome-trace JSON (atomic)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print per-stage latency percentiles "
+                         "(enqueue->admit, admit->first-token, "
+                         "inter-token) and the redispatch histogram "
+                         "instead of per-trace timelines")
     ap.add_argument("--require-complete", action="store_true",
                     help="exit 1 unless every resolved trace is "
                          "gap-free (CI gate)")
@@ -154,11 +257,14 @@ def main(argv=None) -> int:
             return 2
         selected = {tl[0].get("trace"): tl}
 
-    for tid, timeline in sorted(selected.items(),
-                                key=lambda kv: str(kv[0])):
-        if tid is None:
-            continue   # trace-less fleet events: chrome export only
-        print("\n".join(render_timeline(tid, timeline)))
+    if args.summary:
+        print("\n".join(render_summary(summarize_timelines(selected))))
+    else:
+        for tid, timeline in sorted(selected.items(),
+                                    key=lambda kv: str(kv[0])):
+            if tid is None:
+                continue   # trace-less fleet events: chrome export only
+            print("\n".join(render_timeline(tid, timeline)))
 
     if args.chrome:
         atomic_write_json(args.chrome, to_chrome(events))

@@ -212,6 +212,13 @@ class Autoscaler:
         self._drains: set[str] = set()  # rids THIS loop marked draining
         self._breach = 0
         self._idle = 0
+        self._poll_n = 0
+        # bounded history of every poll's decision record (stamped with
+        # the poll index and clock): the replayable control-plane
+        # artifact the offline simulator (tpudist.sim) reproduces and
+        # the sim-vs-live agreement check diffs against
+        self.decision_log: list[dict] = []
+        self.decision_log_max = 4096
         self._last_up: float | None = None
         self._last_down: float | None = None
         self._thread: threading.Thread | None = None
@@ -426,12 +433,32 @@ class Autoscaler:
         self._obs_breach.set(self._breach)
         self._obs_idle.set(self._idle)
         self._obs_burn.set(view["burn_rate"])
-        return {"action": action, "wait_q": view["wait_q"],
-                "active": sorted(active), "draining": sorted(draining),
-                "pending": len(pending),
-                "queue_depth": view["queue_depth"],
-                "burn_rate": view["burn_rate"],
-                "breach": self._breach, "idle": self._idle}
+        record = {"action": action, "wait_q": view["wait_q"],
+                  "active": sorted(active), "draining": sorted(draining),
+                  "pending": len(pending),
+                  "queue_depth": view["queue_depth"],
+                  "burn_rate": view["burn_rate"],
+                  "breach": self._breach, "idle": self._idle,
+                  "poll": self._poll_n, "t": now}
+        self._poll_n += 1
+        self.decision_log.append(record)
+        if len(self.decision_log) > self.decision_log_max:
+            del self.decision_log[:-self.decision_log_max]
+        return record
+
+    def action_seq(self) -> list[dict]:
+        """The non-None decisions, in order: ``[{"poll", "t", "kind",
+        "arg"}, ...]`` — the compact sequence the simulator-vs-live
+        agreement check compares (a drain victim's rid is live-run
+        specific, so ``arg`` keeps only scale-up counts)."""
+        out = []
+        for r in self.decision_log:
+            if r["action"] is None:
+                continue
+            kind, arg = r["action"]
+            out.append({"poll": r["poll"], "t": r["t"], "kind": kind,
+                        "arg": (arg if kind == "up" else None)})
+        return out
 
     # -- background loop ---------------------------------------------------
 

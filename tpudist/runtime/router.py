@@ -537,10 +537,20 @@ class Router:
                  slo_quantile: float = 0.99,
                  join_grace_s: float = 30.0,
                  degrade_max_new: int | None = None,
-                 use_health: bool = True) -> None:
+                 use_health: bool = True,
+                 clock=time.monotonic,
+                 wall=time.time,
+                 sleeper=time.sleep) -> None:
         self.client = client
         self.ns = namespace
         self.poll_s = float(poll_s)
+        # injectable time sources: the offline fleet simulator
+        # (tpudist.sim) runs this SAME event loop against a virtual
+        # clock whose sleeper advances simulated replicas instead of
+        # blocking — production keeps the defaults
+        self._clock = clock
+        self._wall = wall
+        self._sleep = sleeper
         self.max_redispatch = int(max_redispatch)
         self.reject_backoff_s = float(reject_backoff_s)
         if not 0.0 < slo_quantile <= 1.0:
@@ -610,8 +620,10 @@ class Router:
         if c is not None:
             c.inc()
         if reason != "rejected":   # re-routes are not terminal outcomes
+            req = (e or {}).get("req")
             obs.slo.observe(reason if reason != "completed"
-                            else fields.get("serve_reason", "stop"))
+                            else fields.get("serve_reason", "stop"),
+                            priority=int(getattr(req, "priority", 0) or 0))
         trace = (e or {}).get("trace")
         if trace is not None:
             kind = {"completed": "done", "rejected": "reroute"}.get(
@@ -713,7 +725,7 @@ class Router:
     def _update_backoffs(self, loads: dict[str, dict]) -> None:
         """A replica whose ``serve/rejected`` counter grew is shedding:
         pause new admissions to it briefly instead of feeding the shed."""
-        now = time.monotonic()
+        now = self._clock()
         for rid, l in loads.items():
             seen = self._rejected_seen.get(rid, 0.0)
             if l["rejected"] > seen:
@@ -758,30 +770,35 @@ class Router:
     # -- the event loop ----------------------------------------------------
 
     def run(self, requests: Sequence[Any], *,
-            timeout_s: float = 120.0) -> list[Any]:
+            timeout_s: float = 120.0,
+            arrivals: Sequence[float] | None = None) -> list[Any]:
         """Route ``requests`` across the fleet; returns one
         :class:`~tpudist.models.serving.Completion` per request, in
         FINISH order, with each completion's ``rid`` restored to the
         caller's.  Raises :class:`TimeoutError` after ``timeout_s`` —
-        the no-hang bound for total-fleet loss."""
+        the no-hang bound for total-fleet loss.
+
+        ``arrivals`` (one offset in seconds per request, from run
+        start) replays a TIMED workload through the same submit path:
+        each request becomes visible to dispatch — and its trace is
+        minted — only once its offset elapses, so a scenario's diurnal
+        ramp or flash crowd hits the fleet with its real shape instead
+        of as one up-front batch."""
         from tpudist.models.serving import Completion
 
+        if arrivals is not None and len(arrivals) != len(requests):
+            raise ValueError(
+                f"arrivals ({len(arrivals)}) must match requests "
+                f"({len(requests)})")
         entries: dict[str, dict] = {}
         order: list[str] = []
-        for req in requests:
+        for i, req in enumerate(requests):
             key = f"{self._seq:08d}"
             self._seq += 1
-            # mint the trace context here — submit IS the trace root.
-            # It lives in the router entry (not just the request), so a
-            # redispatch re-sends the SAME context and the replica-side
-            # events of both attempts merge under one trace id.
-            tc = TraceContext.mint(key)
+            at = 0.0 if arrivals is None else max(0.0, float(arrivals[i]))
             entries[key] = {"req": req, "assigned": None, "attempts": 0,
-                            "trace": tc}
-            obs.events.record("enqueue", trace=tc.trace_id, key=key,
-                              rid=str(req.rid))
+                            "trace": None, "at": at, "arrived": False}
             order.append(key)
-        self._obs_requests.inc(len(order))
         done: dict[str, Completion] = {}
         finish: list[str] = []
 
@@ -790,18 +807,20 @@ class Router:
             finish.append(key)
             self._obs_completions.inc()
 
-        deadline = time.monotonic() + timeout_s
+        start = self._clock()
+        deadline = start + timeout_s
         while len(done) < len(entries):
-            if time.monotonic() > deadline:
+            if self._clock() > deadline:
                 raise TimeoutError(
                     f"router: {len(entries) - len(done)} of "
                     f"{len(entries)} requests unresolved after "
                     f"{timeout_s:.0f}s (live replicas: "
                     f"{sorted(self.live())})")
-            progressed = self._poll(entries, done, complete)
+            progressed = self._arrive(entries, start) > 0
+            progressed = self._poll(entries, done, complete) or progressed
             self._obs_outstanding.set(len(entries) - len(done))
             if not progressed:
-                time.sleep(self.poll_s)
+                self._sleep(self.poll_s)
         # sweep duplicate done keys (a presumed-dead replica may have
         # committed after its redispatch; greedy determinism makes the
         # duplicate identical, so it is just deleted)
@@ -813,6 +832,38 @@ class Router:
         self._obs_outstanding.set(0)
         return [done[k] for k in finish]
 
+    def _arrive(self, entries: dict[str, dict], start: float) -> int:
+        """Admit entries whose arrival offset has elapsed: mint the
+        trace context — submit IS the trace root; it lives in the
+        router entry (not just the request) so a redispatch re-sends
+        the SAME context and the replica-side events of both attempts
+        merge under one trace id — and record the enqueue event with
+        the request's replayable shape (prompt length, budget,
+        priority, relative deadline), which is what lets a recorded
+        trace be turned back into a workload."""
+        now = self._clock() - start
+        n = 0
+        for key, e in entries.items():
+            if e.get("arrived", True) or e.get("at", 0.0) > now:
+                continue
+            e["arrived"] = True
+            req = e["req"]
+            tc = TraceContext.mint(key)
+            e["trace"] = tc
+            obs.events.record(
+                "enqueue", trace=tc.trace_id, key=key,
+                rid=str(req.rid),
+                prompt_tokens=int(np.asarray(req.prompt).size),
+                max_new=int(req.max_new_tokens),
+                priority=int(getattr(req, "priority", 0) or 0),
+                rel_deadline_s=(
+                    None if req.deadline_s is None
+                    else round(req.deadline_s - self._wall(), 6)))
+            n += 1
+        if n:
+            self._obs_requests.inc(n)
+        return n
+
     def _poll(self, entries: dict[str, dict], done: dict,
               complete) -> bool:
         from tpudist.models.serving import Completion
@@ -821,7 +872,7 @@ class Router:
         regs = self.replicas()
         live = self.live() - self._dead
         self._obs_live.set(len(live))
-        now_mono = time.monotonic()
+        now_mono = self._clock()
         self._ever_live |= live
         for rid in regs:
             self._reg_seen.setdefault(rid, now_mono)
@@ -874,7 +925,7 @@ class Router:
                 e["assigned"] = None
                 self._obs_rerouted.inc()
                 self._backoff[payload.get("replica", "")] = (
-                    time.monotonic() + self.reject_backoff_s)
+                    self._clock() + self.reject_backoff_s)
                 self._decide("rejected", e,
                              replica=payload.get("replica"))
             else:
@@ -956,7 +1007,7 @@ class Router:
                     self._decide("failed", e, attempts=e["attempts"])
 
         # 3) dispatch unassigned requests least-loaded
-        now = time.monotonic()
+        now = self._clock()
         self._backoff = {r: t for r, t in self._backoff.items() if t > now}
         loads = self.loads(regs)
         self._update_backoffs(loads)
@@ -994,7 +1045,7 @@ class Router:
                 if e["assigned"] is not None:
                     assigned_counts[e["assigned"]] = (
                         assigned_counts.get(e["assigned"], 0) + 1)
-            wall = time.time()
+            wall = self._wall()
             # the SLO predictor: the best queue-wait any candidate
             # advertises at the configured percentile — if even that
             # replica would (probably) blow a request's deadline, no
@@ -1003,7 +1054,8 @@ class Router:
                 (loads.get(rid, {}).get("queue_wait_q") or 0.0
                  for rid in candidates), default=0.0)
             for k, e in entries.items():
-                if k in done or e["assigned"] is not None:
+                if (k in done or e["assigned"] is not None
+                        or not e.get("arrived", True)):
                     continue
                 req = e["req"]
                 if req.deadline_s is not None and wall > req.deadline_s:

@@ -1,0 +1,79 @@
+"""In-memory coordination fabric for the offline fleet simulator.
+
+The real fleet coordinates through :class:`tpudist.runtime.coord
+.CoordClient` verbs over a TCP KV service; the simulator swaps in this
+process-local stand-in (the ``FakeCoord`` discipline the unit tests
+already use, promoted to library code) so the REAL ``Router`` and
+``Autoscaler`` run unmodified: same key layout (``{ns}/inbox/...``,
+``{ns}/done/...``, ``{ns}/draining/...``), same wire encodings, same
+heartbeat-lease liveness — just a dict and a set instead of sockets.
+
+Leases are explicit (:meth:`up` / :meth:`down`): simulated replicas
+flip their own liveness at virtual-time boundaries instead of running
+heartbeat threads, which is exactly what makes death/drain timing
+deterministic under the virtual clock.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["SimFabric"]
+
+
+class SimFabric:
+    """Process-local CoordClient stand-in: the KV + liveness verbs the
+    router, autoscaler, and metrics planes reach for."""
+
+    def __init__(self) -> None:
+        self.kv: dict[str, bytes] = {}
+        self.live_set: set[str] = set()
+        self.counters: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- KV verbs ----------------------------------------------------------
+
+    def keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self.kv.get(key)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self.kv[key] = value
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self.kv.pop(key, None)
+
+    def add(self, key: str, delta: int) -> int:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + int(delta)
+            return self.counters[key]
+
+    # -- liveness (heartbeat leases, simulated) ----------------------------
+
+    def live(self) -> set[str]:
+        with self._lock:
+            return set(self.live_set)
+
+    def up(self, name: str) -> None:
+        """Grant a lease (a simulated replica's first heartbeat)."""
+        with self._lock:
+            self.live_set.add(name)
+
+    def down(self, name: str) -> None:
+        """Lapse a lease (clean exit or simulated death)."""
+        with self._lock:
+            self.live_set.discard(name)
+
+    # -- client lifecycle (API-compat no-ops) ------------------------------
+
+    def clone(self) -> "SimFabric":
+        return self
+
+    def close(self) -> None:
+        pass

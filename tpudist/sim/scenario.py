@@ -1,0 +1,411 @@
+"""Declarative serve-fleet scenarios and their SLO envelopes.
+
+A :class:`ScenarioSpec` is the replayable unit of "as many scenarios as
+you can imagine": one document describing a workload SHAPE (arrival
+process, prompt-length distribution, token budgets, deadline
+distribution, tenant mix), the fleet it runs against (replica count,
+service rates, autoscaler policy), and the :class:`Envelope` of SLO
+outcomes the run must land inside.  The same spec drives both
+execution paths:
+
+* the LIVE replayer (:func:`tpudist.sim.workload.synthesize` ->
+  ``Router.run(requests, arrivals=...)``) — real replicas, real chaos;
+* the OFFLINE simulator (:class:`tpudist.sim.simulator.FleetSim`) —
+  the real router/autoscaler policy code against simulated replicas,
+  seconds instead of chaos-bench minutes.
+
+Specs are plain dicts (JSON-shaped) parsed by
+:meth:`ScenarioSpec.from_dict`, which REJECTS unknown keys — a typo'd
+knob must fail parsing, not silently run the default scenario.
+``BUILTIN`` holds the named scenario matrix CI runs on every push;
+each entry's envelope is its regression gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["ScenarioSpec", "Envelope", "BUILTIN", "builtin", "names"]
+
+ARRIVAL_KINDS = ("constant", "diurnal", "flash_crowd")
+PROMPT_KINDS = ("uniform", "longtail")
+DEADLINE_KINDS = ("none", "uniform", "adversarial")
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"scenario spec: {msg}")
+
+
+def _check_keys(what: str, d: dict, allowed: set[str],
+                required: set[str] = frozenset()) -> None:
+    _require(isinstance(d, dict), f"{what} must be a dict, got {d!r}")
+    unknown = set(d) - allowed
+    _require(not unknown, f"{what} has unknown keys {sorted(unknown)} "
+                          f"(allowed: {sorted(allowed)})")
+    missing = required - set(d)
+    _require(not missing, f"{what} missing required keys "
+                          f"{sorted(missing)}")
+
+
+def _validate_arrival(a: dict) -> None:
+    _check_keys("arrival", a,
+                {"kind", "rate", "base_rate", "peak_rate", "period_s",
+                 "spike_rate", "spike_at_s", "spike_width_s"}, {"kind"})
+    kind = a["kind"]
+    _require(kind in ARRIVAL_KINDS,
+             f"arrival.kind {kind!r} not in {ARRIVAL_KINDS}")
+    if kind == "constant":
+        _require(float(a.get("rate", 0)) > 0, "constant needs rate > 0")
+    elif kind == "diurnal":
+        base = float(a.get("base_rate", 0))
+        peak = float(a.get("peak_rate", 0))
+        _require(0 < base <= peak,
+                 f"diurnal needs 0 < base_rate <= peak_rate, got "
+                 f"{base}/{peak}")
+        _require(float(a.get("period_s", 0)) > 0,
+                 "diurnal needs period_s > 0")
+    elif kind == "flash_crowd":
+        _require(float(a.get("base_rate", 0)) > 0,
+                 "flash_crowd needs base_rate > 0")
+        _require(float(a.get("spike_rate", 0))
+                 > float(a.get("base_rate", 0)),
+                 "flash_crowd needs spike_rate > base_rate")
+        _require(float(a.get("spike_width_s", 0)) > 0,
+                 "flash_crowd needs spike_width_s > 0")
+
+
+def _validate_prompt(p: dict) -> None:
+    _check_keys("prompt", p,
+                {"kind", "lo", "hi", "typical", "tail", "tail_frac"},
+                {"kind"})
+    kind = p["kind"]
+    _require(kind in PROMPT_KINDS,
+             f"prompt.kind {kind!r} not in {PROMPT_KINDS}")
+    if kind == "uniform":
+        lo, hi = int(p.get("lo", 0)), int(p.get("hi", 0))
+        _require(0 < lo <= hi, f"prompt needs 0 < lo <= hi, got {lo}/{hi}")
+    else:
+        lo = int(p.get("lo", 0))
+        typ = int(p.get("typical", 0))
+        tail = int(p.get("tail", 0))
+        frac = float(p.get("tail_frac", 0.05))
+        _require(0 < lo <= typ < tail,
+                 f"longtail needs 0 < lo <= typical < tail, got "
+                 f"{lo}/{typ}/{tail}")
+        _require(0.0 < frac < 1.0,
+                 f"longtail tail_frac must be in (0, 1), got {frac}")
+
+
+def _validate_deadline(d: dict) -> None:
+    _check_keys("deadline", d,
+                {"kind", "lo", "hi", "tight_frac", "tight_s", "loose_s"},
+                {"kind"})
+    kind = d["kind"]
+    _require(kind in DEADLINE_KINDS,
+             f"deadline.kind {kind!r} not in {DEADLINE_KINDS}")
+    if kind == "uniform":
+        lo, hi = float(d.get("lo", 0)), float(d.get("hi", 0))
+        _require(0 < lo <= hi,
+                 f"deadline needs 0 < lo <= hi, got {lo}/{hi}")
+    elif kind == "adversarial":
+        _require(0.0 < float(d.get("tight_frac", 0)) < 1.0,
+                 "adversarial needs tight_frac in (0, 1)")
+        _require(0 < float(d.get("tight_s", 0))
+                 < float(d.get("loose_s", 0)),
+                 "adversarial needs 0 < tight_s < loose_s")
+
+
+def _validate_tenant(t: dict) -> None:
+    _check_keys("tenant", t,
+                {"name", "weight", "prefix_tokens", "priority"},
+                {"name", "weight"})
+    _require(float(t["weight"]) > 0,
+             f"tenant {t.get('name')!r} needs weight > 0")
+    _require(int(t.get("prefix_tokens", 0)) >= 0,
+             "tenant prefix_tokens must be >= 0")
+
+
+_FLEET_DEFAULTS: dict[str, Any] = {
+    "replicas": 1,
+    "seconds_per_token": 0.002,
+    "prefill_s": 0.005,
+    "prefill_per_token_s": 0.0002,
+    "warmup_s": 2.0,
+    "publish_interval_s": 0.25,
+    "wait_window_s": 15.0,
+    "router_poll_s": 0.05,
+    "autoscale": None,          # dict of AutoscaleConfig overrides
+}
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """The per-scenario SLO gate, asserted against the summary row a
+    run emits (live bench or offline simulator — same schema).
+
+    ``None`` bounds are unchecked.  ``decisions`` bounds the router's
+    terminal decision counters: ``{"shed": {"min": 1, "max": 10}}``."""
+
+    max_lost: int = 0
+    max_p99_queue_wait_s: float | None = None
+    max_recovery_s: float | None = None
+    min_scale_ups: int = 0
+    max_scale_ups: int | None = None
+    min_drains: int = 0
+    max_priority_bad: int | None = None
+    decisions: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Envelope":
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        _check_keys("envelope", d, allowed)
+        dec = d.get("decisions", {})
+        for reason, bound in dec.items():
+            _check_keys(f"envelope.decisions[{reason!r}]", bound,
+                        {"min", "max"})
+        return cls(**d)
+
+    def check(self, row: dict) -> list[str]:
+        """Violations of this envelope in a scenario summary ``row``
+        (empty list = the run landed inside the envelope)."""
+        bad: list[str] = []
+
+        def num(key, default=0.0):
+            v = row.get(key)
+            return default if v is None else float(v)
+
+        lost = num("lost_requests")
+        if lost > self.max_lost:
+            bad.append(f"lost_requests={lost:g} > max_lost={self.max_lost}")
+        if self.max_p99_queue_wait_s is not None:
+            p99 = num("p99_queue_wait_s")
+            if p99 > self.max_p99_queue_wait_s:
+                bad.append(f"p99_queue_wait_s={p99:.4g} > "
+                           f"{self.max_p99_queue_wait_s}")
+        if self.max_recovery_s is not None:
+            rec = num("recovery_s")
+            if rec > self.max_recovery_s:
+                bad.append(f"recovery_s={rec:.4g} > {self.max_recovery_s}")
+        ups = num("scale_ups")
+        if ups < self.min_scale_ups:
+            bad.append(f"scale_ups={ups:g} < min {self.min_scale_ups}")
+        if self.max_scale_ups is not None and ups > self.max_scale_ups:
+            bad.append(f"scale_ups={ups:g} > max {self.max_scale_ups}")
+        if num("drains") < self.min_drains:
+            bad.append(f"drains={num('drains'):g} < min {self.min_drains}")
+        if self.max_priority_bad is not None:
+            pb = num("priority_bad")
+            if pb > self.max_priority_bad:
+                bad.append(f"priority_bad={pb:g} > "
+                           f"{self.max_priority_bad}")
+        for reason, bound in self.decisions.items():
+            v = num(f"decisions_{reason}")
+            lo, hi = bound.get("min"), bound.get("max")
+            if lo is not None and v < lo:
+                bad.append(f"decisions_{reason}={v:g} < min {lo}")
+            if hi is not None and v > hi:
+                bad.append(f"decisions_{reason}={v:g} > max {hi}")
+        return bad
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    """One named, seeded, replayable scenario (see module docstring)."""
+
+    name: str
+    duration_s: float
+    arrival: dict
+    prompt: dict = field(
+        default_factory=lambda: {"kind": "uniform", "lo": 4, "hi": 12})
+    max_new: dict = field(
+        default_factory=lambda: {"kind": "uniform", "lo": 8, "hi": 24})
+    deadline: dict = field(default_factory=lambda: {"kind": "none"})
+    tenants: tuple = ()
+    seed: int = 0
+    fleet: dict = field(default_factory=dict)
+    envelope: Envelope = field(default_factory=Envelope)
+
+    def __post_init__(self):
+        _require(bool(self.name), "name must be non-empty")
+        _require(self.duration_s > 0,
+                 f"duration_s must be > 0, got {self.duration_s}")
+        _validate_arrival(self.arrival)
+        _validate_prompt(self.prompt)
+        _check_keys("max_new", self.max_new, {"kind", "lo", "hi", "value"},
+                    {"kind"})
+        _require(self.max_new["kind"] in ("uniform", "const"),
+                 f"max_new.kind {self.max_new['kind']!r} not in "
+                 f"('uniform', 'const')")
+        if self.max_new["kind"] == "uniform":
+            lo = int(self.max_new.get("lo", 0))
+            hi = int(self.max_new.get("hi", 0))
+            _require(0 < lo <= hi,
+                     f"max_new needs 0 < lo <= hi, got {lo}/{hi}")
+        else:
+            _require(int(self.max_new.get("value", 0)) > 0,
+                     "max_new const needs value > 0")
+        _validate_deadline(self.deadline)
+        for t in self.tenants:
+            _validate_tenant(t)
+        _check_keys("fleet", self.fleet, set(_FLEET_DEFAULTS))
+        merged = {**_FLEET_DEFAULTS, **self.fleet}
+        _require(int(merged["replicas"]) >= 1, "fleet.replicas must be >= 1")
+        _require(float(merged["seconds_per_token"]) > 0,
+                 "fleet.seconds_per_token must be > 0")
+        # frozen dataclass: route the normalized fleet through __setattr__
+        object.__setattr__(self, "fleet", merged)
+        object.__setattr__(self, "tenants", tuple(self.tenants))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScenarioSpec":
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        _check_keys(f"scenario {d.get('name', '?')!r}", d, allowed,
+                    {"name", "duration_s", "arrival"})
+        kw = dict(d)
+        env = kw.pop("envelope", None)
+        if env is not None and not isinstance(env, Envelope):
+            env = Envelope.from_dict(env)
+        return cls(**kw, **({"envelope": env} if env is not None else {}))
+
+    @classmethod
+    def from_json(cls, path: str) -> "ScenarioSpec":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["tenants"] = list(self.tenants)
+        return d
+
+
+# -- the named scenario matrix (the CI regression suite) --------------------
+#
+# Each entry stresses a different policy surface; each envelope is the
+# regression gate a future serving PR must keep green.  Rates are sized
+# for the offline simulator's default service rate (2 ms/token, ~16
+# token budgets => one replica saturates around 20-25 req/s).
+
+_AUTOSCALE_FAST = {
+    # scale-up after 3 sustained breach polls at 0.5 s cadence; drain
+    # back after a long idle window — sim-seconds, not wall-seconds
+    "min_replicas": 1, "max_replicas": 4, "target_wait_s": 0.5,
+    "low_wait_s": 0.1, "quantile": 0.9, "breach_polls": 3,
+    "idle_polls": 10, "up_cooldown_s": 8.0, "down_cooldown_s": 20.0,
+    "poll_s": 0.5, "max_metric_age_s": 10.0,
+}
+
+BUILTIN: dict[str, dict] = {
+    "steady_state": {
+        "name": "steady_state",
+        "duration_s": 30.0,
+        "arrival": {"kind": "constant", "rate": 8.0},
+        "seed": 11,
+        "fleet": {"replicas": 1, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            "max_p99_queue_wait_s": 0.5,
+            "max_scale_ups": 0,      # steady load must not flap the fleet
+            "decisions": {"completed": {"min": 150}},
+        },
+    },
+    "diurnal_ramp": {
+        "name": "diurnal_ramp",
+        "duration_s": 90.0,
+        "arrival": {"kind": "diurnal", "base_rate": 3.0,
+                    "peak_rate": 40.0, "period_s": 60.0},
+        "seed": 12,
+        "fleet": {"replicas": 1, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            "min_scale_ups": 1,      # the ramp must buy capacity
+            "min_drains": 1,         # ... and the trough must return it
+            "max_recovery_s": 90.0,
+            "decisions": {"failed": {"max": 0}},
+        },
+    },
+    "flash_crowd": {
+        "name": "flash_crowd",
+        "duration_s": 60.0,
+        "arrival": {"kind": "flash_crowd", "base_rate": 4.0,
+                    "spike_rate": 120.0, "spike_at_s": 10.0,
+                    "spike_width_s": 4.0},
+        "seed": 13,
+        "fleet": {"replicas": 1, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            "min_scale_ups": 1,
+            "max_recovery_s": 60.0,  # breach episode must end
+            "decisions": {"failed": {"max": 0}},
+        },
+    },
+    "shared_prefix_tenants": {
+        "name": "shared_prefix_tenants",
+        "duration_s": 30.0,
+        "arrival": {"kind": "constant", "rate": 10.0},
+        "tenants": [
+            {"name": "sysA", "weight": 5.0, "prefix_tokens": 24,
+             "priority": 0},
+            {"name": "sysB", "weight": 3.0, "prefix_tokens": 48,
+             "priority": 0},
+            {"name": "paid", "weight": 2.0, "prefix_tokens": 12,
+             "priority": 1},
+        ],
+        "seed": 14,
+        "fleet": {"replicas": 2, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            "max_p99_queue_wait_s": 0.5,
+            "max_priority_bad": 0,   # paid traffic burns zero budget
+            "decisions": {"completed": {"min": 200}},
+        },
+    },
+    "long_tail_prompts": {
+        "name": "long_tail_prompts",
+        "duration_s": 40.0,
+        "arrival": {"kind": "constant", "rate": 8.0},
+        "prompt": {"kind": "longtail", "lo": 4, "typical": 16,
+                   "tail": 512, "tail_frac": 0.06},
+        "seed": 15,
+        "fleet": {"replicas": 1, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            "max_p99_queue_wait_s": 2.0,  # tail prompts queue behind
+            "decisions": {"failed": {"max": 0}},
+        },
+    },
+    "deadline_storm": {
+        "name": "deadline_storm",
+        "duration_s": 40.0,
+        "arrival": {"kind": "flash_crowd", "base_rate": 6.0,
+                    "spike_rate": 80.0, "spike_at_s": 8.0,
+                    "spike_width_s": 4.0},
+        "deadline": {"kind": "adversarial", "tight_frac": 0.3,
+                     "tight_s": 0.08, "loose_s": 30.0},
+        "seed": 16,
+        "fleet": {"replicas": 1, "autoscale": dict(_AUTOSCALE_FAST)},
+        "envelope": {
+            "max_lost": 0,
+            # tight deadlines under the spike MUST be shed/timed out at
+            # admission (not served late, not failed): the SLO gate's
+            # reason-to-decide regression
+            "decisions": {"failed": {"max": 0},
+                          "completed": {"min": 150}},
+        },
+    },
+}
+
+
+def names() -> list[str]:
+    return sorted(BUILTIN)
+
+
+def builtin(name: str) -> ScenarioSpec:
+    """The named builtin scenario, parsed and validated."""
+    if name not in BUILTIN:
+        raise KeyError(
+            f"unknown scenario {name!r} (have: {', '.join(names())})")
+    return ScenarioSpec.from_dict(BUILTIN[name])

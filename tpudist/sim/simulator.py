@@ -1,0 +1,440 @@
+"""The offline fleet simulator: real control-plane code, virtual time.
+
+The point is NOT a queueing model of the fleet — it is the fleet's
+actual decision code on a synthetic data plane.  :class:`FleetSim` runs
+the real :class:`tpudist.runtime.router.Router` event loop and the real
+:class:`tpudist.runtime.autoscaler.Autoscaler` policy, unmodified,
+against:
+
+* a :class:`VirtualClock` injected as the router's ``clock``/``wall``/
+  ``sleeper`` and the autoscaler's ``clock`` — every sleep ADVANCES
+  simulated time instead of burning wall time, so a 90-second diurnal
+  scenario replays in well under a second;
+* a :class:`~tpudist.sim.fabric.SimFabric` in place of the coord TCP
+  service — same key layout, same wire encodings;
+* :class:`SimReplica` data planes in place of real ``ServeLoop``
+  processes: each consumes its inbox through the REAL request decoder,
+  serves FIFO at a configured seconds-per-token rate (recorded
+  ``serve/seconds_per_token`` EMAs when replaying a trace), commits
+  completions through the real done-key protocol, and publishes
+  ``MetricsPublisher``-shaped snapshots — windowed queue-wait
+  histograms included — so the router's SLO admission and the
+  autoscaler's target tracking read exactly the signals they read in
+  production.
+
+Because the policy code is shared, a simulated run emits the same
+decision counters, the same autoscaler ``decision_log``, and a summary
+row in the same bench-JSONL schema as a live run — which is what makes
+scenario envelopes (:class:`tpudist.sim.scenario.Envelope`) meaningful
+as CI gates, and what the sim-vs-live agreement check in ``bench.py``
+leans on.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tpudist import obs
+from tpudist.obs.registry import values_to_hist
+from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
+from tpudist.runtime.router import Router, _decode_request
+from tpudist.sim.fabric import SimFabric
+from tpudist.sim.scenario import Envelope, ScenarioSpec
+from tpudist.sim.workload import (
+    Workload,
+    service_rates_from_trace,
+    synthesize,
+    workload_from_trace,
+)
+
+__all__ = ["VirtualClock", "SimReplica", "FleetSim"]
+
+# simulated epoch: virtual wall time starts here (any fixed base works —
+# deadlines are relative arithmetic — but a realistic epoch keeps
+# recorded docs plausible to tooling that renders wall stamps)
+_WALL_BASE = 1_750_000_000.0
+
+
+class VirtualClock:
+    """Simulated time: a monotonic origin at 0 and a wall clock at a
+    fixed epoch, both advanced EXPLICITLY by the simulation loop.
+    Injected wherever production code takes ``clock=``/``wall=``."""
+
+    def __init__(self, wall_base: float = _WALL_BASE) -> None:
+        self._now = 0.0
+        self._wall_base = float(wall_base)
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def wall(self) -> float:
+        return self._wall_base + self._now
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"time only moves forward, got dt={dt}")
+        self._now += dt
+
+
+class SimReplica:
+    """One simulated serve replica: the data-plane contract of a
+    ``ReplicaWorker`` (inbox -> FIFO service -> done key; registration,
+    lease, metrics snapshots; graceful-drain close path) at a scalar
+    service rate instead of a model.
+
+    Doubles as its own "process" for the autoscaler's spawner contract:
+    ``poll()`` is ``None`` while running, ``0`` after departure, and
+    ``replica_index`` matches the ``r{rank}`` id the pending-joiner
+    check looks for."""
+
+    def __init__(self, fabric: SimFabric, clock: VirtualClock, *,
+                 rank: int, namespace: str,
+                 seconds_per_token: float = 0.002,
+                 prefill_s: float = 0.005,
+                 prefill_per_token_s: float = 0.0002,
+                 warmup_s: float = 0.0,
+                 publish_interval_s: float = 0.25,
+                 wait_window_s: float = 15.0) -> None:
+        self.fabric = fabric
+        self.clock = clock
+        self.rank = int(rank)
+        self.replica_index = int(rank)   # the spawner/joiner contract
+        self.rid = f"r{rank}"
+        self.ns = namespace
+        self.spt = float(seconds_per_token)
+        self.prefill_s = float(prefill_s)
+        self.prefill_per_token_s = float(prefill_per_token_s)
+        self.publish_interval_s = float(publish_interval_s)
+        self.wait_window_s = float(wait_window_s)
+        self.alive = True
+        self.served = 0
+        self.all_waits: list[float] = []          # every queue wait (sim s)
+        self._live = False
+        self._live_at = clock.monotonic() + max(0.0, float(warmup_s))
+        self._stopping = False
+        self._queue: list[tuple] = []             # (req, enqueued_at)
+        self._cur: tuple | None = None            # (req, finish_at)
+        self._waits: list[tuple[float, float]] = []   # (t, wait) window
+        self._next_pub = self._live_at
+        # registration precedes the first heartbeat, exactly like a real
+        # joiner mid-warmup (the router's join grace covers this window)
+        import json
+        fabric.set(f"{namespace}/replica/{self.rid}",
+                   json.dumps({"replica_id": self.rid,
+                               "rank": self.rank}).encode())
+
+    # -- the spawner/process contract --------------------------------------
+
+    def poll(self):
+        return None if self.alive else 0
+
+    # -- service model -----------------------------------------------------
+
+    def _service_s(self, req) -> float:
+        prompt = int(np.asarray(req.prompt).size)
+        return (self.prefill_s + prompt * self.prefill_per_token_s
+                + int(req.max_new_tokens) * self.spt)
+
+    def _commit(self, req, reason: str, tokens: list[int]) -> None:
+        import json
+        self.fabric.set(
+            f"{self.ns}/done/{req.rid}",
+            json.dumps({"key": str(req.rid), "tokens": tokens,
+                        "reason": reason, "replica": self.rid}).encode())
+        self.served += 1
+        if req.trace is not None:
+            obs.events.record("done_commit", trace=req.trace.trace_id,
+                              replica=self.rid, reason=reason,
+                              tokens=len(tokens))
+
+    def _publish(self) -> None:
+        import json
+        now = self.clock.monotonic()
+        horizon = now - self.wait_window_s
+        self._waits = [(t, w) for t, w in self._waits if t >= horizon]
+        snap = {
+            "rank": self.rank,
+            # REAL wall stamp: collect()'s staleness cutoff measures
+            # real seconds since publish, and the whole sim runs in
+            # well under one — virtual stamps would look hours stale
+            "published_at": time.time(),
+            "gauges": {
+                "serve/queue_depth": {"value": float(len(self._queue))},
+                "serve/seconds_per_token": {"value": self.spt},
+            },
+            "counters": {},
+            "histograms": {},
+        }
+        if self._waits:
+            snap["histograms"]["serve/queue_wait_s"] = values_to_hist(
+                [w for _, w in self._waits], unit="s")
+        self.fabric.set(f"{self.ns}/metrics/{self.rank}",
+                        json.dumps(snap).encode())
+        self._next_pub = now + self.publish_interval_s
+
+    def step(self) -> None:
+        """Advance the replica to the clock's current instant: go live
+        after warmup, consume the inbox, finish/start service, publish
+        metrics, and run the graceful close path once stopped."""
+        if not self.alive:
+            return
+        now = self.clock.monotonic()
+        if now < self._live_at:
+            return
+        if not self._live:
+            self._live = True
+            self.fabric.up(f"{self.ns}:{self.rid}")
+            self._publish()
+
+        if (self.fabric.get(f"{self.ns}/stop") is not None
+                or self.fabric.get(f"{self.ns}/stop/{self.rid}")
+                is not None):
+            self._stopping = True
+
+        # consume the inbox through the real decoder (also the final
+        # sweep while stopping: zero-loss drain means nothing accepted
+        # is ever abandoned)
+        inbox = f"{self.ns}/inbox/{self.rid}/"
+        for key in sorted(self.fabric.keys(inbox)):
+            raw = self.fabric.get(key)
+            self.fabric.delete(key)
+            if raw is None:
+                continue
+            self._queue.append((_decode_request(raw), now))
+
+        # serve: finish whatever is due, start whatever fits — several
+        # per step when service times are shorter than the quantum
+        while True:
+            if self._cur is not None:
+                req, finish_at = self._cur
+                if now < finish_at:
+                    break
+                self._commit(req, "length",
+                             list(range(int(req.max_new_tokens))))
+                self._cur = None
+            if not self._queue:
+                break
+            req, enq_t = self._queue.pop(0)
+            wait = now - enq_t
+            self._waits.append((now, wait))
+            self.all_waits.append(wait)
+            if (req.deadline_s is not None
+                    and self.clock.wall() > req.deadline_s):
+                # expired while queued: the replica-side deadline kill
+                self._commit(req, "timeout", [])
+                continue
+            if req.trace is not None:
+                obs.events.record("admit", trace=req.trace.trace_id,
+                                  replica=self.rid,
+                                  queue_wait_s=round(wait, 6))
+            self._cur = (req, now + self._service_s(req))
+
+        if now >= self._next_pub:
+            self._publish()
+
+        if (self._stopping and self._cur is None and not self._queue
+                and not self.fabric.keys(inbox)):
+            # clean drain exit: the lease lapses; the autoscaler's
+            # sweep (or the router's drain-departure path) handles the
+            # coordination residue, same as a real close
+            self.fabric.down(f"{self.ns}:{self.rid}")
+            self.alive = False
+
+
+class FleetSim:
+    """One offline scenario run (see module docstring).
+
+    ``FleetSim(spec).run()`` returns the scenario summary row —
+    the bench-JSONL payload the :class:`~tpudist.sim.scenario.Envelope`
+    checks — with ``envelope_ok`` / ``violations`` already folded in."""
+
+    def __init__(self, spec: ScenarioSpec, *,
+                 workload: Workload | None = None,
+                 service_rates: dict[str, float] | None = None,
+                 quantum_s: float = 0.01) -> None:
+        self.spec = spec
+        self.workload = workload if workload is not None \
+            else synthesize(spec)
+        self.rates = dict(service_rates or {})
+        self.quantum_s = float(quantum_s)
+        fleet = spec.fleet
+        self.vc = VirtualClock()
+        self.fabric = SimFabric()
+        self.ns = f"sim/{spec.name}"
+        self.replicas: list[SimReplica] = []
+        self._next_rank = 0
+        for _ in range(int(fleet["replicas"])):
+            self._spawn_one(warmup_s=0.0)
+        self.router = Router(
+            self.fabric, namespace=self.ns,
+            poll_s=float(fleet["router_poll_s"]),
+            use_health=False,
+            clock=self.vc.monotonic, wall=self.vc.wall,
+            sleeper=self._advance)
+        self.scaler: Autoscaler | None = None
+        self._next_scaler_poll = None
+        if fleet.get("autoscale"):
+            self.scaler = Autoscaler(
+                self.fabric, namespace=self.ns,
+                config=AutoscaleConfig(**fleet["autoscale"]),
+                spawner=self._spawn_n, clock=self.vc.monotonic)
+            self._next_scaler_poll = self.scaler.cfg.poll_s
+
+    @classmethod
+    def from_trace(cls, doc: dict, *, name: str = "trace_replay",
+                   autoscale: dict | None = None,
+                   replicas: int = 1,
+                   fleet: dict | None = None,
+                   envelope: Envelope | None = None,
+                   **kw) -> "FleetSim":
+        """A simulator replaying a recorded ``tpudist.events/1``
+        document: the trace's enqueue events become the workload
+        (:func:`workload_from_trace`) and its ``segment`` ``spt``
+        stamps set each replica's service rate
+        (:func:`service_rates_from_trace`) — the recorded incident,
+        re-run through today's policy code."""
+        wl = workload_from_trace(doc, name=name)
+        rates = service_rates_from_trace(doc)
+        f = {"replicas": replicas, **(fleet or {})}
+        if autoscale is not None:
+            f["autoscale"] = dict(autoscale)
+        spec = ScenarioSpec(
+            name=name, duration_s=max(wl.duration_s, 1e-3) + 1.0,
+            arrival={"kind": "constant", "rate": 1.0},   # unused: replay
+            fleet=f, **({"envelope": envelope} if envelope else {}))
+        return cls(spec, workload=wl, service_rates=rates, **kw)
+
+    # -- fleet construction ------------------------------------------------
+
+    def _rate_for(self, rid: str) -> float:
+        return float(self.rates.get(
+            rid, self.rates.get("*",
+                                self.spec.fleet["seconds_per_token"])))
+
+    def _spawn_one(self, warmup_s: float | None = None) -> SimReplica:
+        fleet = self.spec.fleet
+        rank = self._next_rank
+        self._next_rank += 1
+        r = SimReplica(
+            self.fabric, self.vc, rank=rank, namespace=self.ns,
+            seconds_per_token=self._rate_for(f"r{rank}"),
+            prefill_s=float(fleet["prefill_s"]),
+            prefill_per_token_s=float(fleet["prefill_per_token_s"]),
+            warmup_s=(float(fleet["warmup_s"]) if warmup_s is None
+                      else warmup_s),
+            publish_interval_s=float(fleet["publish_interval_s"]),
+            wait_window_s=float(fleet["wait_window_s"]))
+        if warmup_s == 0.0:
+            r.step()   # live (and publishing) before the first poll
+        self.replicas.append(r)
+        return r
+
+    def _spawn_n(self, n: int) -> list[SimReplica]:
+        """The autoscaler's spawner: joiners warm up for the configured
+        virtual seconds before their first heartbeat, reproducing the
+        real joiner's compile window."""
+        return [self._spawn_one() for _ in range(n)]
+
+    # -- the virtual-time engine -------------------------------------------
+
+    def _advance(self, dt: float) -> None:
+        """The router's injected sleeper: advance virtual time in
+        quanta, stepping every replica and firing the autoscaler on its
+        cadence — the whole world moves while the router 'sleeps'."""
+        remaining = float(dt)
+        while remaining > 1e-12:
+            q = min(self.quantum_s, remaining)
+            self.vc.advance(q)
+            remaining -= q
+            for r in self.replicas:
+                r.step()
+            if (self._next_scaler_poll is not None
+                    and self.vc.monotonic() >= self._next_scaler_poll):
+                self.scaler.poll()
+                self._next_scaler_poll += self.scaler.cfg.poll_s
+
+    # -- one scenario run --------------------------------------------------
+
+    def run(self, *, timeout_s: float | None = None) -> dict:
+        """Replay the workload through the real router; returns the
+        scenario summary row (bench-JSONL schema, envelope-checked)."""
+        # process-global SLO window: scrub the previous scenario's
+        # observations so this run's burn gauges start clean
+        obs.slo.clear()
+        base = _counters_now(self.ns)
+        reqs, arrivals = self.workload.requests(self.vc.wall())
+        t0 = time.perf_counter()
+        comps = self.router.run(
+            reqs, arrivals=arrivals,
+            timeout_s=(timeout_s if timeout_s is not None
+                       else self.spec.duration_s + 900.0))
+        wall_s = time.perf_counter() - t0
+        return self._summarize(reqs, comps, base, wall_s)
+
+    def _summarize(self, reqs, comps, base: dict, wall_s: float) -> dict:
+        spec = self.spec
+        reasons: dict[str, int] = {}
+        for c in comps:
+            reasons[c.reason] = reasons.get(c.reason, 0) + 1
+        waits = [w for r in self.replicas for w in r.all_waits]
+        now = _counters_now(self.ns)
+        delta = {k: now.get(k, 0.0) - base.get(k, 0.0) for k in now}
+
+        ups = drains = 0
+        recovery_s = 0.0
+        if self.scaler is not None:
+            for rec in self.scaler.decision_log:
+                if rec["action"] is not None:
+                    if rec["action"][0] == "up":
+                        ups += 1
+                    else:
+                        drains += 1
+            breach_ts = [rec["t"] for rec in self.scaler.decision_log
+                         if rec["wait_q"] > self.scaler.cfg.target_wait_s]
+            if breach_ts:
+                recovery_s = (max(breach_ts) - min(breach_ts)
+                              + self.scaler.cfg.poll_s)
+
+        row = {
+            "scenario": spec.name,
+            "requests": len(reqs),
+            "lost_requests": len(reqs) - len(comps),
+            "completed_ok": (reasons.get("stop", 0)
+                             + reasons.get("length", 0)),
+            "p99_queue_wait_s": (
+                round(float(np.percentile(waits, 99)), 6)
+                if waits else 0.0),
+            "recovery_s": round(recovery_s, 3),
+            "scale_ups": ups,
+            "drains": drains,
+            "priority_bad": delta.get("slo/bad~class=priority", 0.0),
+            "final_replicas": sum(1 for r in self.replicas if r.alive),
+            "virtual_s": round(self.vc.monotonic(), 3),
+            "sim_wall_s": round(wall_s, 4),
+            "speedup": (round(self.vc.monotonic() / wall_s, 1)
+                        if wall_s > 0 else None),
+            "seed": spec.seed,
+        }
+        for reason in ("completed", "shed", "rejected", "failed",
+                       "timeout"):
+            row[f"decisions_{reason}"] = delta.get(
+                f"router/decisions/{reason}", 0.0)
+        violations = spec.envelope.check(row)
+        row["envelope_ok"] = not violations
+        row["violations"] = violations
+        return row
+
+
+def _counters_now(ns: str) -> dict[str, float]:
+    """Current values of the process-global counters a scenario summary
+    is computed from — summaries are before/after DELTAS because the
+    obs registry is cumulative across scenarios in one process."""
+    snap = obs.snapshot()
+    out: dict[str, float] = {}
+    for name, m in snap.get("counters", {}).items():
+        if name.startswith(("router/decisions/", "slo/bad", "slo/good",
+                            "autoscale/")):
+            out[name] = float(m.get("value") or 0.0)
+    return out
