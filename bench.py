@@ -2611,7 +2611,9 @@ def bench_sim_replay(on_tpu: bool) -> None:
 
     from tpudist import obs
     from tpudist.models.serving import Request
+    from tpudist.obs.aggregate import collect, merge_snapshots
     from tpudist.obs.events import collect_events, merge_events
+    from tpudist.obs.registry import hist_quantile
     from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
     from tpudist.runtime.coord import CoordClient, CoordServer
     from tpudist.runtime.router import (Router, launch_local_fleet,
@@ -2654,6 +2656,10 @@ def bench_sim_replay(on_tpu: bool) -> None:
         t0 = time.perf_counter()
         scaler.start()
         comps = router.run(list(spike), timeout_s=240.0)
+        # live queue-wait percentiles, collected NOW — the published
+        # histogram is windowed (15 s here), so the spike's waits must
+        # be read before the scale-up wait loop below ages them out
+        merged_live = merge_snapshots(collect(client, f"{ns}/metrics"))
         limit = time.perf_counter() + 90.0
         while (time.perf_counter() < limit
                and not any(a["kind"] == "up"
@@ -2700,6 +2706,38 @@ def bench_sim_replay(on_tpu: bool) -> None:
         live_ups == sim_ups and live_rel is not None
         and sim_rel is not None and abs(live_rel - sim_rel) <= 1)
     speedup = live_wall_s / sim_wall_s if sim_wall_s > 0 else None
+
+    # queue-wait calibration (ISSUE 12 satellite): the same spike's
+    # p50/p99 queue wait, once from the live fleet's published windowed
+    # histogram and once from the replaying simulator's exact waits.
+    # Tolerance is deliberately loose, for three documented reasons:
+    # the simulator services with a single recorded seconds-per-token
+    # constant and steps time by the poll quantum; the live quantile
+    # interpolates log-spaced histogram buckets; and — dominant here —
+    # a sim scale-up joins INSTANTLY on the virtual clock while the
+    # live joiner pays a real warmup (interpreter + compile, ~10 s), so
+    # the sim drains the spike's tail earlier and reads lower waits.
+    # Agreement within 8x (or 500 ms absolute, whichever is looser) is
+    # what the model promises; the gate exists to catch order-of-
+    # magnitude modeling regressions, not jitter.
+    live_wait_h = merged_live["histograms"].get("serve/queue_wait_s")
+    have_live = bool(live_wait_h) and live_wait_h["count"] > 0
+    live_p50 = hist_quantile(live_wait_h, 0.5) if have_live else None
+    live_p99 = hist_quantile(live_wait_h, 0.99) if have_live else None
+    sim_waits = [w for r in sim.replicas for w in r.all_waits]
+    sim_p50 = float(np.percentile(sim_waits, 50)) if sim_waits else None
+    sim_p99 = float(np.percentile(sim_waits, 99)) if sim_waits else None
+
+    def _wait_close(a, b):
+        if a is None or b is None:
+            return None
+        lo, hi = sorted((max(a, 1e-6), max(b, 1e-6)))
+        return bool(hi - lo <= 0.5 or hi / lo <= 8.0)
+
+    p50_ok = _wait_close(live_p50, sim_p50)
+    p99_ok = _wait_close(live_p99, sim_p99)
+    wait_match = (bool(p50_ok and p99_ok)
+                  if p50_ok is not None and p99_ok is not None else None)
     _emit("sim_replay", round(speedup, 1) if speedup else 0, "x", None,
           decision_match=decision_match,
           live_ups=live_ups, sim_ups=sim_ups,
@@ -2710,7 +2748,223 @@ def bench_sim_replay(on_tpu: bool) -> None:
           completed=sum(1 for c in comps
                         if c.reason in ("stop", "length")),
           replay_lost=sim_row["lost_requests"],
-          replay_events=len(doc.get("events", [])))
+          replay_events=len(doc.get("events", [])),
+          live_wait_p50_s=(round(live_p50, 4)
+                           if live_p50 is not None else None),
+          live_wait_p99_s=(round(live_p99, 4)
+                           if live_p99 is not None else None),
+          sim_wait_p50_s=(round(sim_p50, 4)
+                          if sim_p50 is not None else None),
+          sim_wait_p99_s=(round(sim_p99, 4)
+                          if sim_p99 is not None else None),
+          wait_match=wait_match)
+
+
+def bench_router_failover(on_tpu: bool) -> None:
+    """Control-plane crash recovery end to end (ISSUE 12 tentpole): the
+    router runs as its OWN subprocess (``python -m tpudist.runtime.router
+    --route``) over a live 2-replica fleet and is SIGKILLed mid-spike by
+    ``TPUDIST_FAULT_ROUTER_KILL_AFTER_POLLS``; a second subprocess
+    (``--recover``) rebuilds the outstanding table from the durable
+    ``{ns}/journal/*`` records plus the crashed router's partial results
+    file, re-adopts the live replicas, and finishes the run.  Asserted
+    downstream by CI: ``killed`` (the first router really died by
+    SIGKILL), ``recovered`` (the ``--recover`` pass exited cleanly),
+    ``lost_requests=0`` (every submitted request has a result line),
+    ``dup_terminals=0`` (no rid delivered twice across the crash —
+    exactly-once), and ``exact_match`` (greedy tokens identical to an
+    uninterrupted single-loop run over the same seed-0 weights)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (build_tiny_lm, launch_local_fleet,
+                                        stop_fleet, wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_router_failover", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    n_requests = 12
+
+    def make_requests():
+        rng = np.random.default_rng(0)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"f{i}")
+                for i in range(n_requests)]
+
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    want = {c.rid: tuple(c.tokens.tolist())
+            for c in ref.run(make_requests())}
+
+    ns = "bench-failover"
+    addr = f"127.0.0.1:{server.port}"
+    client = CoordClient(port=server.port)
+    procs = launch_local_fleet(
+        addr, 2, namespace=ns,
+        replica_args=["--cache-layout", "paged", "--kv-block-size", "16",
+                      "--ttl", "1.0", "--steps-per-sync", "8"])
+    t0 = time.perf_counter()
+    try:
+        wait_live(client, 2, namespace=ns, timeout_s=120.0)
+        with tempfile.TemporaryDirectory(prefix="tpudist-failover-") as td:
+            reqs_path = Path(td) / "requests.json"
+            res_path = Path(td) / "results.jsonl"
+            reqs_path.write_text(json.dumps(
+                [{"prompt": np.asarray(r.prompt).astype(int).tolist(),
+                  "max_new_tokens": int(r.max_new_tokens),
+                  "rid": r.rid} for r in make_requests()]))
+            base_cmd = [sys.executable, "-m", "tpudist.runtime.router",
+                        "--coord", addr, "--namespace", ns,
+                        "--route", "--results", str(res_path),
+                        "--poll-s", "0.02", "--lost-after", "5.0",
+                        "--timeout", "120"]
+            # the router subprocess does no math; keep it off any
+            # accelerator the replica fleet is holding
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            # poll 15 at 0.02 s/poll: everything submitted + dispatched,
+            # almost nothing consumed — the widest recovery window
+            rc1 = subprocess.run(
+                base_cmd + ["--requests", str(reqs_path)],
+                env=dict(env,
+                         TPUDIST_FAULT_ROUTER_KILL_AFTER_POLLS="15"),
+                timeout=180).returncode
+            killed = rc1 == -signal.SIGKILL
+            partial = len([ln for ln in (
+                res_path.read_text().splitlines()
+                if res_path.exists() else []) if ln.strip()])
+            rc2 = subprocess.run(base_cmd + ["--recover"], env=env,
+                                 timeout=180).returncode
+            recovered = rc2 == 0
+            counts: dict[str, int] = {}
+            got: dict[str, tuple] = {}
+            for ln in res_path.read_text().splitlines():
+                if ln.strip():
+                    doc = json.loads(ln)
+                    counts[doc["rid"]] = counts.get(doc["rid"], 0) + 1
+                    got[doc["rid"]] = tuple(doc["tokens"])
+            journal_left = len(client.keys(f"{ns}/journal/"))
+    finally:
+        stop_fleet(client, procs, namespace=ns)
+    server.stop()
+    wall = time.perf_counter() - t0
+    _emit("router_failover", len(got), "reqs", None,
+          requests=n_requests,
+          lost_requests=n_requests - len(got),
+          killed=killed,
+          recovered=int(recovered),
+          dup_terminals=sum(1 for c in counts.values() if c > 1),
+          delivered_before_crash=partial,
+          exact_match=all(got.get(r) == w for r, w in want.items()),
+          journal_left=journal_left,
+          wall_s=round(wall, 2))
+
+
+def bench_coord_brownout(on_tpu: bool) -> None:
+    """Coord-store brownout under live traffic (ISSUE 12 tentpole): a
+    2-replica fleet serves a batch while the ROUTER's coordination
+    client loses the store for ~2.5x the replica lease TTL
+    (``FaultPlan(coord_outage_at_s=..., coord_outage_s=2.5)`` installed
+    in-process — the same window the ``TPUDIST_FAULT_COORD_OUTAGE_*``
+    env knobs arm in a subprocess).  The replicas keep decoding and
+    committing; the router rides the outage on its retry/backoff path,
+    then reconnects under the stale-not-lost grace.  Asserted
+    downstream by CI: ``lost_requests=0``, ``replica_deaths=0`` (no
+    false death verdicts from staleness), ``exact_match``, and the
+    ``coord/unavailable`` gauge back at 0 with the stretch recorded in
+    ``coord/outage_s``."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime import faults
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        launch_local_fleet, stop_fleet,
+                                        wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_coord_brownout", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    n_requests = 10
+
+    def make_requests():
+        rng = np.random.default_rng(0)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"b{i}")
+                for i in range(n_requests)]
+
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    want = {c.rid: tuple(c.tokens.tolist())
+            for c in ref.run(make_requests())}
+
+    ns = "bench-brownout"
+    client = CoordClient(port=server.port)
+    procs = launch_local_fleet(
+        f"127.0.0.1:{server.port}", 2, namespace=ns,
+        replica_args=["--cache-layout", "paged", "--kv-block-size", "16",
+                      "--ttl", "1.0", "--steps-per-sync", "8"])
+    before = obs.snapshot()["counters"]
+    t0 = time.perf_counter()
+    try:
+        wait_live(client, 2, namespace=ns, timeout_s=120.0)
+        router = Router(client, namespace=ns, lost_after_s=5.0)
+        # FaultPlan windows are relative to plan construction: built
+        # here, the outage opens 1.5 s into routing and lasts 2.5x the
+        # replica TTL — long enough that every lease expires from the
+        # router's stale point of view
+        faults.install(faults.FaultPlan(coord_outage_at_s=1.5,
+                                        coord_outage_s=2.5))
+        try:
+            comps = router.run(make_requests(), timeout_s=180.0)
+        finally:
+            faults.reset()
+    finally:
+        stop_fleet(client, procs, namespace=ns)
+    server.stop()
+    wall = time.perf_counter() - t0
+    after = obs.snapshot()
+
+    def delta(name):
+        return (after["counters"].get(name, {}).get("value", 0)
+                - before.get(name, {}).get("value", 0))
+
+    got = {c.rid: tuple(c.tokens.tolist()) for c in comps}
+    outage_hist = after.get("histograms", {}).get("coord/outage_s", {})
+    _emit("coord_brownout", len(got), "reqs", None,
+          requests=n_requests,
+          lost_requests=n_requests - len(got),
+          exact_match=all(got.get(r) == w for r, w in want.items()),
+          replica_deaths=int(delta("router/replica_deaths")),
+          redispatched=int(delta("router/redispatched")),
+          outage_polls=int(delta("router/outage_polls")),
+          coord_unavailable_now=int(
+              after.get("gauges", {}).get("coord/unavailable", {})
+              .get("value", 0)),
+          outage_stretches=int(outage_hist.get("count", 0)),
+          retry_backoffs=int(
+              after.get("histograms", {})
+              .get("coord/retry_backoff_s", {}).get("count", 0)),
+          wall_s=round(wall, 2))
 
 
 def main() -> None:
@@ -2732,7 +2986,8 @@ def main() -> None:
                bench_speculative_decode, bench_host_allreduce,
                bench_serve_fleet, bench_serve_fused, bench_serve_elastic,
                bench_serve_autoscale, bench_scenario_matrix,
-               bench_sim_replay]
+               bench_sim_replay, bench_router_failover,
+               bench_coord_brownout]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

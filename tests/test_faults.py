@@ -289,3 +289,106 @@ class TestControlPlaneInjections:
         faults.on_warmup()
         assert not faults.corrupt_canary("canary-9")
         faults.autoscale_poll()
+        faults.on_router_poll()
+
+
+class TestControlPlaneCrashKnobs:
+    """ISSUE 12 knobs: router kill-after-polls and the full-store
+    coord-outage window."""
+
+    def test_env_parsing(self):
+        plan = FaultPlan.from_env({
+            "TPUDIST_FAULT_ROUTER_KILL_AFTER_POLLS": "25",
+            "TPUDIST_FAULT_COORD_OUTAGE_AT_S": "3.0",
+            "TPUDIST_FAULT_COORD_OUTAGE_S": "2.5",
+        })
+        assert plan.active
+        assert plan.router_kill_after_polls == 25
+        assert plan.coord_outage_at_s == 3.0
+        assert plan.coord_outage_s == 2.5
+        # the outage length defaults to 5 s once the start is set
+        assert FaultPlan.from_env(
+            {"TPUDIST_FAULT_COORD_OUTAGE_AT_S": "1.0"}).coord_outage_s \
+            == 5.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="router_kill_after_polls"):
+            FaultPlan(router_kill_after_polls=0)
+        with pytest.raises(ValueError, match="coord_outage_s"):
+            FaultPlan(coord_outage_at_s=1.0, coord_outage_s=0.0)
+
+    def test_outage_window_refuses_every_op_then_lifts(self):
+        plan = FaultPlan(coord_outage_at_s=0.0, coord_outage_s=0.05)
+        assert plan.in_outage()
+        for op in ("get", "set", "delete", "add", "keys", "live"):
+            with pytest.raises(FaultInjected, match="coord outage"):
+                plan.coord_op(op)
+        assert plan.injected["coord_outage"] == 6
+        import time as _time
+        _time.sleep(0.06)
+        assert not plan.in_outage()
+        plan.coord_op("get")   # flows again
+
+    def test_outage_not_yet_open_is_inert(self):
+        plan = FaultPlan(coord_outage_at_s=1e6)
+        assert not plan.in_outage()
+        plan.coord_op("get")
+
+    def test_router_kill_raise_is_one_shot(self):
+        from tpudist.runtime.faults import RouterKilled
+
+        plan = FaultPlan(router_kill_after_polls=3,
+                         router_kill_raise=True)
+        plan.on_router_poll()
+        plan.on_router_poll()
+        with pytest.raises(RouterKilled, match="poll 3"):
+            plan.on_router_poll()
+        assert plan.injected["router_kill"] == 1
+        # disarmed: the recovery router's polls must not re-trip it
+        for _ in range(10):
+            plan.on_router_poll()
+        assert plan.injected["router_kill"] == 1
+
+    def test_router_kill_sigkills_subprocess(self):
+        """The live shape: a subprocess router counting polls must
+        vanish (SIGKILL, no cleanup) on the Kth."""
+        script = (
+            "from tpudist.runtime import faults\n"
+            "for i in range(6):\n"
+            "    print(f'poll{i}', flush=True)\n"
+            "    faults.on_router_poll()\n"
+            "print('survived', flush=True)\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(Path(__file__).resolve().parents[1])]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        env["TPUDIST_FAULT_ROUTER_KILL_AFTER_POLLS"] = "4"
+        res = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert res.returncode == -signal.SIGKILL
+        assert "poll3" in res.stdout
+        assert "survived" not in res.stdout
+
+    def test_refused_gate_retries_outage_for_all_verbs(self):
+        """During a declared outage the fault fires BEFORE the RPC
+        leaves the process ("connection refused"), so even the
+        non-idempotent add retries through a window that closes inside
+        the retry budget."""
+        server, _ = _coord_pair()
+        from tpudist.runtime.coord import CoordClient
+
+        # a retry budget comfortably longer than the window (naps are
+        # >= 20 ms each), so the gate deterministically outlives it
+        client = CoordClient("127.0.0.1", server.port, retries=30)
+        faults.install(FaultPlan(coord_outage_at_s=0.0,
+                                 coord_outage_s=0.15))
+        try:
+            # backoff sleeps carry the retries past the window's end
+            assert client.add("outage-ctr", 1) == 1
+            assert faults.plan().injected["coord_outage"] >= 1
+        finally:
+            faults.reset()
+        b = obs.snapshot()["histograms"].get(
+            "coord/retry_backoff_s", {}).get("count", 0)
+        assert b >= 1

@@ -102,6 +102,39 @@ class TestScenarioSpec:
         with pytest.raises(KeyError, match="unknown scenario"):
             builtin("nope")
 
+    def test_fault_validation(self):
+        with pytest.raises(ValueError, match="not in"):
+            _spec(faults=[{"kind": "meteor"}])
+        with pytest.raises(ValueError, match="missing required keys"):
+            _spec(faults=[{"kind": "kill_replica", "at_s": 1.0}])
+        with pytest.raises(ValueError, match="unknown keys"):
+            _spec(faults=[{"kind": "kill_replica", "at_s": 1.0,
+                           "rid": "r0", "for_s": 2.0}])
+        with pytest.raises(ValueError, match="for_s > 0"):
+            _spec(faults=[{"kind": "coord_brownout", "at_s": 1.0,
+                           "for_s": 0.0}])
+        with pytest.raises(ValueError, match="at_poll >= 1"):
+            _spec(faults=[{"kind": "kill_router", "at_poll": 0}])
+        with pytest.raises(ValueError, match="at most one kill_router"):
+            _spec(faults=[{"kind": "kill_router", "at_poll": 5},
+                          {"kind": "kill_router", "at_poll": 9}])
+        spec = _spec(faults=[
+            {"kind": "kill_replica", "at_s": 1.0, "rid": "r0"},
+            {"kind": "drop_heartbeats", "at_s": 2.0, "for_s": 1.0,
+             "rid": "r1"}])
+        assert isinstance(spec.faults, tuple) and len(spec.faults) == 2
+
+    def test_chaos_builtins_present_and_faulted(self):
+        # the chaos third of the matrix (ISSUE 12): present, parsed,
+        # and actually scripting faults
+        for name in ("replica_death_storm", "router_failover",
+                     "coord_brownout"):
+            spec = builtin(name)
+            assert spec.faults, name
+        back = ScenarioSpec.from_dict(
+            builtin("replica_death_storm").to_dict())
+        assert back == builtin("replica_death_storm")
+
 
 class TestEnvelope:
     def test_unknown_key_rejected(self):
@@ -141,6 +174,20 @@ class TestEnvelope:
         assert Envelope(min_scale_ups=1).check({}) \
             == ["scale_ups=0 < min 1"]
         assert Envelope(max_p99_queue_wait_s=1.0).check({}) == []
+
+    def test_chaos_bounds(self):
+        env = Envelope.from_dict({
+            "max_burn_rate_300s": 2.0, "max_replica_deaths": 1,
+            "min_router_recoveries": 1})
+        good = {"lost_requests": 0, "burn_rate_300s": 0.5,
+                "replica_deaths": 1, "router_recoveries": 1}
+        assert env.check(good) == []
+        bad = env.check({"lost_requests": 0, "burn_rate_300s": 9.0,
+                         "replica_deaths": 3, "router_recoveries": 0})
+        assert len(bad) == 3
+        assert any("burn_rate_300s" in b for b in bad)
+        assert any("replica_deaths" in b for b in bad)
+        assert any("router_recoveries" in b for b in bad)
 
 
 class TestWorkloadSynthesis:
@@ -274,7 +321,10 @@ def _passing_row(name: str) -> dict:
             "lost_requests": 0, "p99_queue_wait_s": 0.05,
             "recovery_s": 5.0,
             "scale_ups": env.min_scale_ups, "drains": env.min_drains,
-            "priority_bad": 0, "decisions_completed": 500,
+            "priority_bad": 0, "replica_deaths": 0,
+            "router_recoveries": env.min_router_recoveries,
+            "burn_rate_300s": 0.0,
+            "decisions_completed": 500,
             "decisions_failed": 0, "envelope_ok": True,
             "violations": []}
 
@@ -329,6 +379,38 @@ class TestVirtualClock:
         assert vc.wall() == pytest.approx(501.5)
         with pytest.raises(ValueError):
             vc.advance(-0.1)
+
+
+class TestSimFabricChaos:
+    def test_outage_window_gates_client_verbs_not_leases(self):
+        from tpudist.runtime.faults import FaultInjected
+        from tpudist.sim.fabric import SimFabric
+        from tpudist.sim.simulator import VirtualClock
+
+        vc = VirtualClock()
+        fab = SimFabric(clock=vc.monotonic)
+        fab.add_outage(1.0, 2.0)
+        fab.set("k", b"v")                  # before the window: fine
+        vc.advance(1.5)
+        for op in (lambda: fab.set("k", b"w"), lambda: fab.get("k"),
+                   lambda: fab.keys(), lambda: fab.delete("k"),
+                   lambda: fab.add("c", 1), lambda: fab.live()):
+            with pytest.raises(FaultInjected):
+                op()
+        # lease flips model SERVER-side state: outage-exempt
+        fab.up("ns:r0")
+        fab.down("ns:r0")
+        vc.advance(1.0)                     # past the window
+        assert fab.get("k") == b"v"         # the blind write never landed
+
+    def test_outage_needs_clock_and_sane_window(self):
+        from tpudist.sim.fabric import SimFabric
+        from tpudist.sim.simulator import VirtualClock
+
+        with pytest.raises(ValueError, match="needs a clock"):
+            SimFabric().add_outage(0.0, 1.0)
+        with pytest.raises(ValueError, match="bad outage window"):
+            SimFabric(clock=VirtualClock().monotonic).add_outage(2.0, 1.0)
 
 
 class TestFleetSim:
@@ -399,6 +481,88 @@ class TestFleetSim:
         # every loose-deadline request still completes
         assert row["decisions_shed"] + row["decisions_timeout"] > 0
         assert row["completed_ok"] > 0
+
+
+class TestFleetSimChaos:
+    """The FaultScript verbs drive the REAL recovery paths on the
+    virtual clock: replica death -> redispatch, coord brownout ->
+    buffered ride-out, router kill -> journal recovery."""
+
+    def _tiny(self, **over):
+        base = {"name": "chaos-tiny", "duration_s": 4.0,
+                "arrival": {"kind": "constant", "rate": 6.0},
+                "max_new": {"kind": "const", "value": 8},
+                "seed": 41, "fleet": {"replicas": 2},
+                "envelope": {"max_lost": 0}}
+        base.update(over)
+        return ScenarioSpec.from_dict(base)
+
+    def test_replica_kill_redispatches_everything(self):
+        from tpudist.sim.simulator import FleetSim
+
+        spec = self._tiny(
+            name="chaos-kill", seed=42,
+            faults=[{"kind": "kill_replica", "at_s": 1.0, "rid": "r1"}],
+            envelope={"max_lost": 0, "max_replica_deaths": 1,
+                      "decisions": {"failed": {"max": 0}}})
+        row = FleetSim(spec).run()
+        assert row["lost_requests"] == 0
+        assert row["replica_deaths"] == 1
+        assert row["decisions_completed"] == row["requests"]
+        assert row["envelope_ok"], row["violations"]
+
+    def test_coord_brownout_is_stale_not_lost(self):
+        from tpudist.sim.simulator import FleetSim
+
+        spec = self._tiny(
+            name="chaos-brownout", seed=43,
+            faults=[{"kind": "coord_brownout", "at_s": 1.0,
+                     "for_s": 1.5}],
+            envelope={"max_lost": 0, "max_replica_deaths": 0,
+                      "decisions": {"failed": {"max": 0}}})
+        sim = FleetSim(spec)
+        row = sim.run()
+        assert row["lost_requests"] == 0
+        assert row["replica_deaths"] == 0
+        assert row["decisions_completed"] == row["requests"]
+        # the brownout buffers drained before the fleet shut down
+        assert all(not r._done_buf for r in sim.replicas)
+        assert row["envelope_ok"], row["violations"]
+
+    def test_router_kill_recovers_with_no_dups(self):
+        from tpudist.sim.simulator import FleetSim
+
+        spec = self._tiny(
+            name="chaos-failover", seed=44,
+            faults=[{"kind": "kill_router", "at_poll": 20}],
+            envelope={"max_lost": 0, "min_router_recoveries": 1,
+                      "decisions": {"failed": {"max": 0}}})
+        sim = FleetSim(spec)
+        row = sim.run()
+        assert row["lost_requests"] == 0
+        assert row["router_recoveries"] == 1
+        # exactly-once delivery across the crash: every request decided
+        # exactly one terminal, and the journal compacted to empty
+        assert row["decisions_completed"] == row["requests"]
+        assert sim.fabric.keys(f"{sim.ns}/journal/") == []
+        assert row["envelope_ok"], row["violations"]
+
+    def test_drop_heartbeats_comes_back(self):
+        from tpudist.sim.simulator import FleetSim
+
+        # a false-positive-death shape: the lease lapses for 1 s, the
+        # router redispatches, the replica resumes — nothing is lost
+        # and nothing double-delivers
+        spec = self._tiny(
+            name="chaos-hb", seed=45,
+            faults=[{"kind": "drop_heartbeats", "at_s": 1.0,
+                     "for_s": 1.0, "rid": "r1"}],
+            envelope={"max_lost": 0,
+                      "decisions": {"failed": {"max": 0}}})
+        row = FleetSim(spec).run()
+        assert row["lost_requests"] == 0
+        assert row["decisions_completed"] == row["requests"]
+        assert row["envelope_ok"], row["violations"]
 
 
 @pytest.mark.skipif(not os.path.exists(FIXTURE),

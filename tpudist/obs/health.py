@@ -183,6 +183,7 @@ class HealthMonitor:
         median = _median(known) if known else None
 
         transitions: list[dict] = []
+        candidates: dict[int, str] = {}
         for rank, track in self._tracks.items():
             snap_present = rank in snapshots
             if snap_present:
@@ -203,7 +204,21 @@ class HealthMonitor:
                 candidate = "straggler"
             else:
                 candidate = "healthy"
-            self._apply_hysteresis(rank, track, candidate, transitions)
+            candidates[rank] = candidate
+        # stale-not-lost, fleet-wide: when EVERY tracked rank went bad
+        # in the same round, the common cause is the path to the obs
+        # plane (a coord brownout), not a simultaneous mass death —
+        # demote "lost" to "stale" so consumers steer around the blind
+        # spot without triggering a redispatch storm.  One genuinely
+        # dead rank among healthy peers is unaffected.
+        if (len(candidates) >= 2
+                and all(c in ("stale", "lost")
+                        for c in candidates.values())):
+            candidates = {rank: ("stale" if c == "lost" else c)
+                          for rank, c in candidates.items()}
+        for rank, candidate in candidates.items():
+            self._apply_hysteresis(rank, self._tracks[rank], candidate,
+                                   transitions)
 
         verdict = self._render_verdict(now, transitions)
         self._emit(verdict, transitions)

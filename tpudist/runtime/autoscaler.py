@@ -229,6 +229,10 @@ class Autoscaler:
         self._obs_drained = obs.counter("autoscale/drain_completed",
                                         unit="replicas")
         self._obs_polls = obs.counter("autoscale/polls", unit="polls")
+        self._obs_suppressed = obs.counter(
+            "autoscale/suppressed_polls", unit="polls",
+            help="polls skipped because the coord store was unreachable "
+                 "(no scaling verdicts on blind data)")
         self._obs_replicas = obs.gauge("autoscale/replicas",
                                        unit="replicas")
         self._obs_wait = obs.gauge("autoscale/wait_q", unit="s")
@@ -371,7 +375,27 @@ class Autoscaler:
         (tests assert on it; the bench logs it)."""
         faults.autoscale_poll()
         self._obs_polls.inc()
-        view = self._observe()
+        try:
+            view = self._observe()
+        except ConnectionError as err:
+            # coord brownout: the STORE is the unreachable thing, not
+            # the fleet.  No scaling verdict is safe on blind data —
+            # reset both hysteresis streaks (they must re-earn their
+            # polls against fresh observations) and record a suppressed
+            # poll instead of an action.
+            self._breach = 0
+            self._idle = 0
+            self._obs_suppressed.inc()
+            record = {"action": None, "suppressed": True,
+                      "error": str(err), "poll": self._poll_n,
+                      "t": self._clock()}
+            self._poll_n += 1
+            self.decision_log.append(record)
+            if len(self.decision_log) > self.decision_log_max:
+                del self.decision_log[:-self.decision_log_max]
+            log.warning("autoscale: coord store unreachable (%s); "
+                        "suppressing this poll", err)
+            return record
         live, draining = view["live"], view["draining"]
         self._tick_drains(live, draining)
         active = live - draining

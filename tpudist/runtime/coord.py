@@ -38,6 +38,7 @@ _VALUE_CAP = 1 << 20
 # obs handle cached lazily: coord is imported by lightweight workers and
 # must not pull the metrics registry (and its dependencies) at import
 _retries_counter = None
+_backoff_hist = None
 
 
 def _obs_retries():
@@ -47,6 +48,72 @@ def _obs_retries():
 
         _retries_counter = obs.counter("coord/retries", unit="retries")
     return _retries_counter
+
+
+def _obs_backoff():
+    global _backoff_hist
+    if _backoff_hist is None:
+        from tpudist import obs
+
+        _backoff_hist = obs.histogram(
+            "coord/retry_backoff_s", unit="s",
+            help="per-retry sleep the coord client's capped decorrelated-"
+                 "jitter backoff chose")
+    return _backoff_hist
+
+
+class _OutageTracker:
+    """Process-wide coord-availability bookkeeping.
+
+    Every client op reports success/failure here; the first failure of a
+    contiguous bad stretch opens an outage (``coord/unavailable`` gauge
+    -> 1) and the first success after it closes it, recording the
+    stretch's length into the ``coord/outage_s`` histogram.  The happy
+    path is one lock-free attribute check."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._down_since: float | None = None
+        self._gauge = None
+        self._hist = None
+
+    def _obs(self):
+        if self._gauge is None:
+            from tpudist import obs
+
+            self._gauge = obs.gauge(
+                "coord/unavailable", unit="bool",
+                help="1 while the coord store is unreachable from this "
+                     "process (brownout), 0 once an op succeeds again")
+            self._hist = obs.histogram(
+                "coord/outage_s", unit="s",
+                help="length of each contiguous coord-unreachability "
+                     "stretch, observed at reconnect")
+        return self._gauge, self._hist
+
+    def down(self) -> None:
+        gauge, _ = self._obs()
+        with self._lock:
+            if self._down_since is None:
+                self._down_since = time.monotonic()
+        gauge.set(1)
+
+    def up(self) -> None:
+        if self._down_since is None:
+            return
+        with self._lock:
+            since, self._down_since = self._down_since, None
+        if since is None:
+            return
+        gauge, hist = self._obs()
+        gauge.set(0)
+        hist.record(time.monotonic() - since)
+
+    def is_down(self) -> bool:
+        return self._down_since is not None
+
+
+outage = _OutageTracker()
 
 
 class NativeUnavailable(RuntimeError):
@@ -100,13 +167,25 @@ class CoordClient:
       refresh.  Each retry ticks the ``coord/retries`` counter so a
       flaky control plane is visible before it becomes an outage.
     * ``add``, ``barrier`` — and the writes ``set`` / ``delete`` /
-      ``wait`` — surface errors IMMEDIATELY.  They are not idempotent
-      (or their failure is not observable as such): an ``add`` whose
-      reply was lost may have been applied, so a blind client-side
-      replay could double-count a rank or double-arrive at a barrier —
-      exactly the split-brain the rendezvous layer exists to prevent.
-      Callers own the recovery semantics for those (e.g. a fresh
-      rendezvous round).
+      ``wait`` — surface REAL RPC errors IMMEDIATELY.  They are not
+      idempotent (or their failure is not observable as such): an
+      ``add`` whose reply was lost may have been applied, so a blind
+      client-side replay could double-count a rank or double-arrive at
+      a barrier — exactly the split-brain the rendezvous layer exists
+      to prevent.  Callers own the recovery semantics for those (e.g. a
+      fresh rendezvous round).  The ONE exception is the
+      "connection refused" class — a :class:`~tpudist.runtime.faults.
+      FaultInjected` outage fires BEFORE the request leaves the
+      process, so nothing can have half-applied server-side and every
+      verb retries it safely.
+
+    Backoff is capped decorrelated jitter (``sleep = min(cap,
+    uniform(base, 3 * prev_sleep))``): growth de-synchronizes a fleet
+    of clients hit by the same blip, the cap bounds reconnect latency
+    after a long brownout.  Each sleep lands in the
+    ``coord/retry_backoff_s`` histogram; contiguous unreachability
+    stretches drive the process-wide ``coord/unavailable`` gauge and
+    ``coord/outage_s`` histogram (see :data:`outage`).
 
     Fault injection (:mod:`tpudist.runtime.faults`) hooks every op, so
     both halves of this contract are exercised deterministically in
@@ -114,12 +193,16 @@ class CoordClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  timeout_ms: int = 10_000, retries: int | None = None,
-                 retry_base_s: float = 0.02) -> None:
+                 retry_base_s: float = 0.02,
+                 retry_cap_s: float | None = None) -> None:
         self._lib = _lib()
         self.host, self.port, self._timeout_ms = host, port, timeout_ms
         self._retries = (int(os.environ.get("TPUDIST_COORD_RETRIES", "2"))
                          if retries is None else int(retries))
         self._retry_base_s = float(retry_base_s)
+        self._retry_cap_s = (
+            float(os.environ.get("TPUDIST_COORD_RETRY_CAP_S", "1.0"))
+            if retry_cap_s is None else float(retry_cap_s))
         self._h = self._lib.tcs_connect(host.encode(), port, timeout_ms)
         if not self._h:
             raise ConnectionError(f"could not reach coordination server {host}:{port}")
@@ -134,33 +217,82 @@ class CoordClient:
         per connection, so background threads need their own)."""
         return CoordClient(self.host, self.port, self._timeout_ms,
                            retries=self._retries,
-                           retry_base_s=self._retry_base_s)
+                           retry_base_s=self._retry_base_s,
+                           retry_cap_s=self._retry_cap_s)
+
+    def _backoff(self, prev_sleep: float) -> float:
+        """One capped decorrelated-jitter nap; returns the slept length
+        (the next call's ``prev_sleep``)."""
+        _obs_retries().inc()
+        nap = min(self._retry_cap_s,
+                  random.uniform(self._retry_base_s,
+                                 max(self._retry_base_s, 3.0 * prev_sleep)))
+        _obs_backoff().record(nap)
+        time.sleep(nap)
+        return nap
 
     def _retry(self, op: str, fn):
         """Run ``fn`` with the idempotent-op retry schedule (see class
-        docstring).  Jittered exponential backoff: base × 2^attempt,
-        scaled by a uniform [0.5, 1.5) draw so a fleet of clients hit by
-        the same blip doesn't re-stampede the server in lockstep."""
-        delay = self._retry_base_s
+        docstring)."""
+        sleep = self._retry_base_s
         for attempt in range(self._retries + 1):
             try:
-                return fn()
+                result = fn()
             except ConnectionError:
+                outage.down()
                 if attempt >= self._retries:
                     raise
-                _obs_retries().inc()
-                time.sleep(delay * (0.5 + random.random()))
-                delay *= 2.0
+                sleep = self._backoff(sleep)
+            else:
+                outage.up()
+                return result
+
+    def _refused_gate(self, op: str) -> None:
+        """Fault hook for the non-idempotent verbs.  During a DECLARED
+        outage window FaultInjected fires BEFORE the RPC leaves the
+        process — the refused class — so retrying it here cannot
+        double-apply anything.  Probabilistic ``COORD_ERROR_P`` faults
+        model an op whose server-side fate is unknown and keep
+        surfacing immediately, like the real RPC errors raised by the
+        verb body after this gate."""
+        sleep = self._retry_base_s
+        for attempt in range(self._retries + 1):
+            try:
+                _faults.coord_op(op)
+            except _faults.FaultInjected:
+                outage.down()
+                if attempt >= self._retries \
+                        or not _faults.plan().in_outage():
+                    raise
+                sleep = self._backoff(sleep)
+            else:
+                return
+
+    def _direct(self, op: str, fn):
+        """Run a non-idempotent verb: refused-class faults retry at the
+        gate, the RPC itself runs at most once and surfaces its error
+        immediately (split-brain rule)."""
+        self._refused_gate(op)
+        try:
+            result = fn()
+        except ConnectionError:
+            outage.down()
+            raise
+        outage.up()
+        return result
 
     # -- kv ----------------------------------------------------------------
     def set(self, key: str, value: bytes | str) -> None:
         if isinstance(value, str):
             value = value.encode()
-        _faults.coord_op("set")
-        with self._rpc_lock:
-            if self._lib.tcs_set(self._h, key.encode(), value,
-                                 len(value)) != 0:
-                raise ConnectionError("set failed")
+
+        def once() -> None:
+            with self._rpc_lock:
+                if self._lib.tcs_set(self._h, key.encode(), value,
+                                     len(value)) != 0:
+                    raise ConnectionError("set failed")
+
+        self._direct("set", once)
 
     def get(self, key: str) -> bytes | None:
         return self._retry("get", lambda: self._get_once(key))
@@ -184,29 +316,36 @@ class CoordClient:
             return buf.raw[: out_len.value]
 
     def add(self, key: str, delta: int) -> int:
-        # NOT retried: a lost reply may still have incremented the
-        # counter server-side — see the class docstring
-        _faults.coord_op("add")
-        with self._rpc_lock:
-            v = self._lib.tcs_add(self._h, key.encode(), delta)
-        if v == -(2**63):
-            raise ConnectionError("add failed")
-        return int(v)
+        # the RPC is NOT retried: a lost reply may still have
+        # incremented the counter server-side — see the class docstring
+
+        def once() -> int:
+            with self._rpc_lock:
+                v = self._lib.tcs_add(self._h, key.encode(), delta)
+            if v == -(2**63):
+                raise ConnectionError("add failed")
+            return int(v)
+
+        return self._direct("add", once)
 
     def wait(self, key: str, timeout_s: float = 30.0) -> bool:
-        _faults.coord_op("wait")
-        with self._rpc_lock:
-            rc = self._lib.tcs_wait(self._h, key.encode(),
-                                    int(timeout_s * 1000))
-        if rc < 0:
-            raise ConnectionError("wait failed")
-        return rc == 0
+        def once() -> bool:
+            with self._rpc_lock:
+                rc = self._lib.tcs_wait(self._h, key.encode(),
+                                        int(timeout_s * 1000))
+            if rc < 0:
+                raise ConnectionError("wait failed")
+            return rc == 0
+
+        return self._direct("wait", once)
 
     def delete(self, key: str) -> None:
-        _faults.coord_op("delete")
-        with self._rpc_lock:
-            if self._lib.tcs_del(self._h, key.encode()) != 0:
-                raise ConnectionError("del failed")
+        def once() -> None:
+            with self._rpc_lock:
+                if self._lib.tcs_del(self._h, key.encode()) != 0:
+                    raise ConnectionError("del failed")
+
+        self._direct("delete", once)
 
     def keys(self, prefix: str = "") -> list[str]:
         def once() -> list[str]:
@@ -244,13 +383,16 @@ class CoordClient:
         NOT retried on error: a barrier arrival whose reply was lost may
         still be counted server-side, and a client-side replay would
         arrive twice — see the class docstring."""
-        _faults.coord_op("barrier")
-        with self._rpc_lock:
-            rc = self._lib.tcs_barrier(self._h, name.encode(), count,
-                                       int(timeout_s * 1000))
-        if rc < 0:
-            raise ConnectionError("barrier failed")
-        return rc == 0
+
+        def once() -> bool:
+            with self._rpc_lock:
+                rc = self._lib.tcs_barrier(self._h, name.encode(), count,
+                                           int(timeout_s * 1000))
+            if rc < 0:
+                raise ConnectionError("barrier failed")
+            return rc == 0
+
+        return self._direct("barrier", once)
 
     # -- liveness ----------------------------------------------------------
     def heartbeat(self, worker: str, ttl_s: float) -> None:
@@ -501,11 +643,19 @@ class ElasticMonitor:
         self._thread.start()
 
     def _beat(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # a coord brownout must not permanently kill the lease thread:
+        # keep trying with jittered exponential backoff (capped well
+        # below nothing — the lease has already lapsed server-side, the
+        # point is to re-establish it the moment the store returns) and
+        # snap back to the normal cadence on the first success
+        delay = self.interval_s
+        while not self._stop.wait(delay):
             try:
                 self._beat_client.heartbeat(self.worker_id, self.ttl_s)
+                delay = self.interval_s
             except ConnectionError:
-                return
+                delay = min(8.0, max(delay, self.interval_s) * 2.0) \
+                    * (0.5 + random.random())
 
     def check(self) -> None:
         """Raise ``WorldChanged(new_size)`` if membership shifted."""

@@ -71,6 +71,25 @@ Environment knobs (all optional):
                                     stall every autoscaler control poll by
                                     this many seconds — a wedged control
                                     plane that must not lose requests
+``TPUDIST_FAULT_ROUTER_KILL_AFTER_POLLS``
+                                    SIGKILL self after this many router
+                                    ``_poll`` iterations — a control-plane
+                                    crash mid-spike whose recovery path
+                                    (``--recover``) must finish every
+                                    in-flight request exactly once
+``TPUDIST_FAULT_COORD_OUTAGE_AT_S``
+                                    start of a full-store unreachability
+                                    window (process uptime, seconds): EVERY
+                                    coord RPC raises :class:`FaultInjected`
+                                    while the window is open — a coord
+                                    brownout, as distinct from the per-op
+                                    ``COORD_ERROR_P`` coin flips.  Because
+                                    the fault fires BEFORE the RPC leaves
+                                    the process, no op can have half-
+                                    applied — the "connection refused"
+                                    class, safely retriable for all verbs
+``TPUDIST_FAULT_COORD_OUTAGE_S``    the outage window's length (default
+                                    5 s once ``COORD_OUTAGE_AT_S`` is set)
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -83,9 +102,10 @@ import signal
 import threading
 import time
 
-__all__ = ["FaultInjected", "FaultPlan", "plan", "install", "reset",
-           "coord_op", "drop_heartbeat", "drop_publish", "on_segment",
-           "on_warmup", "corrupt_canary", "autoscale_poll"]
+__all__ = ["FaultInjected", "RouterKilled", "FaultPlan", "plan",
+           "install", "reset", "coord_op", "drop_heartbeat",
+           "drop_publish", "on_segment", "on_warmup", "corrupt_canary",
+           "autoscale_poll", "on_router_poll"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -95,6 +115,14 @@ class FaultInjected(ConnectionError):
     ``ConnectionError`` so production error handling (CoordClient's
     idempotent-op retry, callers' except clauses) treats it exactly like
     a real dropped connection."""
+
+
+class RouterKilled(RuntimeError):
+    """Raised by :meth:`FaultPlan.on_router_poll` instead of SIGKILL
+    when ``router_kill_raise`` is set: the in-process router-crash shape
+    the offline simulator uses — FleetSim catches it, builds a fresh
+    Router on the same fabric, and runs the REAL ``recover()`` path on
+    the virtual clock.  Live chaos keeps the real SIGKILL."""
 
 
 def _env_float(environ, name: str) -> float | None:
@@ -120,6 +148,10 @@ class FaultPlan:
         kill_at_warmup: bool = False,
         canary_corrupt: bool = False,
         autoscale_poll_delay_s: float | None = None,
+        router_kill_after_polls: int | None = None,
+        router_kill_raise: bool = False,
+        coord_outage_at_s: float | None = None,
+        coord_outage_s: float = 5.0,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -139,29 +171,49 @@ class FaultPlan:
         self.kill_at_warmup = bool(kill_at_warmup)
         self.canary_corrupt = bool(canary_corrupt)
         self.autoscale_poll_delay_s = autoscale_poll_delay_s
+        if router_kill_after_polls is not None \
+                and int(router_kill_after_polls) < 1:
+            raise ValueError(
+                f"router_kill_after_polls must be >= 1, got "
+                f"{router_kill_after_polls}")
+        self.router_kill_after_polls = (
+            None if router_kill_after_polls is None
+            else int(router_kill_after_polls))
+        self.router_kill_raise = bool(router_kill_raise)
+        if coord_outage_at_s is not None and coord_outage_s <= 0:
+            raise ValueError(
+                f"coord_outage_s must be > 0, got {coord_outage_s}")
+        self.coord_outage_at_s = coord_outage_at_s
+        self.coord_outage_s = float(coord_outage_s)
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self._segments = 0
+        self._router_polls = 0
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
                          "heartbeat_drop": 0, "publish_drop": 0,
                          "heartbeat_delay": 0, "canary_corrupt": 0,
-                         "autoscale_delay": 0}
+                         "autoscale_delay": 0, "coord_outage": 0,
+                         "router_kill": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
                            or kill_after_segments is not None
                            or publish_drop_after_s is not None
                            or heartbeat_delay_s is not None
                            or kill_at_warmup or canary_corrupt
-                           or autoscale_poll_delay_s is not None)
+                           or autoscale_poll_delay_s is not None
+                           or router_kill_after_polls is not None
+                           or coord_outage_at_s is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
         env = os.environ if environ is None else environ
         kill = _env_float(env, "KILL_AFTER_SEGMENTS")
         hb = _env_float(env, "HEARTBEAT_STOP_AFTER_S")
+        rkill = _env_float(env, "ROUTER_KILL_AFTER_POLLS")
+        outage_s = _env_float(env, "COORD_OUTAGE_S")
         return cls(
             coord_error_p=_env_float(env, "COORD_ERROR_P") or 0.0,
             coord_delay_p=_env_float(env, "COORD_DELAY_P") or 0.0,
@@ -175,13 +227,35 @@ class FaultPlan:
             kill_at_warmup=bool(_env_float(env, "KILL_AT_WARMUP") or 0),
             canary_corrupt=bool(_env_float(env, "CANARY_CORRUPT") or 0),
             autoscale_poll_delay_s=_env_float(env, "AUTOSCALE_POLL_DELAY_S"),
+            router_kill_after_polls=None if rkill is None else int(rkill),
+            coord_outage_at_s=_env_float(env, "COORD_OUTAGE_AT_S"),
+            coord_outage_s=5.0 if outage_s is None else outage_s,
             seed=int(_env_float(env, "SEED") or 0),
         )
 
     # -- hooks -------------------------------------------------------------
 
+    def in_outage(self) -> bool:
+        """True while the declared full-store unreachability window is
+        open (uptime in ``[coord_outage_at_s, coord_outage_at_s +
+        coord_outage_s)``)."""
+        if self.coord_outage_at_s is None:
+            return False
+        uptime = time.monotonic() - self._born
+        return (self.coord_outage_at_s
+                <= uptime
+                < self.coord_outage_at_s + self.coord_outage_s)
+
     def coord_op(self, op: str) -> None:
-        """Maybe delay, maybe raise — called before every coord RPC."""
+        """Maybe delay, maybe raise — called before every coord RPC.
+        During a declared outage window EVERY op raises: the fault fires
+        before the RPC leaves the process, so nothing can have half-
+        applied server-side — the retriable "connection refused" class,
+        unlike a real mid-RPC failure."""
+        if self.in_outage():
+            with self._lock:
+                self.injected["coord_outage"] += 1
+            raise FaultInjected(f"injected fault: coord outage ({op})")
         if not (self.coord_error_p or self.coord_delay_p):
             return
         with self._lock:
@@ -269,6 +343,28 @@ class FaultPlan:
             self.injected["autoscale_delay"] += 1
         time.sleep(self.autoscale_poll_delay_s)
 
+    def on_router_poll(self) -> None:
+        """Count one router ``_poll`` iteration; crash the router at the
+        configured count.  SIGKILL by default (live chaos: no finally
+        blocks, the assignment table simply vanishes); with
+        ``router_kill_raise`` it raises :class:`RouterKilled` instead so
+        an in-process harness (the simulator) can catch the crash and
+        drive the real recovery path."""
+        if self.router_kill_after_polls is None:
+            return
+        with self._lock:
+            self._router_polls += 1
+            n = self._router_polls
+        if n >= self.router_kill_after_polls:
+            with self._lock:
+                self.injected["router_kill"] += 1
+            if self.router_kill_raise:
+                # one-shot: recovery's own polls must not re-trip it
+                self.router_kill_after_polls = None
+                raise RouterKilled(
+                    f"injected fault: router killed at poll {n}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
 
 _INERT = FaultPlan()
 _plan: FaultPlan | None = None
@@ -332,3 +428,9 @@ def autoscale_poll() -> None:
     p = plan()
     if p.active:
         p.autoscale_poll()
+
+
+def on_router_poll() -> None:
+    p = plan()
+    if p.active:
+        p.on_router_poll()

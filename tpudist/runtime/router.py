@@ -154,9 +154,14 @@ log = get_logger(__name__)
 __all__ = ["Router", "ReplicaWorker", "build_tiny_lm",
            "launch_local_fleet", "scale_fleet", "stop_fleet",
            "exit_reports", "wait_live", "roll_weights", "wait_swapped",
-           "alloc_replica_indices", "request_drain", "drain_replicas"]
+           "alloc_replica_indices", "request_drain", "drain_replicas",
+           "JOURNAL_SCHEMA"]
 
 DEFAULT_NAMESPACE = "fleet"
+
+# version tag every {ns}/journal/* record carries (see docs/DESIGN.md
+# "Control-plane recovery" for the schema and commit-point rules)
+JOURNAL_SCHEMA = "tpudist.journal/1"
 
 
 # -- wire format (JSON over the KV store) ---------------------------------
@@ -249,6 +254,14 @@ class ReplicaWorker:
         self.swap_turn_timeout_s = float(swap_turn_timeout_s)
         self._inbox = f"{namespace}/inbox/{replica_id}/"
         self._served = 0
+        # coord-brownout degradation: completions that fail to commit
+        # (store unreachable) park here and flush on reconnect — the
+        # replica keeps decoding through the outage.  Bounded so a
+        # never-ending outage cannot grow memory without limit; at the
+        # bound the OLDEST is dropped (the router redispatches it after
+        # the outage, and greedy determinism re-produces it).
+        self._done_buf: list[tuple[str, bytes]] = []
+        self._done_buf_cap = 4096
         self._weights_version = 0
         self._roll: dict | None = None   # the in-progress swap-chain turn
         self._obs_version = obs.gauge("serve/weights_version",
@@ -408,33 +421,54 @@ class ReplicaWorker:
     def _pending_roll_requested(self) -> bool:
         return self._roll is not None and self._roll["requested"]
 
+    def _flush_done_buffer(self) -> None:
+        """Re-commit completions parked during a coord brownout, oldest
+        first; stop at the first failure (still down)."""
+        while self._done_buf:
+            key, payload = self._done_buf[0]
+            try:
+                self.client.set(key, payload)
+            except ConnectionError:
+                return
+            self._done_buf.pop(0)
+
     def _source(self):
         """One intake poll: ``None`` on a stop key (close and drain),
         else the inbox's requests in key order (the router's dispatch
         order — its keys are zero-padded sequence numbers).  Also the
         tick of the rolling-swap protocol — it rides the same poll
-        cadence the loop already guarantees."""
-        if (self.client.get(f"{self.ns}/stop") is not None
-                or self.client.get(
-                    f"{self.ns}/stop/{self.replica_id}") is not None):
-            return None
-        if self.snapshot_dir is not None:
-            self._check_weights_roll()
-        out = []
-        for key in sorted(self.client.keys(self._inbox)):
-            raw = self.client.get(key)
-            self.client.delete(key)
-            if raw is None:   # racing a router sweep of a presumed death
-                continue
-            try:
-                req = _decode_request(raw)
-            except (ValueError, KeyError) as e:
-                log.warning("replica %s: dropping undecodable request "
-                            "%s: %s", self.replica_id, key, e)
-                continue
-            if req.trace is not None:
-                self._traces[str(req.rid)] = req.trace
-            out.append(req)
+        cadence the loop already guarantees.
+
+        A coord outage mid-poll yields ``[]``, NOT death: in-flight
+        decode segments keep running and the poll retries on the
+        loop's next tick — replicas ride a brownout out (the buffered
+        done commits flush here too)."""
+        try:
+            self._flush_done_buffer()
+            if (self.client.get(f"{self.ns}/stop") is not None
+                    or self.client.get(
+                        f"{self.ns}/stop/{self.replica_id}") is not None):
+                return None
+            if self.snapshot_dir is not None:
+                self._check_weights_roll()
+            out = []
+            for key in sorted(self.client.keys(self._inbox)):
+                raw = self.client.get(key)
+                self.client.delete(key)
+                if raw is None:   # racing a sweep of a presumed death
+                    continue
+                try:
+                    req = _decode_request(raw)
+                except (ValueError, KeyError) as e:
+                    log.warning("replica %s: dropping undecodable "
+                                "request %s: %s",
+                                self.replica_id, key, e)
+                    continue
+                if req.trace is not None:
+                    self._traces[str(req.rid)] = req.trace
+                out.append(req)
+        except ConnectionError:
+            return []
         return out
 
     def _sink(self, comp) -> None:
@@ -449,8 +483,21 @@ class ReplicaWorker:
             tokens = (tokens + 1 if tokens.size
                       else np.asarray([1], np.int32))
             comp = dataclasses.replace(comp, tokens=tokens)
-        self.client.set(f"{self.ns}/done/{comp.rid}",
-                        _encode_completion(self.replica_id, comp))
+        payload = _encode_completion(self.replica_id, comp)
+        done_key = f"{self.ns}/done/{comp.rid}"
+        try:
+            self._flush_done_buffer()
+            if self._done_buf:   # still down: keep commit order
+                raise ConnectionError("coord store still unreachable")
+            self.client.set(done_key, payload)
+        except ConnectionError:
+            if len(self._done_buf) >= self._done_buf_cap:
+                dropped, _ = self._done_buf.pop(0)
+                log.warning("replica %s: done buffer full during coord "
+                            "outage; dropping oldest (%s) — the router "
+                            "will redispatch it", self.replica_id,
+                            dropped)
+            self._done_buf.append((done_key, payload))
         self._served += 1
         trace = self._traces.pop(str(comp.rid), None)
         if trace is not None:
@@ -538,6 +585,9 @@ class Router:
                  join_grace_s: float = 30.0,
                  degrade_max_new: int | None = None,
                  use_health: bool = True,
+                 journal: bool = True,
+                 compact_every: int = 50,
+                 outage_grace_s: float = 5.0,
                  clock=time.monotonic,
                  wall=time.time,
                  sleeper=time.sleep) -> None:
@@ -560,6 +610,24 @@ class Router:
         self.join_grace_s = float(join_grace_s)
         self.degrade_max_new = (None if degrade_max_new is None
                                 else int(degrade_max_new))
+        # crash recovery: journal request lifecycle to {ns}/journal/*
+        # (schema tpudist.journal/1) so a replacement router can rebuild
+        # the outstanding-request table with Router.recover().  Journal
+        # writes are best-effort (never block routing on a brownout);
+        # terminal records are compacted away every `compact_every`
+        # polls once delivered.
+        self.journal = bool(journal)
+        self.compact_every = int(compact_every)
+        # coord-brownout degradation: a poll that dies on ConnectionError
+        # marks the store down and is SKIPPED (no death verdicts on
+        # blind data); after reconnect, death verdicts for ever-live
+        # replicas are suppressed another `outage_grace_s` so leases
+        # that lapsed server-side during the outage can re-establish
+        self.outage_grace_s = float(outage_grace_s)
+        self._journal_docs: dict[str, dict] = {}
+        self._polls = 0
+        self._coord_down_since: float | None = None
+        self._outage_grace_until = float("-inf")
         self._health = (HealthMonitor(
             client=client, namespace=f"{namespace}/metrics",
             signal="serve/queue_wait_s", skew_threshold=4.0,
@@ -597,6 +665,20 @@ class Router:
         self._obs_rollbacks = obs.counter("router/rollbacks", unit="rolls")
         self._obs_degrade_clamped = obs.counter("router/degrade_clamped",
                                                 unit="reqs")
+        self._obs_recoveries = obs.counter("router/recoveries",
+                                           unit="recoveries")
+        self._obs_replays = obs.counter("router/recovered_replays",
+                                        unit="reqs")
+        self._obs_dup_terminals = obs.counter("router/dup_terminals",
+                                              unit="reqs")
+        self._obs_compactions = obs.counter("router/journal_compactions",
+                                            unit="records")
+        self._obs_orphans = obs.counter("router/orphans_swept",
+                                        unit="keys")
+        self._obs_outage_polls = obs.counter("router/outage_polls",
+                                             unit="polls")
+        self._obs_journal = obs.gauge("router/journal_records",
+                                      unit="records")
         self._obs_live = obs.gauge("router/replicas_live", unit="replicas")
         self._obs_outstanding = obs.gauge("router/outstanding", unit="reqs")
         self._obs_pool = obs.gauge("router/pool", unit="generation")
@@ -767,11 +849,102 @@ class Router:
             except ConnectionError:
                 pass
 
+    # -- crash-recovery journal --------------------------------------------
+    #
+    # One record per request at {ns}/journal/{key}, written full-record
+    # (idempotent) at each lifecycle transition.  Write-ordering
+    # invariants (see docs/DESIGN.md "Control-plane recovery"):
+    #
+    #   * dispatch: inbox set FIRST, then journal assigned-update — a
+    #     crash in the window leaves the record open-unassigned, so
+    #     recovery redispatches; under greedy determinism a resulting
+    #     double-serve commits an identical duplicate done key, which
+    #     consumption dedupes.
+    #   * terminal: read done key -> journal terminal (WITH tokens) ->
+    #     delete done key -> deliver.  Consumption is journaled before
+    #     the done key is destroyed, so "journal open + no done key"
+    #     always means the replica has not committed yet (safe to keep
+    #     waiting), never "the outcome was consumed and lost".
+    #
+    # Journal writes are best-effort: a brownout skips them (routing
+    # must not stall on the journal) and the record catches up on the
+    # next transition's full-record write.
+
+    def _journal_key(self, k: str) -> str:
+        return f"{self.ns}/journal/{k}"
+
+    def _journal_write(self, k: str) -> None:
+        if not self.journal:
+            return
+        doc = self._journal_docs.get(k)
+        if doc is None:
+            return
+        try:
+            self.client.set(self._journal_key(k),
+                            json.dumps(doc).encode())
+        except ConnectionError:
+            pass
+
+    def _journal_submit(self, entries: dict[str, dict]) -> None:
+        """Journal every request at submit time (terminal=None,
+        unassigned), so recovery needs only the store — arrival
+        schedules and caller rids ride in the record."""
+        if not self.journal:
+            return
+        for k, e in entries.items():
+            req = e["req"]
+            self._journal_docs[k] = {
+                "schema": JOURNAL_SCHEMA,
+                "req": json.loads(_encode_request(k, req).decode()),
+                "rid": str(req.rid),
+                "assigned": None,
+                "attempts": 0,
+                "at": float(e.get("at", 0.0)),
+                "terminal": None,
+            }
+            self._journal_write(k)
+        self._obs_journal.set(len(self._journal_docs))
+
+    def _journal_assign(self, k: str, e: dict) -> None:
+        doc = self._journal_docs.get(k)
+        if doc is None:
+            return
+        doc["assigned"] = e["assigned"]
+        doc["attempts"] = int(e["attempts"])
+        self._journal_write(k)
+
+    def _journal_terminal(self, k: str, reason: str, tokens,
+                          serve_reason: str | None = None) -> None:
+        doc = self._journal_docs.get(k)
+        if doc is None:
+            return
+        doc["terminal"] = reason
+        doc["serve_reason"] = serve_reason
+        doc["tokens"] = np.asarray(tokens).astype(int).tolist()
+        doc["assigned"] = None
+        self._journal_write(k)
+
+    def _compact_journal(self, done: dict) -> None:
+        """Delete journal records for DELIVERED terminals — the journal
+        stays bounded by the outstanding set, not by run length."""
+        if not self.journal:
+            return
+        for k in [k for k, doc in self._journal_docs.items()
+                  if doc.get("terminal") is not None and k in done]:
+            try:
+                self.client.delete(self._journal_key(k))
+            except ConnectionError:
+                continue   # keep the doc; retried next compaction
+            del self._journal_docs[k]
+            self._obs_compactions.inc()
+        self._obs_journal.set(len(self._journal_docs))
+
     # -- the event loop ----------------------------------------------------
 
     def run(self, requests: Sequence[Any], *,
             timeout_s: float = 120.0,
-            arrivals: Sequence[float] | None = None) -> list[Any]:
+            arrivals: Sequence[float] | None = None,
+            on_complete=None) -> list[Any]:
         """Route ``requests`` across the fleet; returns one
         :class:`~tpudist.models.serving.Completion` per request, in
         FINISH order, with each completion's ``rid`` restored to the
@@ -783,54 +956,211 @@ class Router:
         each request becomes visible to dispatch — and its trace is
         minted — only once its offset elapses, so a scenario's diurnal
         ramp or flash crowd hits the fleet with its real shape instead
-        of as one up-front batch."""
-        from tpudist.models.serving import Completion
+        of as one up-front batch.
 
+        ``on_complete(key, completion)`` is invoked as each terminal
+        decision lands (AFTER its journal terminal record) — the
+        incremental delivery hook the ``--route`` CLI uses to stream
+        results to disk so a crashed router's successor knows what was
+        already delivered."""
         if arrivals is not None and len(arrivals) != len(requests):
             raise ValueError(
                 f"arrivals ({len(arrivals)}) must match requests "
                 f"({len(requests)})")
         entries: dict[str, dict] = {}
-        order: list[str] = []
         for i, req in enumerate(requests):
             key = f"{self._seq:08d}"
             self._seq += 1
             at = 0.0 if arrivals is None else max(0.0, float(arrivals[i]))
             entries[key] = {"req": req, "assigned": None, "attempts": 0,
                             "trace": None, "at": at, "arrived": False}
-            order.append(key)
-        done: dict[str, Completion] = {}
-        finish: list[str] = []
+        self._journal_submit(entries)
+        return self._drive(entries, timeout_s=timeout_s,
+                           on_complete=on_complete)
 
-        def complete(key: str, comp: Completion) -> None:
+    def recover(self, *, timeout_s: float = 120.0,
+                delivered: Sequence[str] = (),
+                on_complete=None) -> list[Any]:
+        """Rebuild the outstanding-request table from ``{ns}/journal/*``
+        + done keys and drive it to completion — the crashed-router
+        failover path.  Live replicas are RE-ADOPTED without a restart
+        (their open assignments stay assigned; their committed done
+        keys are consumed normally); assignments to dead replicas flow
+        through the ordinary death-redispatch machinery on the first
+        poll; orphaned inbox entries (assigned elsewhere, or already
+        terminal) are swept; terminal-journaled requests are replayed
+        from their stored tokens — unless their caller rid is in
+        ``delivered`` (rids the previous router already delivered, e.g.
+        read back from the ``--results`` file) — and any duplicate done
+        key they left behind is deleted and counted
+        (``router/dup_terminals``).  Returns replayed + newly finished
+        completions in finish order."""
+        from tpudist.models.serving import Completion
+
+        self._obs_recoveries.inc()
+        seen_delivered = {str(r) for r in delivered}
+        prefix = f"{self.ns}/journal/"
+        records: dict[str, dict] = {}
+        for key in self.client.keys(prefix):
+            raw = self.client.get(key)
+            if raw is None:
+                continue
+            try:
+                doc = json.loads(raw.decode())
+            except ValueError:
+                continue
+            if doc.get("schema") != JOURNAL_SCHEMA:
+                continue
+            records[key[len(prefix):]] = doc
+        # never mint a key that could collide with a journaled one
+        for k in records:
+            try:
+                self._seq = max(self._seq, int(k) + 1)
+            except ValueError:
+                pass
+        self._journal_docs = dict(records)
+        self._obs_journal.set(len(records))
+        entries: dict[str, dict] = {}
+        replays: list[tuple[str, Any]] = []
+        for k in sorted(records):
+            doc = records[k]
+            rid = str(doc.get("rid", k))
+            if doc.get("terminal") is not None:
+                # the decision was made (and journaled) before the
+                # crash: replay it from the stored tokens rather than
+                # re-running, and delete the duplicate done key a
+                # falsely-presumed-dead replica may have left
+                try:
+                    if self.client.get(f"{self.ns}/done/{k}") is not None:
+                        self.client.delete(f"{self.ns}/done/{k}")
+                        self._obs_dup_terminals.inc()
+                except ConnectionError:
+                    pass
+                if rid in seen_delivered:
+                    # terminal AND already delivered: nothing left to
+                    # do — compact the record away right now
+                    try:
+                        self.client.delete(self._journal_key(k))
+                        del self._journal_docs[k]
+                        self._obs_compactions.inc()
+                    except ConnectionError:
+                        pass
+                    continue
+                replays.append((k, Completion(
+                    rid=rid,
+                    prompt=np.asarray(doc["req"]["prompt"], np.int32),
+                    tokens=np.asarray(doc.get("tokens", ()), np.int32),
+                    reason=doc["terminal"])))
+                continue
+            req = dataclasses.replace(
+                _decode_request(json.dumps(doc["req"]).encode()),
+                rid=rid)
+            tc = TraceContext.mint(k)
+            entries[k] = {"req": req,
+                          "assigned": doc.get("assigned"),
+                          "attempts": int(doc.get("attempts", 0)),
+                          "trace": tc, "at": 0.0, "arrived": True}
+            obs.events.record("recover_adopt", trace=tc.trace_id,
+                              key=k, rid=rid,
+                              assigned=doc.get("assigned"),
+                              attempts=int(doc.get("attempts", 0)))
+        if replays:
+            self._obs_replays.inc(len(replays))
+        # sweep orphaned inbox entries: anything not matching an open
+        # journal assignment is residue of the crashed router (a
+        # terminal request's leftover dispatch, or a dispatch superseded
+        # by a redispatch) — a replica must not serve it again
+        inbox_prefix = f"{self.ns}/inbox/"
+        for key in self.client.keys(inbox_prefix):
+            rid_part, _, k = key[len(inbox_prefix):].partition("/")
+            e = entries.get(k)
+            if e is not None and e["assigned"] == rid_part:
+                continue
+            try:
+                self.client.delete(key)
+                self._obs_orphans.inc()
+            except ConnectionError:
+                pass
+        log.info("router: recovered %d open + %d terminal journal "
+                 "records (%d replayed)", len(entries),
+                 len(records) - len(entries), len(replays))
+        return self._drive(entries, timeout_s=timeout_s,
+                           on_complete=on_complete, preloaded=replays)
+
+    def _drive(self, entries: dict[str, dict], *, timeout_s: float,
+               on_complete=None, preloaded: Sequence[tuple] = ()
+               ) -> list[Any]:
+        done: dict[str, Any] = {}
+        finish: list[str] = []
+        remaining = set(entries)
+
+        def complete(key: str, comp) -> None:
             done[key] = comp
             finish.append(key)
+            remaining.discard(key)
             self._obs_completions.inc()
+            if on_complete is not None:
+                on_complete(key, comp)
 
+        for k, comp in preloaded:
+            complete(k, comp)
         start = self._clock()
         deadline = start + timeout_s
-        while len(done) < len(entries):
+        while remaining:
             if self._clock() > deadline:
                 raise TimeoutError(
-                    f"router: {len(entries) - len(done)} of "
+                    f"router: {len(remaining)} of "
                     f"{len(entries)} requests unresolved after "
                     f"{timeout_s:.0f}s (live replicas: "
-                    f"{sorted(self.live())})")
+                    f"{sorted(self._live_or(set()))})")
             progressed = self._arrive(entries, start) > 0
-            progressed = self._poll(entries, done, complete) or progressed
-            self._obs_outstanding.set(len(entries) - len(done))
+            try:
+                progressed = (self._poll(entries, done, complete)
+                              or progressed)
+            except ConnectionError as err:
+                # coord brownout: poll blind — keep in-flight decodes
+                # running, make NO death verdicts, and retry.  The
+                # store being unreachable is stale-not-lost, fleet-wide.
+                if self._coord_down_since is None:
+                    self._coord_down_since = self._clock()
+                    log.warning("router: coord store unreachable (%s); "
+                                "polling blind until it returns", err)
+                self._obs_outage_polls.inc()
+                progressed = False
+            else:
+                if self._coord_down_since is not None:
+                    gap = self._clock() - self._coord_down_since
+                    self._outage_grace_until = (self._clock()
+                                                + self.outage_grace_s)
+                    self._coord_down_since = None
+                    log.info("router: coord store back after %.1fs; "
+                             "suppressing death verdicts for %.1fs",
+                             gap, self.outage_grace_s)
+            self._polls += 1
+            if (self.journal and self.compact_every > 0
+                    and self._polls % self.compact_every == 0):
+                self._compact_journal(done)
+            self._obs_outstanding.set(len(remaining))
             if not progressed:
                 self._sleep(self.poll_s)
         # sweep duplicate done keys (a presumed-dead replica may have
         # committed after its redispatch; greedy determinism makes the
-        # duplicate identical, so it is just deleted)
+        # duplicate identical, so it is just deleted), then compact the
+        # journal to empty — every record is delivered now
         for key in entries:
             try:
                 self.client.delete(f"{self.ns}/done/{key}")
             except ConnectionError:
                 pass
+        self._compact_journal(done)
         self._obs_outstanding.set(0)
         return [done[k] for k in finish]
+
+    def _live_or(self, fallback: set[str]) -> set[str]:
+        try:
+            return self.live()
+        except ConnectionError:
+            return fallback
 
     def _arrive(self, entries: dict[str, dict], start: float) -> int:
         """Admit entries whose arrival offset has elapsed: mint the
@@ -868,6 +1198,7 @@ class Router:
               complete) -> bool:
         from tpudist.models.serving import Completion
 
+        faults.on_router_poll()
         progressed = False
         regs = self.replicas()
         live = self.live() - self._dead
@@ -910,7 +1241,6 @@ class Router:
             raw = self.client.get(key)
             if raw is None:
                 continue
-            self.client.delete(key)
             payload = json.loads(raw.decode())
             req = e["req"]
             comp = Completion(
@@ -922,13 +1252,21 @@ class Router:
                 # replica-side load shed: re-route, don't surface —
                 # the request was admitted to the FLEET, and some other
                 # replica (or this one, later) can still serve it
+                self.client.delete(key)
                 e["assigned"] = None
+                self._journal_assign(k, e)
                 self._obs_rerouted.inc()
                 self._backoff[payload.get("replica", "")] = (
                     self._clock() + self.reject_backoff_s)
                 self._decide("rejected", e,
                              replica=payload.get("replica"))
             else:
+                # commit-point ordering: journal the terminal (WITH the
+                # tokens) before destroying the done key, so a crash in
+                # between leaves a replayable record instead of an
+                # outcome that was consumed and lost
+                self._journal_terminal(k, comp.reason, comp.tokens)
+                self.client.delete(key)
                 complete(k, comp)
                 self._decide("completed", e, serve_reason=comp.reason,
                              replica=payload.get("replica"),
@@ -956,6 +1294,13 @@ class Router:
         for rid in sorted((assigned_to | set(regs)) - self._dead):
             lost = rid in verdict_lost
             if rid in live and not lost:
+                continue
+            if now_mono < self._outage_grace_until \
+                    and rid in self._ever_live:
+                # post-brownout grace: leases lapsed server-side while
+                # the STORE was down; give every ever-live replica one
+                # grace window to re-beat before calling it dead — an
+                # outage must not become a mass-death redispatch storm
                 continue
             if not lost and rid not in self._ever_live:
                 # registration→first-heartbeat grace: a slow-warming
@@ -991,6 +1336,7 @@ class Router:
                     continue
                 e["assigned"] = None
                 e["attempts"] += 1
+                self._journal_assign(k, e)
                 progressed = True
                 self._obs_redispatched.inc()
                 trace = e.get("trace")
@@ -1000,6 +1346,7 @@ class Router:
                                       attempts=e["attempts"])
                 if e["attempts"] > self.max_redispatch:
                     req = e["req"]
+                    self._journal_terminal(k, "failed", ())
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32),
@@ -1059,6 +1406,7 @@ class Router:
                     continue
                 req = e["req"]
                 if req.deadline_s is not None and wall > req.deadline_s:
+                    self._journal_terminal(k, "timeout", ())
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32), reason="timeout"))
@@ -1072,6 +1420,7 @@ class Router:
                     # already prefilled once (redispatch) is sunk cost
                     # and races the deadline instead.
                     self._obs_slo_shed.inc()
+                    self._journal_terminal(k, "shed", ())
                     complete(k, Completion(
                         rid=req.rid, prompt=np.asarray(req.prompt),
                         tokens=np.zeros((0,), np.int32), reason="shed"))
@@ -1102,6 +1451,10 @@ class Router:
                 self.client.set(f"{self.ns}/inbox/{rid}/{k}",
                                 _encode_request(k, send))
                 e["assigned"] = rid
+                # inbox FIRST, then journal: a crash in between leaves
+                # the record open-unassigned -> recovery redispatches
+                # (a double-serve dedupes at done-key consumption)
+                self._journal_assign(k, e)
                 assigned_counts[rid] = assigned_counts.get(rid, 0) + 1
                 progressed = True
                 self._obs_dispatched.inc()
@@ -1595,11 +1948,62 @@ def exit_reports(client: CoordClient, *,
     return out
 
 
-# -- replica CLI -----------------------------------------------------------
+# -- replica / router CLI --------------------------------------------------
+
+def _run_route_mode(args) -> None:  # pragma: no cover - subprocess entry
+    """``--route``: drive a Router over an existing fleet from a
+    requests file, streaming one JSONL result line per completion
+    (append + flush, so a SIGKILLed router's partial output survives).
+    ``--recover`` rebuilds from the journal instead of submitting: the
+    results file read-back tells the recovered router which terminals
+    the crashed one already delivered — the failover path of the
+    module docstring, end to end."""
+    from tpudist.models.serving import Request
+
+    host, port = args.coord.rsplit(":", 1)
+    client = CoordClient(host, int(port))
+    router = Router(client, namespace=args.namespace,
+                    poll_s=args.poll_s,
+                    lost_after_s=args.lost_after)
+    results = Path(args.results)
+
+    def deliver(key: str, comp) -> None:
+        with results.open("a") as fh:
+            fh.write(json.dumps({
+                "rid": str(comp.rid),
+                "tokens": np.asarray(comp.tokens).astype(int).tolist(),
+                "reason": comp.reason}) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    if args.recover:
+        delivered = []
+        if results.exists():
+            for line in results.read_text().splitlines():
+                if line.strip():
+                    delivered.append(str(json.loads(line)["rid"]))
+        comps = router.recover(timeout_s=args.timeout,
+                               delivered=delivered,
+                               on_complete=deliver)
+    else:
+        docs = json.loads(Path(args.requests).read_text())
+        reqs = [Request(prompt=np.asarray(d["prompt"], np.int32),
+                        max_new_tokens=int(d["max_new_tokens"]),
+                        rid=str(d["rid"]),
+                        deadline_s=d.get("deadline_s"),
+                        priority=int(d.get("priority", 0)))
+                for d in docs]
+        comps = router.run(reqs, timeout_s=args.timeout,
+                           on_complete=deliver)
+    log.info("router: %s finished %d completions",
+             "recovery" if args.recover else "route", len(comps))
+
 
 def main() -> None:  # pragma: no cover - subprocess entry point
     """Run one serve replica: ``python -m tpudist.runtime.router --coord
-    HOST:PORT --replica-id r0 --rank 0 [model/serve args]``.
+    HOST:PORT --replica-id r0 --rank 0 [model/serve args]`` — or, with
+    ``--route``, the ROUTER side from a requests file (``--recover``
+    resumes a crashed router from its journal).
 
     Builds the deterministic tiny LM (same ``--seed`` across the fleet
     => identical weights => redispatch exact-match) and serves until a
@@ -1608,10 +2012,24 @@ def main() -> None:  # pragma: no cover - subprocess entry point
     nothing to wire here."""
     import argparse
 
-    ap = argparse.ArgumentParser(description="tpudist serve replica")
+    ap = argparse.ArgumentParser(
+        description="tpudist serve replica / router")
     ap.add_argument("--coord", required=True, help="coord server host:port")
-    ap.add_argument("--replica-id", required=True)
-    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--route", action="store_true",
+                    help="run the ROUTER side instead of a replica")
+    ap.add_argument("--recover", action="store_true",
+                    help="with --route: rebuild from {ns}/journal/* "
+                         "instead of submitting --requests")
+    ap.add_argument("--requests", default="",
+                    help="route mode: JSON file of request docs "
+                         "(rid, prompt, max_new_tokens, ...)")
+    ap.add_argument("--results", default="",
+                    help="route mode: JSONL file results append to")
+    ap.add_argument("--poll-s", type=float, default=0.02)
+    ap.add_argument("--lost-after", type=float, default=5.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--replica-id", default=None)
+    ap.add_argument("--rank", type=int, default=None)
     ap.add_argument("--namespace", default=DEFAULT_NAMESPACE)
     ap.add_argument("--ttl", type=float, default=2.0)
     ap.add_argument("--vocab", type=int, default=64)
@@ -1652,6 +2070,16 @@ def main() -> None:  # pragma: no cover - subprocess entry point
                          "before proceeding anyway (dead-holder "
                          "liveness fallback)")
     args = ap.parse_args()
+
+    if args.route or args.recover:
+        if not args.results:
+            ap.error("--route/--recover require --results")
+        if not args.recover and not args.requests:
+            ap.error("--route requires --requests")
+        _run_route_mode(args)
+        return
+    if args.replica_id is None or args.rank is None:
+        ap.error("replica mode requires --replica-id and --rank")
 
     from tpudist.models.serving import ServeLoop
 

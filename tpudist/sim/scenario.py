@@ -33,6 +33,11 @@ __all__ = ["ScenarioSpec", "Envelope", "BUILTIN", "builtin", "names"]
 ARRIVAL_KINDS = ("constant", "diurnal", "flash_crowd")
 PROMPT_KINDS = ("uniform", "longtail")
 DEADLINE_KINDS = ("none", "uniform", "adversarial")
+# the FaultScript verbs, mirroring the TPUDIST_FAULT_* env knobs:
+# KILL_AFTER_SEGMENTS, HEARTBEAT_STOP_AFTER_S, COORD_OUTAGE_AT_S/_S,
+# ROUTER_KILL_AFTER_POLLS respectively
+FAULT_KINDS = ("kill_replica", "drop_heartbeats", "coord_brownout",
+               "kill_router")
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -129,6 +134,38 @@ def _validate_tenant(t: dict) -> None:
              "tenant prefix_tokens must be >= 0")
 
 
+def _validate_fault(f: dict) -> None:
+    _check_keys("fault", f,
+                {"kind", "at_s", "for_s", "rid", "at_poll"}, {"kind"})
+    kind = f["kind"]
+    _require(kind in FAULT_KINDS,
+             f"fault.kind {kind!r} not in {FAULT_KINDS}")
+    if kind == "kill_replica":
+        _check_keys("fault(kill_replica)", f, {"kind", "at_s", "rid"},
+                    {"kind", "at_s", "rid"})
+        _require(float(f["at_s"]) >= 0, "kill_replica needs at_s >= 0")
+    elif kind == "drop_heartbeats":
+        _check_keys("fault(drop_heartbeats)", f,
+                    {"kind", "at_s", "for_s", "rid"},
+                    {"kind", "at_s", "for_s", "rid"})
+        _require(float(f["at_s"]) >= 0,
+                 "drop_heartbeats needs at_s >= 0")
+        _require(float(f["for_s"]) > 0,
+                 "drop_heartbeats needs for_s > 0")
+    elif kind == "coord_brownout":
+        _check_keys("fault(coord_brownout)", f,
+                    {"kind", "at_s", "for_s"}, {"kind", "at_s", "for_s"})
+        _require(float(f["at_s"]) >= 0,
+                 "coord_brownout needs at_s >= 0")
+        _require(float(f["for_s"]) > 0,
+                 "coord_brownout needs for_s > 0")
+    elif kind == "kill_router":
+        _check_keys("fault(kill_router)", f, {"kind", "at_poll"},
+                    {"kind", "at_poll"})
+        _require(int(f["at_poll"]) >= 1,
+                 "kill_router needs at_poll >= 1")
+
+
 _FLEET_DEFAULTS: dict[str, Any] = {
     "replicas": 1,
     "seconds_per_token": 0.002,
@@ -157,6 +194,9 @@ class Envelope:
     max_scale_ups: int | None = None
     min_drains: int = 0
     max_priority_bad: int | None = None
+    max_burn_rate_300s: float | None = None
+    max_replica_deaths: int | None = None
+    min_router_recoveries: int = 0
     decisions: dict = field(default_factory=dict)
 
     @classmethod
@@ -202,6 +242,20 @@ class Envelope:
             if pb > self.max_priority_bad:
                 bad.append(f"priority_bad={pb:g} > "
                            f"{self.max_priority_bad}")
+        if self.max_burn_rate_300s is not None:
+            br = num("burn_rate_300s")
+            if br > self.max_burn_rate_300s:
+                bad.append(f"burn_rate_300s={br:.4g} > "
+                           f"{self.max_burn_rate_300s}")
+        if self.max_replica_deaths is not None:
+            deaths = num("replica_deaths")
+            if deaths > self.max_replica_deaths:
+                bad.append(f"replica_deaths={deaths:g} > "
+                           f"{self.max_replica_deaths}")
+        recov = num("router_recoveries")
+        if recov < self.min_router_recoveries:
+            bad.append(f"router_recoveries={recov:g} < min "
+                       f"{self.min_router_recoveries}")
         for reason, bound in self.decisions.items():
             v = num(f"decisions_{reason}")
             lo, hi = bound.get("min"), bound.get("max")
@@ -225,6 +279,7 @@ class ScenarioSpec:
         default_factory=lambda: {"kind": "uniform", "lo": 8, "hi": 24})
     deadline: dict = field(default_factory=lambda: {"kind": "none"})
     tenants: tuple = ()
+    faults: tuple = ()
     seed: int = 0
     fleet: dict = field(default_factory=dict)
     envelope: Envelope = field(default_factory=Envelope)
@@ -251,6 +306,11 @@ class ScenarioSpec:
         _validate_deadline(self.deadline)
         for t in self.tenants:
             _validate_tenant(t)
+        for f in self.faults:
+            _validate_fault(f)
+        _require(sum(1 for f in self.faults
+                     if f["kind"] == "kill_router") <= 1,
+                 "at most one kill_router fault per scenario")
         _check_keys("fleet", self.fleet, set(_FLEET_DEFAULTS))
         merged = {**_FLEET_DEFAULTS, **self.fleet}
         _require(int(merged["replicas"]) >= 1, "fleet.replicas must be >= 1")
@@ -259,6 +319,7 @@ class ScenarioSpec:
         # frozen dataclass: route the normalized fleet through __setattr__
         object.__setattr__(self, "fleet", merged)
         object.__setattr__(self, "tenants", tuple(self.tenants))
+        object.__setattr__(self, "faults", tuple(self.faults))
 
     @classmethod
     def from_dict(cls, d: dict) -> "ScenarioSpec":
@@ -279,6 +340,7 @@ class ScenarioSpec:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["tenants"] = list(self.tenants)
+        d["faults"] = list(self.faults)
         return d
 
 
@@ -394,6 +456,69 @@ BUILTIN: dict[str, dict] = {
             # reason-to-decide regression
             "decisions": {"failed": {"max": 0},
                           "completed": {"min": 150}},
+        },
+    },
+    "replica_death_storm": {
+        "name": "replica_death_storm",
+        "duration_s": 45.0,
+        "arrival": {"kind": "constant", "rate": 30.0},
+        "seed": 17,
+        # no scale-DOWNs here: the pre-kill fleet is lightly loaded and
+        # a drain before the kills would leave zero replicas publishing
+        # metrics (nothing for breach detection to see) — this scenario
+        # gates death recovery, not idle drain
+        "fleet": {"replicas": 3,
+                  "autoscale": {**_AUTOSCALE_FAST, "idle_polls": 200}},
+        # two of three replicas die mid-run: the survivor saturates,
+        # the router redispatches every orphaned request, and the
+        # autoscaler must buy the capacity back
+        "faults": [
+            {"kind": "kill_replica", "at_s": 5.0, "rid": "r1"},
+            {"kind": "kill_replica", "at_s": 7.0, "rid": "r2"},
+        ],
+        "envelope": {
+            "max_lost": 0,
+            "max_replica_deaths": 2,
+            "min_scale_ups": 1,
+            "max_recovery_s": 45.0,
+            "max_burn_rate_300s": 40.0,
+            "decisions": {"failed": {"max": 0}},
+        },
+    },
+    "router_failover": {
+        "name": "router_failover",
+        "duration_s": 40.0,
+        "arrival": {"kind": "flash_crowd", "base_rate": 5.0,
+                    "spike_rate": 60.0, "spike_at_s": 8.0,
+                    "spike_width_s": 4.0},
+        "seed": 18,
+        "fleet": {"replicas": 2, "autoscale": dict(_AUTOSCALE_FAST)},
+        # the router dies mid-spike (~poll 200 at 0.05 s cadence); a
+        # fresh router must rebuild its table from the journal, re-adopt
+        # the live replicas, and finish every request
+        "faults": [{"kind": "kill_router", "at_poll": 200}],
+        "envelope": {
+            "max_lost": 0,
+            "min_router_recoveries": 1,
+            "decisions": {"failed": {"max": 0},
+                          "completed": {"min": 250}},
+        },
+    },
+    "coord_brownout": {
+        "name": "coord_brownout",
+        "duration_s": 35.0,
+        "arrival": {"kind": "constant", "rate": 8.0},
+        "seed": 19,
+        "fleet": {"replicas": 2, "autoscale": dict(_AUTOSCALE_FAST)},
+        # the coord store goes dark for 6 s: in-flight decode keeps
+        # running, completions buffer and flush on reconnect, and
+        # NOBODY gets declared dead (stale, not lost)
+        "faults": [{"kind": "coord_brownout", "at_s": 8.0, "for_s": 6.0}],
+        "envelope": {
+            "max_lost": 0,
+            "max_replica_deaths": 0,
+            "max_burn_rate_300s": 25.0,
+            "decisions": {"failed": {"max": 0}},
         },
     },
 }

@@ -38,6 +38,7 @@ import numpy as np
 
 from tpudist import obs
 from tpudist.obs.registry import values_to_hist
+from tpudist.runtime import faults
 from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
 from tpudist.runtime.router import Router, _decode_request
 from tpudist.sim.fabric import SimFabric
@@ -109,6 +110,7 @@ class SimReplica:
         self.publish_interval_s = float(publish_interval_s)
         self.wait_window_s = float(wait_window_s)
         self.alive = True
+        self.killed = False
         self.served = 0
         self.all_waits: list[float] = []          # every queue wait (sim s)
         self._live = False
@@ -118,6 +120,11 @@ class SimReplica:
         self._cur: tuple | None = None            # (req, finish_at)
         self._waits: list[tuple[float, float]] = []   # (t, wait) window
         self._next_pub = self._live_at
+        # coord brownout: commits that can't reach the fabric park here
+        # and flush on the next step after the window — the SimReplica
+        # mirror of ReplicaWorker's bounded done buffer
+        self._done_buf: list[tuple[str, bytes]] = []
+        self._hb_resume_at: float | None = None
         # registration precedes the first heartbeat, exactly like a real
         # joiner mid-warmup (the router's join grace covers this window)
         import json
@@ -130,6 +137,27 @@ class SimReplica:
     def poll(self):
         return None if self.alive else 0
 
+    # -- chaos (the FaultScript verbs) -------------------------------------
+
+    def kill(self) -> None:
+        """SIGKILL equivalent: the lease lapses (server-side TTL), the
+        consumed-but-unserved queue vanishes, the registration stays as
+        residue — the router's death path sweeps and redispatches."""
+        self.alive = False
+        self.killed = True
+        self._queue.clear()
+        self._cur = None
+        self._done_buf.clear()
+        self.fabric.down(f"{self.ns}:{self.rid}")
+
+    def drop_heartbeats(self, for_s: float) -> None:
+        """HEARTBEAT_STOP equivalent: the lease lapses but the replica
+        keeps serving — the false-positive-death shape whose duplicate
+        done writes the router's consumption dedupes.  The lease
+        returns after ``for_s`` virtual seconds."""
+        self.fabric.down(f"{self.ns}:{self.rid}")
+        self._hb_resume_at = self.clock.monotonic() + float(for_s)
+
     # -- service model -----------------------------------------------------
 
     def _service_s(self, req) -> float:
@@ -137,12 +165,28 @@ class SimReplica:
         return (self.prefill_s + prompt * self.prefill_per_token_s
                 + int(req.max_new_tokens) * self.spt)
 
+    def _flush_done_buffer(self) -> None:
+        while self._done_buf:
+            key, payload = self._done_buf[0]
+            try:
+                self.fabric.set(key, payload)
+            except ConnectionError:
+                return
+            self._done_buf.pop(0)
+
     def _commit(self, req, reason: str, tokens: list[int]) -> None:
         import json
-        self.fabric.set(
-            f"{self.ns}/done/{req.rid}",
-            json.dumps({"key": str(req.rid), "tokens": tokens,
-                        "reason": reason, "replica": self.rid}).encode())
+        payload = json.dumps(
+            {"key": str(req.rid), "tokens": tokens,
+             "reason": reason, "replica": self.rid}).encode()
+        key = f"{self.ns}/done/{req.rid}"
+        try:
+            self._flush_done_buffer()
+            if self._done_buf:   # still in the outage: keep order
+                raise ConnectionError("sim coord outage")
+            self.fabric.set(key, payload)
+        except ConnectionError:
+            self._done_buf.append((key, payload))
         self.served += 1
         if req.trace is not None:
             obs.events.record("done_commit", trace=req.trace.trace_id,
@@ -170,39 +214,57 @@ class SimReplica:
         if self._waits:
             snap["histograms"]["serve/queue_wait_s"] = values_to_hist(
                 [w for _, w in self._waits], unit="s")
-        self.fabric.set(f"{self.ns}/metrics/{self.rank}",
-                        json.dumps(snap).encode())
+        try:
+            self.fabric.set(f"{self.ns}/metrics/{self.rank}",
+                            json.dumps(snap).encode())
+        except ConnectionError:
+            pass   # latest-wins snapshots: the next publish catches up
         self._next_pub = now + self.publish_interval_s
 
     def step(self) -> None:
         """Advance the replica to the clock's current instant: go live
         after warmup, consume the inbox, finish/start service, publish
-        metrics, and run the graceful close path once stopped."""
+        metrics, and run the graceful close path once stopped.  A coord
+        brownout makes the fabric verbs raise; the replica rides it out
+        exactly like a real worker — keep serving what it has, buffer
+        the commits, skip the polls."""
         if not self.alive:
             return
         now = self.clock.monotonic()
         if now < self._live_at:
             return
+        if (self._hb_resume_at is not None
+                and now >= self._hb_resume_at):
+            # the dropped heartbeat returns: the lease re-establishes
+            self.fabric.up(f"{self.ns}:{self.rid}")
+            self._hb_resume_at = None
         if not self._live:
             self._live = True
             self.fabric.up(f"{self.ns}:{self.rid}")
-            self._publish()
+            try:
+                self._publish()
+            except ConnectionError:
+                pass
 
-        if (self.fabric.get(f"{self.ns}/stop") is not None
-                or self.fabric.get(f"{self.ns}/stop/{self.rid}")
-                is not None):
-            self._stopping = True
+        self._flush_done_buffer()
+        try:
+            if (self.fabric.get(f"{self.ns}/stop") is not None
+                    or self.fabric.get(f"{self.ns}/stop/{self.rid}")
+                    is not None):
+                self._stopping = True
 
-        # consume the inbox through the real decoder (also the final
-        # sweep while stopping: zero-loss drain means nothing accepted
-        # is ever abandoned)
-        inbox = f"{self.ns}/inbox/{self.rid}/"
-        for key in sorted(self.fabric.keys(inbox)):
-            raw = self.fabric.get(key)
-            self.fabric.delete(key)
-            if raw is None:
-                continue
-            self._queue.append((_decode_request(raw), now))
+            # consume the inbox through the real decoder (also the final
+            # sweep while stopping: zero-loss drain means nothing
+            # accepted is ever abandoned)
+            inbox = f"{self.ns}/inbox/{self.rid}/"
+            for key in sorted(self.fabric.keys(inbox)):
+                raw = self.fabric.get(key)
+                self.fabric.delete(key)
+                if raw is None:
+                    continue
+                self._queue.append((_decode_request(raw), now))
+        except ConnectionError:
+            inbox = f"{self.ns}/inbox/{self.rid}/"
 
         # serve: finish whatever is due, start whatever fits — several
         # per step when service times are shorter than the quantum
@@ -234,13 +296,17 @@ class SimReplica:
         if now >= self._next_pub:
             self._publish()
 
-        if (self._stopping and self._cur is None and not self._queue
-                and not self.fabric.keys(inbox)):
-            # clean drain exit: the lease lapses; the autoscaler's
-            # sweep (or the router's drain-departure path) handles the
-            # coordination residue, same as a real close
-            self.fabric.down(f"{self.ns}:{self.rid}")
-            self.alive = False
+        try:
+            if (self._stopping and self._cur is None and not self._queue
+                    and not self._done_buf
+                    and not self.fabric.keys(inbox)):
+                # clean drain exit: the lease lapses; the autoscaler's
+                # sweep (or the router's drain-departure path) handles
+                # the coordination residue, same as a real close
+                self.fabric.down(f"{self.ns}:{self.rid}")
+                self.alive = False
+        except ConnectionError:
+            pass   # can't verify an empty inbox blind; close next step
 
 
 class FleetSim:
@@ -261,18 +327,27 @@ class FleetSim:
         self.quantum_s = float(quantum_s)
         fleet = spec.fleet
         self.vc = VirtualClock()
-        self.fabric = SimFabric()
+        self.fabric = SimFabric(clock=self.vc.monotonic)
         self.ns = f"sim/{spec.name}"
         self.replicas: list[SimReplica] = []
         self._next_rank = 0
+        # the declarative FaultScript: brownout windows arm the fabric
+        # up front; timed replica faults queue for _advance to fire;
+        # a router kill arms the process fault plan at run() time
+        self._router_kill_poll: int | None = None
+        self._fault_due: list[dict] = []
+        for f in getattr(spec, "faults", ()):
+            if f["kind"] == "coord_brownout":
+                self.fabric.add_outage(f["at_s"],
+                                       f["at_s"] + f["for_s"])
+            elif f["kind"] == "kill_router":
+                self._router_kill_poll = int(f["at_poll"])
+            else:
+                self._fault_due.append(dict(f))
+        self._fault_due.sort(key=lambda f: f["at_s"])
         for _ in range(int(fleet["replicas"])):
             self._spawn_one(warmup_s=0.0)
-        self.router = Router(
-            self.fabric, namespace=self.ns,
-            poll_s=float(fleet["router_poll_s"]),
-            use_health=False,
-            clock=self.vc.monotonic, wall=self.vc.wall,
-            sleeper=self._advance)
+        self.router = self._make_router()
         self.scaler: Autoscaler | None = None
         self._next_scaler_poll = None
         if fleet.get("autoscale"):
@@ -307,6 +382,14 @@ class FleetSim:
         return cls(spec, workload=wl, service_rates=rates, **kw)
 
     # -- fleet construction ------------------------------------------------
+
+    def _make_router(self) -> Router:
+        return Router(
+            self.fabric, namespace=self.ns,
+            poll_s=float(self.spec.fleet["router_poll_s"]),
+            use_health=False,
+            clock=self.vc.monotonic, wall=self.vc.wall,
+            sleeper=self._advance)
 
     def _rate_for(self, rid: str) -> float:
         return float(self.rates.get(
@@ -348,12 +431,25 @@ class FleetSim:
             q = min(self.quantum_s, remaining)
             self.vc.advance(q)
             remaining -= q
+            while (self._fault_due
+                    and self.vc.monotonic() >= self._fault_due[0]["at_s"]):
+                self._fire_fault(self._fault_due.pop(0))
             for r in self.replicas:
                 r.step()
             if (self._next_scaler_poll is not None
                     and self.vc.monotonic() >= self._next_scaler_poll):
                 self.scaler.poll()
                 self._next_scaler_poll += self.scaler.cfg.poll_s
+
+    def _fire_fault(self, ev: dict) -> None:
+        target = next((r for r in self.replicas
+                       if r.rid == ev.get("rid") and r.alive), None)
+        if target is None:
+            return
+        if ev["kind"] == "kill_replica":
+            target.kill()
+        elif ev["kind"] == "drop_heartbeats":
+            target.drop_heartbeats(ev["for_s"])
 
     # -- one scenario run --------------------------------------------------
 
@@ -365,11 +461,42 @@ class FleetSim:
         obs.slo.clear()
         base = _counters_now(self.ns)
         reqs, arrivals = self.workload.requests(self.vc.wall())
+        budget = (timeout_s if timeout_s is not None
+                  else self.spec.duration_s + 900.0)
+        installed = False
+        if self._router_kill_poll is not None:
+            faults.install(faults.FaultPlan(
+                router_kill_after_polls=self._router_kill_poll,
+                router_kill_raise=True))
+            installed = True
         t0 = time.perf_counter()
-        comps = self.router.run(
-            reqs, arrivals=arrivals,
-            timeout_s=(timeout_s if timeout_s is not None
-                       else self.spec.duration_s + 900.0))
+        # on_complete is the sim's delivery journal (the results file of
+        # the CLI route mode): completions the first router delivered
+        # before a crash survive the crash, and recover() is told about
+        # them so replayed terminals dedupe instead of double-counting
+        comps: list = []
+        delivered: list[str] = []
+
+        def _deliver(key, comp):
+            comps.append(comp)
+            delivered.append(str(comp.rid))
+
+        try:
+            try:
+                self.router.run(reqs, arrivals=arrivals,
+                                timeout_s=budget, on_complete=_deliver)
+            except faults.RouterKilled:
+                # the injected router crash: a REPLACEMENT router on the
+                # same fabric/namespace runs the real journal-recovery
+                # path — re-adopting live replicas, sweeping orphans,
+                # replaying journaled terminals
+                self.router = self._make_router()
+                self.router.recover(timeout_s=budget,
+                                    delivered=delivered,
+                                    on_complete=_deliver)
+        finally:
+            if installed:
+                faults.reset()
         wall_s = time.perf_counter() - t0
         return self._summarize(reqs, comps, base, wall_s)
 
@@ -392,7 +519,8 @@ class FleetSim:
                     else:
                         drains += 1
             breach_ts = [rec["t"] for rec in self.scaler.decision_log
-                         if rec["wait_q"] > self.scaler.cfg.target_wait_s]
+                         if not rec.get("suppressed")
+                         and rec["wait_q"] > self.scaler.cfg.target_wait_s]
             if breach_ts:
                 recovery_s = (max(breach_ts) - min(breach_ts)
                               + self.scaler.cfg.poll_s)
@@ -416,6 +544,14 @@ class FleetSim:
             "speedup": (round(self.vc.monotonic() / wall_s, 1)
                         if wall_s > 0 else None),
             "seed": spec.seed,
+            # chaos accounting (ISSUE 12): deaths the router declared,
+            # journal recoveries it ran, and the 300s SLO burn — the
+            # whole sim finishes in well under 300 real seconds, so
+            # this window sees every terminal decision of the run
+            "replica_deaths": delta.get("router/replica_deaths", 0.0),
+            "router_recoveries": delta.get("router/recoveries", 0.0),
+            "burn_rate_300s": round(
+                obs.slo.burn_rates().get(300.0, 0.0), 4),
         }
         for reason in ("completed", "shed", "rejected", "failed",
                        "timeout"):
@@ -435,6 +571,7 @@ def _counters_now(ns: str) -> dict[str, float]:
     out: dict[str, float] = {}
     for name, m in snap.get("counters", {}).items():
         if name.startswith(("router/decisions/", "slo/bad", "slo/good",
-                            "autoscale/")):
+                            "autoscale/", "router/replica_deaths",
+                            "router/recoveries", "coord/")):
             out[name] = float(m.get("value") or 0.0)
     return out
