@@ -39,8 +39,10 @@ _EMITTED: list[dict] = []  # every metric line, re-printed in the recap
 # row provenance (ISSUE 11 satellite): every emitted line says which
 # schema revision produced it, at which commit, under which seed, from
 # which bench — so a BENCH_*.json artifact is self-describing when it
-# is compared across runs.  Schema 2 = schema 1 + these four keys.
-_BENCH_SCHEMA = 2
+# is compared across runs.  Schema 2 = schema 1 + these four keys;
+# schema 3 adds `injected` (ISSUE 13): the fault plan's nonzero
+# injection tallies, so chaos rows carry their own cause.
+_BENCH_SCHEMA = 3
 _GIT_SHA: str | None | bool = False   # False = not resolved yet
 _CURRENT_BENCH: str | None = None
 
@@ -72,8 +74,16 @@ def _emit(metric, value, unit, vs_baseline=None, **extra) -> None:
     # function always printed; BENCH_*.json parsers see identical lines)
     from tpudist.obs.export import jsonl_line
 
+    from tpudist.runtime import faults as _faults
+
+    # fault provenance: the nonzero injection tallies of THIS process's
+    # fault plan, so a row produced under chaos says exactly which
+    # faults actually fired (subprocess injections surface through the
+    # row's own counters instead — e.g. checksum_mismatches)
+    injected = {k: v for k, v in _faults.plan().injected.items() if v}
     prov = {"bench_schema": _BENCH_SCHEMA, "git_sha": _git_sha(),
-            "seed": _bench_seed(), "bench": _CURRENT_BENCH}
+            "seed": _bench_seed(), "bench": _CURRENT_BENCH,
+            "injected": injected}
     extra.update((k, v) for k, v in prov.items() if k not in extra)
     line = jsonl_line(metric, value, unit, vs_baseline, **extra)
     _EMITTED.append(json.loads(line))
@@ -2967,6 +2977,127 @@ def bench_coord_brownout(on_tpu: bool) -> None:
           wall_s=round(wall, 2))
 
 
+def bench_corruption_quarantine(on_tpu: bool) -> None:
+    """Data-plane integrity under live traffic (ISSUE 13 tentpole): a
+    2-replica fleet serves a batch while replica 1 flips one bit in
+    each of its first 3 committed completion payloads
+    (``TPUDIST_FAULT_FLIP_WIRE_BITS=1:3`` in the subprocess — flips
+    land past the frame header so the wire CHECKSUM, not a parse
+    error, must catch them).  The router must reject every corrupt
+    payload before delivery, redispatch the requests, quarantine the
+    replica on the third strike, and — once the injection self-stops —
+    reinstate it after 3 consecutive clean golden probes.  Asserted
+    downstream by CI: ``lost_requests=0``, ``corrupted_delivered=0``
+    with ``exact_match``, ``quarantines>=1``, ``reinstated>=1``."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (GoldenProbe, QuarantineConfig,
+                                        Router, build_tiny_lm,
+                                        launch_local_fleet, stop_fleet,
+                                        wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_corruption_quarantine", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    n_requests = 8
+    probe_prompt = np.array([3, 1, 4, 1, 5], np.int32)
+    probe_budget = 12
+
+    def make_requests():
+        rng = np.random.default_rng(0)
+        return [Request(rng.integers(0, 64, 4 + i % 6).astype(np.int32),
+                        16 + 2 * (i % 4), rid=f"c{i}")
+                for i in range(n_requests)]
+
+    # one uninterrupted reference run computes BOTH the exact-match
+    # oracle and the golden probe's known-exact greedy answer (greedy
+    # output is per-request deterministic regardless of batching — the
+    # same property fleet exact-match already leans on)
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    ref_out = {c.rid: c for c in ref.run(
+        make_requests() + [Request(probe_prompt, probe_budget,
+                                   rid="golden")])}
+    want = {r: tuple(ref_out[r].tokens.tolist())
+            for r in ref_out if r != "golden"}
+    golden = GoldenProbe(prompt=tuple(int(t) for t in probe_prompt),
+                         expect=tuple(ref_out["golden"].tokens.tolist()),
+                         max_new_tokens=probe_budget)
+
+    ns = "bench-quarantine"
+    client = CoordClient(port=server.port)
+    procs = launch_local_fleet(
+        f"127.0.0.1:{server.port}", 2, namespace=ns,
+        replica_args=["--cache-layout", "paged", "--kv-block-size", "16",
+                      "--ttl", "1.0", "--steps-per-sync", "8"],
+        env_overrides={1: {"TPUDIST_FAULT_FLIP_WIRE_BITS": "1:3"}})
+    before = obs.snapshot()["counters"]
+    t0 = time.perf_counter()
+    reinstated_after_s = None
+    try:
+        wait_live(client, 2, namespace=ns, timeout_s=120.0)
+        router = Router(
+            client, namespace=ns, lost_after_s=5.0,
+            golden_probe=golden,
+            quarantine_config=QuarantineConfig(
+                strike_threshold=3, strike_window_s=60.0,
+                probe_interval_s=0.5, probe_timeout_s=30.0,
+                reinstate_after=3, retire_after_fails=25))
+        comps = router.run(make_requests(), timeout_s=180.0)
+        run_wall = time.perf_counter() - t0
+        quarantined_during_run = sorted(router.quarantine.quarantined())
+        # the run is over but the fleet is still up: keep driving the
+        # probe cycle — the injection capped itself at 3 flips, so the
+        # quarantined replica now answers probes exactly and must earn
+        # its way back in
+        t1 = time.perf_counter()
+        while time.perf_counter() - t1 < 60.0:
+            router.quarantine.tick()
+            if not router.quarantine.quarantined():
+                reinstated_after_s = time.perf_counter() - t1
+                break
+            time.sleep(0.1)
+    finally:
+        stop_fleet(client, procs, namespace=ns)
+    server.stop()
+    after = obs.snapshot()["counters"]
+
+    def delta(name):
+        return (after.get(name, {}).get("value", 0)
+                - before.get(name, {}).get("value", 0))
+
+    got = {c.rid: tuple(c.tokens.tolist()) for c in comps}
+    _emit("corruption_quarantine", len(got), "reqs", None,
+          requests=n_requests,
+          lost_requests=n_requests - len(got),
+          exact_match=all(got.get(r) == w for r, w in want.items()),
+          corrupted_delivered=sum(1 for r, w in want.items()
+                                  if got.get(r) not in (None, w)),
+          checksum_mismatches=int(delta("integrity/checksum_mismatch")),
+          strikes=int(delta("quarantine/strikes")),
+          quarantines=int(delta("router/quarantines")),
+          quarantined_during_run=quarantined_during_run,
+          reinstated=int(delta("router/reinstated")),
+          retired=int(delta("router/retired")),
+          probe_pass=int(delta("probe/pass")),
+          probe_fail=int(delta("probe/fail")),
+          redispatched=int(delta("router/redispatched")),
+          replica_deaths=int(delta("router/replica_deaths")),
+          reinstated_after_s=(round(reinstated_after_s, 2)
+                              if reinstated_after_s is not None else None),
+          run_wall_s=round(run_wall, 2),
+          wall_s=round(time.perf_counter() - t0, 2))
+
+
 def main() -> None:
     import jax
 
@@ -2987,7 +3118,7 @@ def main() -> None:
                bench_serve_fleet, bench_serve_fused, bench_serve_elastic,
                bench_serve_autoscale, bench_scenario_matrix,
                bench_sim_replay, bench_router_failover,
-               bench_coord_brownout]
+               bench_coord_brownout, bench_corruption_quarantine]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

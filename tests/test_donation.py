@@ -101,7 +101,7 @@ def test_serve_segment_donates_full_carry():
     old_cache_leaf = jax.tree.leaves(loop.cache)[0]
     old = (loop._tok, loop._active, loop._remaining, loop._key)
     out = loop._segment(loop.params, loop.cache, *old[:3], loop._first,
-                        old[3], jnp.int32(4))
+                        old[3], jnp.int32(4), jnp.bool_(False))
     jax.block_until_ready(out[-1])
     assert old_cache_leaf.is_deleted()
     for buf in old:

@@ -392,3 +392,86 @@ class TestControlPlaneCrashKnobs:
         b = obs.snapshot()["histograms"].get(
             "coord/retry_backoff_s", {}).get("count", 0)
         assert b >= 1
+
+
+class TestIntegrityKnobs:
+    """ISSUE 13 knobs: wire bit flips, NaN logit poisoning, golden-probe
+    corruption."""
+
+    def test_env_parsing(self):
+        plan = FaultPlan.from_env({
+            "TPUDIST_FAULT_FLIP_WIRE_BITS": "2:5",
+            "TPUDIST_FAULT_NAN_AFTER_TOKENS": "40",
+            "TPUDIST_FAULT_PROBE_FAIL": "2",
+        })
+        assert plan.active
+        assert (plan.flip_wire_every, plan.flip_wire_max) == (2, 5)
+        assert plan.nan_after_tokens == 40
+        assert plan.probe_fail == 2
+        # uncapped form: every Nth payload, forever
+        plan = FaultPlan.from_env({"TPUDIST_FAULT_FLIP_WIRE_BITS": "3"})
+        assert (plan.flip_wire_every, plan.flip_wire_max) == (3, None)
+
+    @pytest.mark.parametrize("bad", ["0", "x", "2:0", "1:y", ":3"])
+    def test_flip_spec_validation(self, bad):
+        with pytest.raises(ValueError, match="flip_wire_bits"):
+            FaultPlan(flip_wire_bits=bad)
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError, match="nan_after_tokens"):
+            FaultPlan(nan_after_tokens=-1)
+        with pytest.raises(ValueError, match="probe_fail"):
+            FaultPlan(probe_fail=0)
+
+    def test_flip_every_nth_with_cap(self):
+        """'2:2': payloads 2 and 4 get ONE bit flipped past the frame
+        header (so the CHECKSUM, not a parse error, is what catches
+        it); the cap then disarms the injection — the transient shape
+        whose reinstatement path the quarantine bench drives."""
+        from tpudist.runtime import wire
+
+        plan = FaultPlan(flip_wire_bits="2:2")
+        clean = wire.encode_record("completion", {
+            "key": "k", "tokens": list(range(16)), "reason": "length",
+            "replica": "r1"})
+        out = [plan.flip_wire_bits(clean) for _ in range(6)]
+        assert out[0] == clean and out[2] == clean    # off-cycle
+        assert out[4] == clean and out[5] == clean    # cap reached
+        for flipped in (out[1], out[3]):
+            assert flipped != clean
+            assert len(flipped) == len(clean)
+            diff = [i for i in range(len(clean))
+                    if flipped[i] != clean[i]]
+            assert len(diff) == 1 and diff[0] >= 9    # inside the body
+            with pytest.raises(wire.WireError) as ei:
+                wire.decode_record(flipped)
+            assert ei.value.reason == "checksum"
+        assert plan.injected["wire_flip"] == 2
+
+    def test_flip_passthrough_cases(self):
+        plan = FaultPlan(flip_wire_bits="1")
+        assert plan.flip_wire_bits(b"") == b""
+        assert FaultPlan().flip_wire_bits(b"payload") == b"payload"
+
+    def test_poison_logits_threshold(self):
+        plan = FaultPlan(nan_after_tokens=10)
+        assert not plan.poison_logits(9)
+        assert plan.injected["nan_logits"] == 0
+        assert plan.poison_logits(10)
+        assert plan.poison_logits(11)
+        assert plan.injected["nan_logits"] == 2
+        assert not FaultPlan().poison_logits(10 ** 9)
+
+    def test_corrupt_probe_first_n_only(self):
+        plan = FaultPlan(probe_fail=2)
+        assert plan.corrupt_probe("probe-r1-000000")
+        assert not plan.corrupt_probe("q7")          # not a probe
+        assert plan.corrupt_probe("probe-r1-000001")
+        assert not plan.corrupt_probe("probe-r1-000002")  # budget spent
+        assert plan.injected["probe_corrupt"] == 2
+
+    def test_module_hooks_inert_by_default(self):
+        faults.reset()
+        assert faults.flip_wire_bits(b"abc") == b"abc"
+        assert not faults.poison_logits(10 ** 9)
+        assert not faults.corrupt_probe("probe-r1-000000")

@@ -1,0 +1,294 @@
+"""Quarantine state machine (ISSUE 13), driven by an injectable clock
+and an in-memory coord fake: strike accrual and window expiry, the
+quarantine transition and its marker key, the golden-probe cycle
+(pass/fail/timeout), reinstatement, retirement, and the death-sweep
+drop path.  No sleeps, no subprocesses."""
+
+import pytest
+
+from tpudist.runtime import wire
+from tpudist.runtime.quarantine import (GoldenProbe, QuarantineConfig,
+                                        QuarantineManager)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+class FakeKV:
+    """Just enough of CoordClient for the quarantine manager: a dict
+    with set/get/delete, plus a connection-failure switch."""
+
+    def __init__(self):
+        self.kv = {}
+        self.down = False
+
+    def _check(self):
+        if self.down:
+            raise ConnectionError("coord down")
+
+    def set(self, key, value):
+        self._check()
+        self.kv[key] = bytes(value)
+
+    def get(self, key):
+        self._check()
+        return self.kv.get(key)
+
+    def delete(self, key):
+        self._check()
+        self.kv.pop(key, None)
+
+
+GOLDEN = GoldenProbe(prompt=(3, 1, 4), expect=(7, 8, 9))
+
+
+def make_manager(*, golden=GOLDEN, **cfg):
+    cfg.setdefault("strike_threshold", 3)
+    cfg.setdefault("strike_window_s", 30.0)
+    cfg.setdefault("probe_interval_s", 1.0)
+    cfg.setdefault("probe_timeout_s", 5.0)
+    cfg.setdefault("reinstate_after", 2)
+    cfg.setdefault("retire_after_fails", 3)
+    clock = FakeClock()
+    kv = FakeKV()
+    mgr = QuarantineManager(kv, namespace="t", golden=golden,
+                            config=QuarantineConfig(**cfg), clock=clock)
+    return mgr, kv, clock
+
+
+def pending_probe_key(mgr, kv, rid):
+    """The inbox key of the probe tick() just sent to ``rid``."""
+    prefix = f"t/inbox/{rid}/"
+    keys = [k for k in kv.kv if k.startswith(prefix)]
+    assert len(keys) == 1, keys
+    return keys[0]
+
+
+def answer_probe(mgr, kv, rid, *, tokens, reason="length",
+                 corrupt=False):
+    inbox_key = pending_probe_key(mgr, kv, rid)
+    probe_key = inbox_key.rsplit("/", 1)[1]
+    assert probe_key.startswith(f"probe-{rid}-")
+    del kv.kv[inbox_key]
+    payload = wire.encode_record("completion", {
+        "key": probe_key, "tokens": list(tokens), "reason": reason,
+        "replica": rid})
+    if corrupt:
+        payload = payload[:-1] + bytes([payload[-1] ^ 0x10])
+    kv.kv[f"t/done/{probe_key}"] = payload
+
+
+class TestStrikes:
+    def test_below_threshold_no_quarantine(self):
+        mgr, kv, clock = make_manager()
+        assert mgr.strike("r1", "wire/checksum") is False
+        assert mgr.strike("r1", "wire/checksum") is False
+        assert mgr.quarantined() == set()
+        assert mgr.strikes("r1") == 2
+        assert "t/quarantined/r1" not in kv.kv
+
+    def test_threshold_quarantines_and_marks(self):
+        mgr, kv, clock = make_manager()
+        mgr.strike("r1", "wire/checksum")
+        mgr.strike("r1", "corrupt_segment")
+        assert mgr.strike("r1", "wire/checksum") is True
+        assert mgr.quarantined() == {"r1"}
+        doc = wire.decode_record(kv.kv["t/quarantined/r1"])
+        assert doc["replica"] == "r1"
+        assert doc["kinds"] == ["wire/checksum", "corrupt_segment",
+                                "wire/checksum"]
+
+    def test_window_expiry_forgives_old_strikes(self):
+        mgr, kv, clock = make_manager(strike_window_s=10.0)
+        mgr.strike("r1", "wire/checksum")
+        mgr.strike("r1", "wire/checksum")
+        clock.advance(11.0)
+        assert mgr.strikes("r1") == 0
+        # two old + one fresh is NOT three-in-window
+        assert mgr.strike("r1", "wire/checksum") is False
+        assert mgr.quarantined() == set()
+
+    def test_strikes_are_per_replica(self):
+        mgr, kv, clock = make_manager()
+        mgr.strike("r1", "wire/checksum")
+        mgr.strike("r1", "wire/checksum")
+        mgr.strike("r2", "wire/checksum")
+        assert mgr.quarantined() == set()
+        assert mgr.strikes("r2") == 1
+
+    def test_empty_rid_ignored(self):
+        mgr, kv, clock = make_manager()
+        for _ in range(5):
+            assert mgr.strike("", "wire/checksum") is False
+        assert mgr.quarantined() == set()
+
+    def test_strikes_while_quarantined_do_not_requarantine(self):
+        mgr, kv, clock = make_manager()
+        for _ in range(3):
+            mgr.strike("r1", "wire/checksum")
+        # late corrupt completions from the drained replica keep
+        # arriving; they must not re-enter / reset the state
+        assert mgr.strike("r1", "wire/checksum") is False
+        assert mgr.quarantined() == {"r1"}
+
+
+def quarantine(mgr, rid="r1"):
+    for _ in range(mgr.cfg.strike_threshold):
+        mgr.strike(rid, "wire/checksum")
+    assert rid in mgr.quarantined()
+
+
+class TestProbeCycle:
+    def test_tick_sends_framed_probe_request(self):
+        mgr, kv, clock = make_manager()
+        quarantine(mgr)
+        mgr.tick(live={"r1"})
+        inbox_key = pending_probe_key(mgr, kv, "r1")
+        doc = wire.decode_record(kv.kv[inbox_key], expect="request")
+        assert doc["prompt"] == [3, 1, 4]
+        assert doc["max_new_tokens"] == 3   # len(expect)
+        assert doc["key"].startswith("probe-r1-")
+
+    def test_no_probe_for_dead_replica(self):
+        mgr, kv, clock = make_manager()
+        quarantine(mgr)
+        mgr.tick(live=set())
+        assert not any(k.startswith("t/inbox/") for k in kv.kv)
+
+    def test_no_golden_means_quarantine_is_sticky(self):
+        mgr, kv, clock = make_manager(golden=None)
+        quarantine(mgr)
+        for _ in range(10):
+            mgr.tick(live={"r1"})
+            clock.advance(5.0)
+        assert mgr.quarantined() == {"r1"}
+        assert not any(k.startswith("t/inbox/") for k in kv.kv)
+
+    def test_probe_interval_respected(self):
+        mgr, kv, clock = make_manager(probe_interval_s=2.0)
+        quarantine(mgr)
+        mgr.tick(live={"r1"})
+        answer_probe(mgr, kv, "r1", tokens=(7, 8, 9))
+        mgr.tick(live={"r1"})   # consumes the pass...
+        assert mgr.state("r1")["passes"] == 1
+        # ...but must not send the next probe until the interval lapses
+        assert not any(k.startswith("t/inbox/") for k in kv.kv)
+        clock.advance(2.5)
+        mgr.tick(live={"r1"})
+        pending_probe_key(mgr, kv, "r1")
+
+    def test_consecutive_passes_reinstate(self):
+        mgr, kv, clock = make_manager(reinstate_after=2)
+        quarantine(mgr)
+        for _ in range(2):
+            clock.advance(1.5)
+            mgr.tick(live={"r1"})
+            answer_probe(mgr, kv, "r1", tokens=(7, 8, 9))
+            mgr.tick(live={"r1"})
+        assert mgr.quarantined() == set()
+        assert "t/quarantined/r1" not in kv.kv
+        assert mgr.strikes("r1") == 0   # clean ledger after reinstate
+        # the consumed done keys are deleted, not left to leak
+        assert not any(k.startswith("t/done/") for k in kv.kv)
+
+    def test_fail_resets_consecutive_passes(self):
+        mgr, kv, clock = make_manager(reinstate_after=2,
+                                      retire_after_fails=10)
+        quarantine(mgr)
+        clock.advance(1.5)
+        mgr.tick(live={"r1"})
+        answer_probe(mgr, kv, "r1", tokens=(7, 8, 9))
+        mgr.tick(live={"r1"})
+        assert mgr.state("r1")["passes"] == 1
+        clock.advance(1.5)
+        mgr.tick(live={"r1"})
+        answer_probe(mgr, kv, "r1", tokens=(7, 8, 0))   # mismatch
+        mgr.tick(live={"r1"})
+        st = mgr.state("r1")
+        assert (st["passes"], st["fails"]) == (0, 1)
+        assert mgr.quarantined() == {"r1"}
+
+    def test_corrupt_probe_answer_is_a_fail(self):
+        mgr, kv, clock = make_manager(retire_after_fails=10)
+        quarantine(mgr)
+        mgr.tick(live={"r1"})
+        answer_probe(mgr, kv, "r1", tokens=(7, 8, 9), corrupt=True)
+        mgr.tick(live={"r1"})
+        assert mgr.state("r1")["fails"] == 1
+
+    def test_bad_reason_is_a_fail(self):
+        mgr, kv, clock = make_manager(retire_after_fails=10)
+        quarantine(mgr)
+        mgr.tick(live={"r1"})
+        answer_probe(mgr, kv, "r1", tokens=(7, 8, 9),
+                     reason="corrupt_segment")
+        mgr.tick(live={"r1"})
+        assert mgr.state("r1")["fails"] == 1
+
+    def test_probe_timeout_is_a_fail(self):
+        mgr, kv, clock = make_manager(probe_timeout_s=5.0,
+                                      retire_after_fails=10)
+        quarantine(mgr)
+        mgr.tick(live={"r1"})
+        clock.advance(6.0)
+        mgr.tick(live={"r1"})
+        assert mgr.state("r1")["fails"] == 1
+
+    def test_retire_after_fails_sets_stop_key(self):
+        mgr, kv, clock = make_manager(retire_after_fails=2,
+                                      probe_interval_s=1.0)
+        quarantine(mgr)
+        for _ in range(2):
+            clock.advance(1.5)
+            mgr.tick(live={"r1"})
+            answer_probe(mgr, kv, "r1", tokens=(0, 0, 0))
+            mgr.tick(live={"r1"})
+        st = mgr.state("r1")
+        assert st["retired"] is True
+        assert kv.kv.get("t/stop/r1") == b"1"
+        # retired replicas stay excluded and are probed no further
+        assert mgr.quarantined() == {"r1"}
+        clock.advance(5.0)
+        mgr.tick(live={"r1"})
+        assert not any(k.startswith("t/inbox/") for k in kv.kv)
+
+    def test_drop_clears_all_state(self):
+        mgr, kv, clock = make_manager()
+        quarantine(mgr)
+        mgr.drop("r1")
+        assert mgr.quarantined() == set()
+        assert mgr.strikes("r1") == 0
+        # a reincarnated r1 starts from a clean ledger
+        assert mgr.strike("r1", "wire/checksum") is False
+
+
+class TestBrownoutTolerance:
+    def test_coord_down_never_raises(self):
+        mgr, kv, clock = make_manager()
+        kv.down = True
+        quarantine(mgr)          # marker set swallowed
+        mgr.tick(live={"r1"})    # probe send swallowed
+        assert mgr.quarantined() == {"r1"}
+        kv.down = False
+        clock.advance(1.5)
+        mgr.tick(live={"r1"})    # recovers: probe goes out
+        pending_probe_key(mgr, kv, "r1")
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize("bad", [
+        {"strike_threshold": 0}, {"reinstate_after": 0},
+        {"retire_after_fails": 0}, {"strike_window_s": 0.0},
+        {"probe_interval_s": -1.0}, {"probe_timeout_s": 0.0},
+    ])
+    def test_rejects_degenerate_policy(self, bad):
+        with pytest.raises(ValueError):
+            QuarantineConfig(**bad)

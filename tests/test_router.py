@@ -12,6 +12,7 @@ import time
 import numpy as np
 import pytest
 
+from tpudist.runtime import wire
 from tpudist.runtime.router import (
     Router, _decode_request, _encode_completion, _encode_request,
     build_tiny_lm, exit_reports, launch_local_fleet, roll_weights,
@@ -91,9 +92,10 @@ class TestWireFormat:
         comp = Completion(rid="00000007", prompt=req.prompt,
                           tokens=np.array([5, 6], np.int32),
                           reason="length")
-        import json
+        from tpudist.runtime import wire
 
-        d = json.loads(_encode_completion("r1", comp).decode())
+        d = wire.decode_record(_encode_completion("r1", comp),
+                               expect="completion")
         assert d == {"key": "00000007", "tokens": [5, 6],
                      "reason": "length", "replica": "r1"}
 
@@ -476,8 +478,8 @@ class TestControlPlaneUnit:
                                        priority=1)),
         }
         router._poll(entries, {}, None)
-        sent = {json.loads(fc.kv[k])["key"]:
-                json.loads(fc.kv[k])["max_new_tokens"]
+        sent = {wire.decode_record(fc.kv[k])["key"]:
+                wire.decode_record(fc.kv[k])["max_new_tokens"]
                 for k in fc.keys(f"{ns}/inbox/a/")}
         assert sent == {"00000000": 4, "00000001": 16}
         assert _counter("router/degrade_clamped") - c0 == 1
@@ -680,6 +682,92 @@ class TestFleetE2E:
                                  "--require-complete"])
         assert rc == 0
         assert json.load(open(chrome))["traceEvents"]
+
+    def test_bit_flipping_replica_quarantined_exact_output(self):
+        """ISSUE 13's acceptance E2E: replica r1 flips one bit in each
+        of its first two committed completion payloads (past the frame
+        header, so only the wire CHECKSUM can catch it).  The router
+        must reject both payloads before delivery, strike r1 into
+        quarantine, redispatch the work, and still return a greedy
+        exact-match Completion for every request — then, because the
+        injection self-stops, reinstate r1 after consecutive clean
+        golden probes.  Nothing dies: quarantine is exclusion, not
+        execution."""
+        from tpudist import obs
+        from tpudist.models.serving import Request, ServeLoop
+        from tpudist.runtime.router import GoldenProbe, QuarantineConfig
+
+        server, client = _coord_pair()
+        ns = "flip-fleet"
+        # one uninterrupted reference run yields BOTH the exact-match
+        # oracle and the golden probe's known answer (greedy output is
+        # per-request deterministic regardless of batching)
+        probe_prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        cfg, params = build_tiny_lm(seed=0)
+        ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                        prefill_chunk=8, cache_layout="paged",
+                        kv_block_size=16)
+        ref_out = {c.rid: c for c in ref.run(
+            _requests(6) + [Request(probe_prompt, 12, rid="golden")])}
+        golden = GoldenProbe(
+            prompt=tuple(int(t) for t in probe_prompt),
+            expect=tuple(ref_out["golden"].tokens.tolist()),
+            max_new_tokens=12)
+
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 2, namespace=ns,
+            replica_args=["--cache-layout", "paged",
+                          "--kv-block-size", "16", "--ttl", "1.0"],
+            env_overrides={1: {"TPUDIST_FAULT_FLIP_WIRE_BITS": "1:2"}})
+        before = obs.snapshot()["counters"]
+        try:
+            wait_live(client, 2, namespace=ns, timeout_s=90.0)
+            router = Router(
+                client, namespace=ns, lost_after_s=5.0,
+                golden_probe=golden,
+                quarantine_config=QuarantineConfig(
+                    strike_threshold=2, strike_window_s=60.0,
+                    probe_interval_s=0.25, probe_timeout_s=30.0,
+                    reinstate_after=2, retire_after_fails=50))
+            comps = router.run(_requests(6), timeout_s=120.0)
+            # the run may outlive the quarantine (in-poll probe ticks
+            # can reinstate r1 before the last request drains); if
+            # not, keep driving the probe cycle until r1 earns its
+            # way back in
+            deadline = time.monotonic() + 60.0
+            while (router.quarantine.quarantined()
+                   and time.monotonic() < deadline):
+                router.quarantine.tick()
+                time.sleep(0.05)
+            assert router.quarantine.quarantined() == set()
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        # zero lost, zero corrupted tokens delivered: every request
+        # exact-matches the uninterrupted reference
+        assert sorted(c.rid for c in comps) == [f"q{i}" for i in range(6)]
+        assert all(c.reason == "length" for c in comps)
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, np.asarray(ref_out[c.rid].tokens, np.int32),
+                err_msg=f"request {c.rid} diverged past the bit flips")
+        # both flips were caught at the wire and struck r1 into
+        # quarantine; clean probes brought it back; nobody was killed
+        assert delta("integrity/checksum_mismatch") >= 2
+        assert delta("router/quarantines") >= 1
+        assert delta("router/reinstated") >= 1
+        assert delta("router/retired") == 0
+        assert delta("probe/pass") >= 2
+        assert delta("router/replica_deaths") == 0
+        # r1 survived its quarantine: it exits CLEANLY at stop_fleet
+        reports = exit_reports(client, namespace=ns)
+        assert set(reports) == {"r0", "r1"}
+        assert all(r["clean"] for r in reports.values())
 
     def test_two_replicas_share_load_no_faults(self):
         """Happy path: both replicas serve, output exact-matches the

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from tpudist import obs
-from tpudist.runtime import faults
+from tpudist.runtime import faults, wire
 from tpudist.runtime.faults import FaultPlan, RouterKilled
 from tpudist.runtime.router import (
     JOURNAL_SCHEMA, Router, _decode_request, _encode_request)
@@ -111,7 +111,7 @@ class TestJournalLifecycle:
                 req = _decode_request(value)
                 raw = fc.kv.get(f"{ns}/journal/{req.rid}")
                 seen.append(None if raw is None
-                            else json.loads(raw.decode()))
+                            else wire.decode_record(raw))
                 fc.kv.pop(key, None)
                 fc.kv[f"{ns}/done/{req.rid}"] = json.dumps(
                     {"key": req.rid, "tokens": [7],
@@ -167,7 +167,7 @@ class TestJournalLifecycle:
                 k = key[len(f"{ns}/done/"):]
                 raw = fc.kv.get(f"{ns}/journal/{k}")
                 at_delete[key] = (None if raw is None
-                                  else json.loads(raw.decode()))
+                                  else wire.decode_record(raw))
             orig_delete(key)
 
         fc.delete = delete
@@ -182,7 +182,7 @@ class TestRecover:
                  terminal=None, tokens=()):
         req = _requests(1)[0]
         doc = {"schema": JOURNAL_SCHEMA,
-               "req": json.loads(_encode_request(k, req).decode()),
+               "req": wire.decode_record(_encode_request(k, req)),
                "rid": rid, "assigned": assigned, "attempts": attempts,
                "at": 0.0, "terminal": terminal,
                "tokens": list(tokens)}

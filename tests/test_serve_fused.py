@@ -155,7 +155,7 @@ class TestDeadlineClamp:
 
         def ticking_segment(*a):
             out = orig(*a)
-            t[0] += float(np.asarray(a[-1]))      # n_steps seconds
+            t[0] += float(np.asarray(a[7]))       # n_steps seconds
             return out
 
         loop._segment = ticking_segment

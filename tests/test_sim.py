@@ -135,6 +135,26 @@ class TestScenarioSpec:
             builtin("replica_death_storm").to_dict())
         assert back == builtin("replica_death_storm")
 
+    def test_corrupt_replica_validation(self):
+        # ISSUE 13: the byzantine-replica verb and its integrity bounds
+        with pytest.raises(ValueError, match="missing required keys"):
+            _spec(faults=[{"kind": "corrupt_replica", "at_s": 1.0}])
+        with pytest.raises(ValueError, match="every >= 1"):
+            _spec(faults=[{"kind": "corrupt_replica", "at_s": 1.0,
+                           "rid": "r1", "every": 0}])
+        with pytest.raises(ValueError, match="count must be >= 1"):
+            _spec(faults=[{"kind": "corrupt_replica", "at_s": 1.0,
+                           "rid": "r1", "count": 0}])
+        spec = _spec(faults=[{"kind": "corrupt_replica", "at_s": 1.0,
+                              "rid": "r1", "every": 2, "count": 4}])
+        assert spec.faults[0]["count"] == 4
+        sc = builtin("silent_corruption")
+        assert sc.faults and sc.faults[0]["kind"] == "corrupt_replica"
+        assert sc.envelope.max_corrupted_terminals == 0
+        assert sc.envelope.min_quarantines >= 1
+        assert sc.envelope.min_reinstated >= 1
+        assert ScenarioSpec.from_dict(sc.to_dict()) == sc
+
 
 class TestEnvelope:
     def test_unknown_key_rejected(self):
@@ -323,6 +343,9 @@ def _passing_row(name: str) -> dict:
             "scale_ups": env.min_scale_ups, "drains": env.min_drains,
             "priority_bad": 0, "replica_deaths": 0,
             "router_recoveries": env.min_router_recoveries,
+            "quarantines": env.min_quarantines,
+            "reinstated": env.min_reinstated,
+            "corrupted_terminals": 0,
             "burn_rate_300s": 0.0,
             "decisions_completed": 500,
             "decisions_failed": 0, "envelope_ok": True,
@@ -561,6 +584,25 @@ class TestFleetSimChaos:
                       "decisions": {"failed": {"max": 0}}})
         row = FleetSim(spec).run()
         assert row["lost_requests"] == 0
+        assert row["decisions_completed"] == row["requests"]
+        assert row["envelope_ok"], row["violations"]
+
+    def test_silent_corruption_quarantines_before_delivery(self):
+        """ISSUE 13's sim acceptance: a replica flipping bits in its
+        committed completions is struck into quarantine BEFORE any
+        corrupt payload reaches a caller, its work is redispatched,
+        and — the injection being capped — golden probes reinstate it.
+        Zero lost, zero corrupted terminals, nothing killed."""
+        from tpudist.sim.simulator import FleetSim
+
+        row = FleetSim(builtin("silent_corruption")).run()
+        assert row["lost_requests"] == 0
+        assert row["corrupted_terminals"] == 0
+        assert row["checksum_mismatches"] >= 3
+        assert row["quarantines"] >= 1
+        assert row["reinstated"] >= 1
+        assert row["retired"] == 0
+        assert row["replica_deaths"] == 0
         assert row["decisions_completed"] == row["requests"]
         assert row["envelope_ok"], row["violations"]
 

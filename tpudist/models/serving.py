@@ -470,6 +470,16 @@ class ServeLoop:
         self._obs_tokens = obs.counter("serve/tokens", unit="tokens")
         self._obs_rejected = obs.counter("serve/rejected", unit="reqs")
         self._obs_timeouts = obs.counter("serve/timeouts", unit="reqs")
+        # data-plane integrity: lanes the in-graph NaN/inf logit guard
+        # froze, plus host-side token-range failures — either way the
+        # request finishes reason="corrupt_segment" (the router's cue
+        # to redispatch and strike this replica) instead of emitting
+        # garbage as if it were output
+        self._obs_corrupt = obs.counter("serve/corrupt_segments",
+                                        unit="segments")
+        # lifetime tokens drained to the host: the trip point for the
+        # TPUDIST_FAULT_NAN_AFTER_TOKENS injection
+        self._served_tokens = 0
         self._obs_segments = obs.counter("serve/segments", unit="segments")
         self._obs_queue = obs.gauge("serve/queue_depth", unit="reqs")
         self._obs_degraded = obs.gauge("serve/degraded", unit="bool")
@@ -592,14 +602,22 @@ class ServeLoop:
     # -- compiled pieces ---------------------------------------------------
 
     def _segment_impl(self, params, cache, tok, active, remaining, first,
-                      key, n_steps):
+                      key, n_steps, poison):
         """One fused multi-token segment: a ``lax.while_loop`` of up to
         ``n_steps`` decode ticks (``n_steps`` is a DYNAMIC arg — the
         deadline clamp in :meth:`_plan_steps` shortens segments without
         recompiling) that EXITS EARLY once every lane is frozen, so an
         almost-idle batch never pays full-length segments.  The emit
         buffer is fixed at ``steps_per_sync`` columns (pad-filled past
-        ``n_steps``); the host slices to the dispatched length."""
+        ``n_steps``); the host slices to the dispatched length.
+
+        ``poison`` (dynamic bool, normally False) NaN-floods the step's
+        logits — the TPUDIST_FAULT_NAN_AFTER_TOKENS injection point,
+        kept as a dynamic arg so fault runs reuse the clean executable.
+        The integrity guard below it is always on: a lane whose logits
+        go NaN/inf is frozen IN-GRAPH before its garbage token reaches
+        the emit buffer, and reported in the per-lane ``corrupt``
+        output so the host can finalize it ``corrupt_segment``."""
         stop_arr = self._stop
         pad = jnp.int32(self.pad_token)
         S = self.cfg.max_seq_len
@@ -608,7 +626,7 @@ class ServeLoop:
             return (carry[0] < n_steps) & jnp.any(carry[3])
 
         def step(carry):
-            i, cache, tok, active, remaining, lived, key, E = carry
+            i, cache, tok, active, remaining, lived, corrupt, key, E = carry
             main_idx, side_idx = _index_leaves(cache)
             pos = main_idx if side_idx is None else main_idx + side_idx
             pos = jnp.minimum(pos, S - 1)
@@ -618,8 +636,17 @@ class ServeLoop:
             logits, mut = self.model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 positions=pos[:, None], mutable=["cache"])
+            last = logits[:, -1]
+            last = jnp.where(poison, jnp.full_like(last, jnp.nan), last)
+            # integrity guard: freeze (not emit) lanes whose logits are
+            # no longer finite — overflowed accumulator, scrambled KV
+            # page, injected fault — so corruption surfaces as a
+            # verdict instead of as plausible-looking tokens
+            bad = active & ~jnp.all(jnp.isfinite(last), axis=-1)
+            corrupt = corrupt | bad
+            active = active & ~bad
             key, sk = jax.random.split(key)
-            nxt = self._select(logits[:, -1], sk).astype(jnp.int32)
+            nxt = self._select(last, sk).astype(jnp.int32)
             emit = jnp.where(active, nxt, pad)
             E = lax.dynamic_update_slice(E, emit[:, None], (0, i))
             remaining = remaining - active.astype(jnp.int32)
@@ -629,13 +656,16 @@ class ServeLoop:
             active = active & ~hit_stop & (remaining > 0)
             tok = jnp.where(active, nxt, pad)
             return (i + 1, mut["cache"], tok, active, remaining, lived,
-                    key, E)
+                    corrupt, key, E)
 
         lived0 = jnp.zeros((self.B,), jnp.int32)
+        corrupt0 = jnp.zeros((self.B,), bool)
         E0 = jnp.full((self.B, self.steps), pad, jnp.int32)
-        (_, cache, tok, active, remaining, lived, key, E) = lax.while_loop(
+        (_, cache, tok, active, remaining, lived, corrupt, key,
+         E) = lax.while_loop(
             cond, step,
-            (jnp.int32(0), cache, tok, active, remaining, lived0, key, E0))
+            (jnp.int32(0), cache, tok, active, remaining, lived0,
+             corrupt0, key, E0))
         if self.side:
             # side -> main merge INSIDE the segment executable: one
             # dispatch per wave instead of two (each dispatch costs
@@ -645,7 +675,7 @@ class ServeLoop:
         # column 0 carries the admission-deferred first tokens so ONE
         # host fetch resolves them together with the segment's emits
         emits = jnp.concatenate([first[:, None], E], axis=1)
-        return cache, tok, active, remaining, key, emits
+        return cache, tok, active, remaining, key, emits, corrupt
 
     def _prefill_impl(self, params, prompt_padded, true_len, key,
                       *, true_chunk):
@@ -1346,8 +1376,33 @@ class ServeLoop:
                 st["pending_first"] = False
             else:
                 row = row[1:]               # column 0 is a stale first
+            vocab = self.cfg.vocab_size
             for t in row:
+                if not 0 <= t < vocab:
+                    # host-side range net: an id outside the vocab can
+                    # only come from scrambled device memory or a bad
+                    # transfer (the sampler indexes [0, vocab)).  Covers
+                    # the speculative path, which has no in-graph guard.
+                    self._obs_corrupt.inc()
+                    obs.recorder.record(
+                        "serve_corrupt_segment", slot=slot,
+                        token=t, tokens=len(st["tokens"]))
+                    tev("corrupt_segment", st["req"], slot=slot,
+                        token=t, tokens=len(st["tokens"]))
+                    self._active = self._active.at[slot].set(False)
+                    if self.pool is not None and inflight:
+                        # host-side kill, like timeout: pre-kill
+                        # segments may still write this lane's pages,
+                        # so the refund waits for them to drain
+                        finalize(slot, "corrupt_segment",
+                                 free_pool=False)
+                        slot_state[slot] = {"zombie": True,
+                                            "free_at": seq}
+                    else:
+                        finalize(slot, "corrupt_segment")
+                    return
                 st["tokens"].append(t)
+                self._served_tokens += 1
                 if t in self._stop_set:
                     finalize(slot, "stop")
                     return
@@ -1426,6 +1481,10 @@ class ServeLoop:
             t_disp = time.perf_counter()
             with obs.span("serve/segment", steps=n, seq=seq):
                 if self.decode_mode == "speculative":
+                    # the speculative segment has no in-graph guard;
+                    # the host-side token-range check in drain() is the
+                    # integrity net for this path
+                    corrupt = None
                     (self.cache, self.draft_cache, self._tok,
                      self._active, self._remaining, self._key, emits,
                      stats) = self._segment_spec(
@@ -1436,11 +1495,13 @@ class ServeLoop:
                     self._obs_spec_k.set(k)
                 else:
                     stats = None
+                    poison = faults.poison_logits(self._served_tokens)
                     (self.cache, self._tok, self._active,
-                     self._remaining, self._key, emits) = self._segment(
+                     self._remaining, self._key, emits,
+                     corrupt) = self._segment(
                         self.params, self.cache, self._tok, self._active,
                         self._remaining, self._first, self._key,
-                        jnp.int32(n))
+                        jnp.int32(n), jnp.bool_(poison))
             self._obs_segments.inc()
             self._obs_dispatches.inc()
             for slot in range(self.B):
@@ -1454,7 +1515,7 @@ class ServeLoop:
                 emits.copy_to_host_async()
             except AttributeError:  # non-jax array (test doubles)
                 pass
-            inflight.append((seq, emits, stats, n, k, t_disp))
+            inflight.append((seq, emits, corrupt, stats, n, k, t_disp))
             seq += 1
             self._obs_depth.set(len(inflight))
             # fault harness: a configured kill-after-K-segments SIGKILLs
@@ -1470,8 +1531,8 @@ class ServeLoop:
             ``stats[0]`` (the emitted count) — either way the drain
             slices to the real width so pad columns past a short segment
             are never consumed."""
-            s_idx, emits_dev, stats_dev, n_disp, k_disp, t_disp = (
-                inflight.popleft())
+            (s_idx, emits_dev, corrupt_dev, stats_dev, n_disp, k_disp,
+             t_disp) = inflight.popleft()
             self._obs_depth.set(len(inflight))
             if any(st is not None and not st.get("zombie")
                    and st["seq"] <= s_idx for st in slot_state):
@@ -1513,11 +1574,30 @@ class ServeLoop:
                                 k_disp, dt / rounds)
                         self._spec_uses[k_disp] = (
                             self._spec_uses.get(k_disp, 0) + 1)
+                corrupt = (np.asarray(corrupt_dev)
+                           if corrupt_dev is not None else None)
                 for slot in range(self.B):
                     st = slot_state[slot]
                     if (st is not None and not st.get("zombie")
                             and st["seq"] <= s_idx):
-                        drain(slot, emits[slot, :1 + n_tok])
+                        if corrupt is not None and bool(corrupt[slot]):
+                            # the in-graph guard froze this lane before
+                            # emitting anything from the bad step, but
+                            # this segment's earlier columns are from
+                            # the same poisoned state — discard them
+                            # all and surface the verdict.  free_pool
+                            # is safe for the same reason stop-finalize
+                            # is: the lane is frozen in-graph, so later
+                            # in-flight segments never write its pages.
+                            self._obs_corrupt.inc()
+                            obs.recorder.record(
+                                "serve_corrupt_segment", slot=slot,
+                                seq=s_idx, tokens=len(st["tokens"]))
+                            tev("corrupt_segment", st["req"], slot=slot,
+                                seq=s_idx, tokens=len(st["tokens"]))
+                            finalize(slot, "corrupt_segment")
+                        else:
+                            drain(slot, emits[slot, :1 + n_tok])
             # zombie refund: every segment dispatched before the kill
             # (index < free_at) has drained once s_idx reaches
             # free_at - 1 — no stale merge can touch the blocks now

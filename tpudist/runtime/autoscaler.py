@@ -261,6 +261,14 @@ class Autoscaler:
         prefix = f"{self.ns}/draining/"
         return {k[len(prefix):] for k in self.client.keys(prefix)}
 
+    def quarantined(self) -> set[str]:
+        """Replicas the router's quarantine manager has marked
+        (``{ns}/quarantined/{rid}``): alive and heartbeating, but
+        excluded from dispatch while golden probes decide their fate —
+        so their capacity is MISSING and the fleet must backfill."""
+        prefix = f"{self.ns}/quarantined/"
+        return {k[len(prefix):] for k in self.client.keys(prefix)}
+
     def _registrations(self) -> dict[str, dict]:
         out = {}
         prefix = f"{self.ns}/replica/"
@@ -274,6 +282,7 @@ class Autoscaler:
         """The merged fleet view one decision is made from."""
         live = self.live()
         draining = self.draining()
+        quarantined = self.quarantined()
         snaps = collect(self.client, f"{self.ns}/metrics",
                         max_age_s=self.cfg.max_metric_age_s)
         merged = merge_snapshots(snaps)
@@ -300,7 +309,8 @@ class Autoscaler:
         local = obs.slo.burn_rates()
         if local:
             burn = max(burn, local[min(local)])
-        return {"live": live, "draining": draining, "wait_q": wait_q,
+        return {"live": live, "draining": draining,
+                "quarantined": quarantined, "wait_q": wait_q,
                 "queue_depth": depth, "kv_blocks_free": free,
                 "burn_rate": burn, "snaps": snaps}
 
@@ -398,7 +408,12 @@ class Autoscaler:
             return record
         live, draining = view["live"], view["draining"]
         self._tick_drains(live, draining)
-        active = live - draining
+        # quarantined capacity is MISSING capacity: the router will not
+        # dispatch to it, so counting it would starve the backfill —
+        # and it must never be picked as a scale-down victim (it is
+        # already out of rotation; draining it would mask the probe
+        # verdict that decides whether it comes back)
+        active = live - draining - view["quarantined"]
         pending = self._pending_joiners(live)
         now = self._clock()
         action = None
@@ -459,6 +474,7 @@ class Autoscaler:
         self._obs_burn.set(view["burn_rate"])
         record = {"action": action, "wait_q": view["wait_q"],
                   "active": sorted(active), "draining": sorted(draining),
+                  "quarantined": sorted(view["quarantined"]),
                   "pending": len(pending),
                   "queue_depth": view["queue_depth"],
                   "burn_rate": view["burn_rate"],

@@ -90,6 +90,22 @@ Environment knobs (all optional):
                                     class, safely retriable for all verbs
 ``TPUDIST_FAULT_COORD_OUTAGE_S``    the outage window's length (default
                                     5 s once ``COORD_OUTAGE_AT_S`` is set)
+``TPUDIST_FAULT_FLIP_WIRE_BITS``    ``N`` or ``N:M`` — flip one bit in every
+                                    Nth coord payload this process commits
+                                    (``N:M`` stops after M flips total):
+                                    silent wire corruption the checksummed
+                                    frame must catch and the router must
+                                    quarantine
+``TPUDIST_FAULT_NAN_AFTER_TOKENS``  poison the decode segment's logits to
+                                    NaN once the serve loop has emitted this
+                                    many tokens — in-band compute corruption
+                                    the lane guard must freeze into a
+                                    ``corrupt_segment`` verdict
+``TPUDIST_FAULT_PROBE_FAIL``        flip a token in the first N completions
+                                    whose request id starts with ``probe`` —
+                                    a quarantined replica that keeps failing
+                                    its golden probes (N large: retirement;
+                                    N small: fail-then-reinstate)
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -105,7 +121,8 @@ import time
 __all__ = ["FaultInjected", "RouterKilled", "FaultPlan", "plan",
            "install", "reset", "coord_op", "drop_heartbeat",
            "drop_publish", "on_segment", "on_warmup", "corrupt_canary",
-           "autoscale_poll", "on_router_poll"]
+           "autoscale_poll", "on_router_poll", "flip_wire_bits",
+           "poison_logits", "corrupt_probe"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -152,6 +169,9 @@ class FaultPlan:
         router_kill_raise: bool = False,
         coord_outage_at_s: float | None = None,
         coord_outage_s: float = 5.0,
+        flip_wire_bits: str | int | None = None,
+        nan_after_tokens: int | None = None,
+        probe_fail: int | None = None,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -185,18 +205,49 @@ class FaultPlan:
                 f"coord_outage_s must be > 0, got {coord_outage_s}")
         self.coord_outage_at_s = coord_outage_at_s
         self.coord_outage_s = float(coord_outage_s)
+        # wire corruption spec "N" (every Nth payload, forever) or
+        # "N:M" (every Nth, but stop after M flips — the transient
+        # corruption shape whose reinstatement path the bench drives)
+        self.flip_wire_every: int | None = None
+        self.flip_wire_max: int | None = None
+        if flip_wire_bits is not None:
+            spec = str(flip_wire_bits)
+            every, _, cap = spec.partition(":")
+            try:
+                self.flip_wire_every = int(every)
+                self.flip_wire_max = int(cap) if cap else None
+            except ValueError:
+                raise ValueError(
+                    f"flip_wire_bits must be 'N' or 'N:M', got {spec!r}"
+                ) from None
+            if self.flip_wire_every < 1 or (
+                    self.flip_wire_max is not None
+                    and self.flip_wire_max < 1):
+                raise ValueError(
+                    f"flip_wire_bits counts must be >= 1, got {spec!r}")
+        if nan_after_tokens is not None and int(nan_after_tokens) < 0:
+            raise ValueError(
+                f"nan_after_tokens must be >= 0, got {nan_after_tokens}")
+        self.nan_after_tokens = (None if nan_after_tokens is None
+                                 else int(nan_after_tokens))
+        if probe_fail is not None and int(probe_fail) < 1:
+            raise ValueError(
+                f"probe_fail must be >= 1, got {probe_fail}")
+        self.probe_fail = None if probe_fail is None else int(probe_fail)
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self._segments = 0
         self._router_polls = 0
+        self._wire_payloads = 0
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
                          "heartbeat_drop": 0, "publish_drop": 0,
                          "heartbeat_delay": 0, "canary_corrupt": 0,
                          "autoscale_delay": 0, "coord_outage": 0,
-                         "router_kill": 0}
+                         "router_kill": 0, "wire_flip": 0,
+                         "nan_logits": 0, "probe_corrupt": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
                            or kill_after_segments is not None
@@ -205,7 +256,10 @@ class FaultPlan:
                            or kill_at_warmup or canary_corrupt
                            or autoscale_poll_delay_s is not None
                            or router_kill_after_polls is not None
-                           or coord_outage_at_s is not None)
+                           or coord_outage_at_s is not None
+                           or self.flip_wire_every is not None
+                           or self.nan_after_tokens is not None
+                           or self.probe_fail is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -230,6 +284,12 @@ class FaultPlan:
             router_kill_after_polls=None if rkill is None else int(rkill),
             coord_outage_at_s=_env_float(env, "COORD_OUTAGE_AT_S"),
             coord_outage_s=5.0 if outage_s is None else outage_s,
+            flip_wire_bits=(env.get(ENV_PREFIX + "FLIP_WIRE_BITS") or None),
+            nan_after_tokens=(
+                None if _env_float(env, "NAN_AFTER_TOKENS") is None
+                else int(_env_float(env, "NAN_AFTER_TOKENS"))),
+            probe_fail=(None if _env_float(env, "PROBE_FAIL") is None
+                        else int(_env_float(env, "PROBE_FAIL"))),
             seed=int(_env_float(env, "SEED") or 0),
         )
 
@@ -334,6 +394,52 @@ class FaultPlan:
             self.injected["canary_corrupt"] += 1
         return True
 
+    def flip_wire_bits(self, payload: bytes) -> bytes:
+        """Maybe corrupt one coord payload about to be committed: every
+        ``flip_wire_every``-th payload gets ONE bit flipped (capped at
+        ``flip_wire_max`` flips when set).  The flip lands past any
+        frame header, so the CHECKSUM — not a parse error — is what has
+        to catch it, exactly like a real in-flight bit flip."""
+        if self.flip_wire_every is None or not payload:
+            return payload
+        with self._lock:
+            self._wire_payloads += 1
+            fire = (self._wire_payloads % self.flip_wire_every == 0
+                    and (self.flip_wire_max is None
+                         or self.injected["wire_flip"]
+                         < self.flip_wire_max))
+            if fire:
+                self.injected["wire_flip"] += 1
+        if not fire:
+            return payload
+        pos = min(len(payload) - 1, max(9, len(payload) // 2))
+        return (payload[:pos] + bytes([payload[pos] ^ 0x10])
+                + payload[pos + 1:])
+
+    def poison_logits(self, tokens_served: int) -> bool:
+        """True when this decode segment's logits should be poisoned to
+        NaN: the serve loop has emitted at least ``nan_after_tokens``
+        tokens — overflowed-accumulator corruption appearing mid-run,
+        which the in-graph lane guard must freeze rather than emit."""
+        if (self.nan_after_tokens is None
+                or tokens_served < self.nan_after_tokens):
+            return False
+        with self._lock:
+            self.injected["nan_logits"] += 1
+        return True
+
+    def corrupt_probe(self, rid: str) -> bool:
+        """True when this golden-probe completion's tokens should be
+        corrupted (first ``probe_fail`` probes only): a quarantined
+        replica that is still wrong when re-probed."""
+        if not (self.probe_fail and rid.startswith("probe")):
+            return False
+        with self._lock:
+            if self.injected["probe_corrupt"] >= self.probe_fail:
+                return False
+            self.injected["probe_corrupt"] += 1
+        return True
+
     def autoscale_poll(self) -> None:
         """Stall one autoscaler control poll (a wedged control plane —
         the data plane must keep serving, just without scaling)."""
@@ -422,6 +528,21 @@ def on_warmup() -> None:
 def corrupt_canary(rid: str) -> bool:
     p = plan()
     return p.active and p.corrupt_canary(rid)
+
+
+def flip_wire_bits(payload: bytes) -> bytes:
+    p = plan()
+    return p.flip_wire_bits(payload) if p.active else payload
+
+
+def poison_logits(tokens_served: int) -> bool:
+    p = plan()
+    return p.active and p.poison_logits(tokens_served)
+
+
+def corrupt_probe(rid: str) -> bool:
+    p = plan()
+    return p.active and p.corrupt_probe(rid)
 
 
 def autoscale_poll() -> None:

@@ -125,6 +125,34 @@ health monitor's ``stale`` verdict steers routing without a death,
 ``KILL_AT_WARMUP`` SIGKILLs a joiner between registration and its
 first heartbeat, and ``CANARY_CORRUPT`` forces the green pool to serve
 wrong canary output so the rollback path runs for real.
+
+Data-plane integrity (the byzantine-fault complement to the crash
+machinery above; see docs/DESIGN.md "Data-plane integrity"):
+
+* **Checksummed wire** — requests, completions, and journal records
+  are framed by :mod:`tpudist.runtime.wire` (crc32c + schema tag);
+  every decode site verifies before trusting.  A mismatch raises a
+  typed :class:`~tpudist.runtime.wire.WireError` carrying
+  namespace/key/replica, which the router COUNTS
+  (``integrity/checksum_mismatch``), attributes as a strike against
+  the offending replica, and answers by deleting the corrupt key and
+  redispatching the request — corruption is never delivered and never
+  crashes the poll loop.  Unframed legacy payloads still decode (the
+  simulator's fakes and hand-planted test keys ride that path).
+* **In-band verdicts** — a replica that catches corruption itself
+  (NaN/inf logits freezing a lane into ``reason="corrupt_segment"``,
+  or an undecodable inbox payload surfacing as
+  ``reason="wire_error"``) commits the verdict instead of output; the
+  router re-routes the request like a rejection and records a strike.
+* **Quarantine** — strikes accumulate in
+  :class:`~tpudist.runtime.quarantine.QuarantineManager`; past the
+  threshold the replica is drained from dispatch (not killed), marked
+  ``{ns}/quarantined/{rid}`` (the autoscaler backfills the capacity),
+  and re-probed with golden queries — fixed prompt, known-exact greedy
+  tokens, the blue-green canary check running in steady state — until
+  it is reinstated by consecutive clean probes or retired.  The fault
+  knobs ``FLIP_WIRE_BITS``, ``NAN_AFTER_TOKENS``, and ``PROBE_FAIL``
+  drive all three paths deterministically.
 """
 
 from __future__ import annotations
@@ -145,8 +173,10 @@ from tpudist.obs.aggregate import collect, MetricsPublisher
 from tpudist.obs.events import EventPublisher, TraceContext
 from tpudist.obs.health import HealthMonitor
 from tpudist.obs.registry import hist_quantile
-from tpudist.runtime import faults
+from tpudist.runtime import faults, wire
 from tpudist.runtime.coord import CoordClient, ElasticMonitor
+from tpudist.runtime.quarantine import (GoldenProbe, QuarantineConfig,
+                                        QuarantineManager)
 from tpudist.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -155,7 +185,8 @@ __all__ = ["Router", "ReplicaWorker", "build_tiny_lm",
            "launch_local_fleet", "scale_fleet", "stop_fleet",
            "exit_reports", "wait_live", "roll_weights", "wait_swapped",
            "alloc_replica_indices", "request_drain", "drain_replicas",
-           "JOURNAL_SCHEMA"]
+           "JOURNAL_SCHEMA", "GoldenProbe", "QuarantineConfig",
+           "QuarantineManager"]
 
 DEFAULT_NAMESPACE = "fleet"
 
@@ -164,9 +195,11 @@ DEFAULT_NAMESPACE = "fleet"
 JOURNAL_SCHEMA = "tpudist.journal/1"
 
 
-# -- wire format (JSON over the KV store) ---------------------------------
+# -- wire format (checksummed JSON frames over the KV store; see
+# tpudist.runtime.wire for the crc32c framing and the legacy
+# unframed-JSON fallback every decoder keeps) ------------------------------
 
-def _encode_request(key: str, req) -> bytes:
+def _request_doc(key: str, req) -> dict:
     doc = {
         "key": key,
         "prompt": np.asarray(req.prompt).astype(int).tolist(),
@@ -180,27 +213,43 @@ def _encode_request(key: str, req) -> bytes:
     trace = getattr(req, "trace", None)
     if trace is not None:
         doc["trace"] = trace.to_wire()
-    return json.dumps(doc).encode()
+    return doc
 
 
-def _decode_request(raw: bytes):
+def _encode_request(key: str, req) -> bytes:
+    return wire.encode_record("request", _request_doc(key, req))
+
+
+def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
+                    replica: str = ""):
+    """Verify + decode one inbox payload into a ``Request``.  Raises
+    :class:`~tpudist.runtime.wire.WireError` on ANY failure — checksum,
+    truncation, bad JSON, or a structurally valid document missing the
+    request fields — so one except clause covers the whole corrupt
+    surface at each call site."""
     from tpudist.models.serving import Request
 
-    d = json.loads(raw.decode())
-    return Request(prompt=np.asarray(d["prompt"], np.int32),
-                   max_new_tokens=int(d["max_new_tokens"]),
-                   rid=d["key"], deadline_s=d.get("deadline_s"),
-                   priority=int(d.get("priority", 0)),
-                   trace=TraceContext.from_wire(d.get("trace")))
+    d = wire.decode_record(raw, expect="request", namespace=namespace,
+                           key=key, replica=replica)
+    try:
+        return Request(prompt=np.asarray(d["prompt"], np.int32),
+                       max_new_tokens=int(d["max_new_tokens"]),
+                       rid=d["key"], deadline_s=d.get("deadline_s"),
+                       priority=int(d.get("priority", 0)),
+                       trace=TraceContext.from_wire(d.get("trace")))
+    except (KeyError, ValueError, TypeError):
+        raise wire.WireError("schema", kind="request",
+                             namespace=namespace, key=key,
+                             replica=replica) from None
 
 
 def _encode_completion(replica_id: str, comp) -> bytes:
-    return json.dumps({
+    return wire.encode_record("completion", {
         "key": comp.rid,
         "tokens": np.asarray(comp.tokens).astype(int).tolist(),
         "reason": comp.reason,
         "replica": replica_id,
-    }).encode()
+    })
 
 
 # -- the replica side ------------------------------------------------------
@@ -457,12 +506,32 @@ class ReplicaWorker:
                 self.client.delete(key)
                 if raw is None:   # racing a sweep of a presumed death
                     continue
+                k = key[len(self._inbox):]
                 try:
-                    req = _decode_request(raw)
-                except (ValueError, KeyError) as e:
-                    log.warning("replica %s: dropping undecodable "
-                                "request %s: %s",
-                                self.replica_id, key, e)
+                    req = _decode_request(raw, namespace=self.ns,
+                                          key=k,
+                                          replica=self.replica_id)
+                except wire.WireError as e:
+                    # a corrupt dispatch: silently dropping it would
+                    # leave the router waiting until its death sweep or
+                    # timeout.  Commit a wire_error VERDICT instead —
+                    # the router re-routes the request immediately and
+                    # counts the strike against this replica (the
+                    # observation point; a replica whose memory or NIC
+                    # flips bits accumulates these).
+                    log.warning("replica %s: undecodable request %s "
+                                "(%s); committing wire_error verdict",
+                                self.replica_id, key, e.reason)
+                    try:
+                        self.client.set(
+                            f"{self.ns}/done/{k}",
+                            wire.encode_record("completion", {
+                                "key": k, "tokens": [],
+                                "reason": "wire_error",
+                                "replica": self.replica_id,
+                                "wire_reason": e.reason}))
+                    except ConnectionError:
+                        pass
                     continue
                 if req.trace is not None:
                     self._traces[str(req.rid)] = req.trace
@@ -483,7 +552,19 @@ class ReplicaWorker:
             tokens = (tokens + 1 if tokens.size
                       else np.asarray([1], np.int32))
             comp = dataclasses.replace(comp, tokens=tokens)
+        if faults.corrupt_probe(str(comp.rid)):
+            # injected golden-probe wrongness: a quarantined replica
+            # that is still corrupt when re-probed (the reinstatement
+            # gate must hold it out; enough of these retires it)
+            tokens = np.asarray(comp.tokens, np.int32)
+            tokens = (tokens + 1 if tokens.size
+                      else np.asarray([1], np.int32))
+            comp = dataclasses.replace(comp, tokens=tokens)
         payload = _encode_completion(self.replica_id, comp)
+        # injected wire corruption: flip a bit in the ENCODED frame, so
+        # the router-side checksum — not any replica-side check — is
+        # the thing that has to catch it
+        payload = faults.flip_wire_bits(payload)
         done_key = f"{self.ns}/done/{comp.rid}"
         try:
             self._flush_done_buffer()
@@ -533,11 +614,12 @@ class ReplicaWorker:
             try:
                 self.client.set(
                     f"{self.ns}/exit/{self.replica_id}",
-                    json.dumps({"replica": self.replica_id,
-                                "served": self._served,
-                                "pool_drained": self.pool_drained(),
-                                "weights_version": self._weights_version,
-                                "clean": clean}).encode())
+                    wire.encode_record("heartbeat", {
+                        "replica": self.replica_id,
+                        "served": self._served,
+                        "pool_drained": self.pool_drained(),
+                        "weights_version": self._weights_version,
+                        "clean": clean}))
             except Exception:
                 pass
             self._pub.stop(final_publish=True)
@@ -588,6 +670,9 @@ class Router:
                  journal: bool = True,
                  compact_every: int = 50,
                  outage_grace_s: float = 5.0,
+                 quarantine: bool = True,
+                 golden_probe: GoldenProbe | None = None,
+                 quarantine_config: QuarantineConfig | None = None,
                  clock=time.monotonic,
                  wall=time.time,
                  sleeper=time.sleep) -> None:
@@ -677,6 +762,21 @@ class Router:
                                         unit="keys")
         self._obs_outage_polls = obs.counter("router/outage_polls",
                                              unit="polls")
+        # data-plane integrity: payloads that failed checksum/schema
+        # verification at a router decode site, and corrupt-segment
+        # verdicts replicas reported in-band.  Both feed the quarantine
+        # manager's strike ledger.
+        self._obs_checksum = obs.counter("integrity/checksum_mismatch",
+                                         unit="payloads")
+        self._obs_corrupt_seg = obs.counter("integrity/corrupt_segment",
+                                            unit="segments")
+        # golden probes need known-exact output: without `golden_probe`
+        # the manager still quarantines (exclusion is the safe default)
+        # but has no evidence path to reinstatement
+        self.quarantine = (QuarantineManager(
+            client, namespace=namespace, golden=golden_probe,
+            config=quarantine_config, clock=clock)
+            if quarantine else None)
         self._obs_journal = obs.gauge("router/journal_records",
                                       unit="records")
         self._obs_live = obs.gauge("router/replicas_live", unit="replicas")
@@ -843,11 +943,16 @@ class Router:
                 pass
         for key in (f"{self.ns}/replica/{rid}",
                     f"{self.ns}/metrics/{regs.get(rid, {}).get('rank')}",
-                    f"{self.ns}/draining/{rid}"):
+                    f"{self.ns}/draining/{rid}",
+                    f"{self.ns}/quarantined/{rid}"):
             try:
                 self.client.delete(key)
             except ConnectionError:
                 pass
+        if self.quarantine is not None:
+            # its quarantine record dies with it: a future replica
+            # reusing the id starts with a clean strike ledger
+            self.quarantine.drop(rid)
 
     # -- crash-recovery journal --------------------------------------------
     #
@@ -881,7 +986,7 @@ class Router:
             return
         try:
             self.client.set(self._journal_key(k),
-                            json.dumps(doc).encode())
+                            wire.encode_record("journal", doc))
         except ConnectionError:
             pass
 
@@ -895,7 +1000,7 @@ class Router:
             req = e["req"]
             self._journal_docs[k] = {
                 "schema": JOURNAL_SCHEMA,
-                "req": json.loads(_encode_request(k, req).decode()),
+                "req": _request_doc(k, req),
                 "rid": str(req.rid),
                 "assigned": None,
                 "attempts": 0,
@@ -1006,8 +1111,17 @@ class Router:
             if raw is None:
                 continue
             try:
-                doc = json.loads(raw.decode())
-            except ValueError:
+                doc = wire.decode_record(raw, expect="journal",
+                                         namespace=self.ns,
+                                         key=key[len(prefix):])
+            except wire.WireError as err:
+                # a corrupt journal record cannot be recovered FROM —
+                # count it and skip; the request it described either
+                # has a live done key (consumed normally) or is lost
+                # to this recovery, never a poll-loop crash
+                self._obs_checksum.inc()
+                log.warning("router: skipping corrupt journal record "
+                            "%s (%s)", key, err.reason)
                 continue
             if doc.get("schema") != JOURNAL_SCHEMA:
                 continue
@@ -1232,34 +1346,91 @@ class Router:
 
         # 1) consume completions FIRST: work a replica committed just
         # before dying must not be re-run
+        quarantined = (self.quarantine.quarantined()
+                       if self.quarantine is not None else set())
+
+        def reroute(key: str, k: str, e: dict, replica: str,
+                    reason: str) -> None:
+            """Un-deliver one done key: destroy it, clear the
+            assignment so dispatch re-routes, and back the replica
+            off — the shared tail of every shed/integrity verdict."""
+            self.client.delete(key)
+            e["assigned"] = None
+            self._journal_assign(k, e)
+            self._obs_rerouted.inc()
+            if replica:
+                self._backoff[replica] = (self._clock()
+                                          + self.reject_backoff_s)
+            self._decide("rejected", e, replica=replica or None,
+                         verdict=reason)
+
         done_prefix = f"{self.ns}/done/"
         for key in self.client.keys(done_prefix):
             k = key[len(done_prefix):]
+            if k.startswith("probe-"):
+                continue   # golden-probe answers: the quarantine
+                #            manager consumes these, not the run loop
             e = entries.get(k)
             if e is None or k in done:
                 continue
             raw = self.client.get(key)
             if raw is None:
                 continue
-            payload = json.loads(raw.decode())
+            try:
+                payload = wire.decode_record(
+                    raw, expect="completion", namespace=self.ns,
+                    key=k, replica=e["assigned"] or "")
+            except wire.WireError as err:
+                # a corrupt commit must never be delivered: count it,
+                # strike the replica the payload was assigned to (the
+                # bytes are untrustworthy, so attribution comes from
+                # the router's own assignment table), and redispatch
+                progressed = True
+                self._obs_checksum.inc()
+                log.warning("router: corrupt done payload %s (%s) "
+                            "from replica %r; redispatching", k,
+                            err.reason, err.replica)
+                if self.quarantine is not None and err.replica:
+                    self.quarantine.strike(err.replica,
+                                           f"wire/{err.reason}")
+                    quarantined = self.quarantine.quarantined()
+                reroute(key, k, e, err.replica, "checksum_mismatch")
+                continue
             req = e["req"]
             comp = Completion(
                 rid=req.rid, prompt=np.asarray(req.prompt),
-                tokens=np.asarray(payload["tokens"], np.int32),
-                reason=payload["reason"])
+                tokens=np.asarray(payload.get("tokens", ()), np.int32),
+                reason=str(payload.get("reason")))
             progressed = True
+            replica = str(payload.get("replica") or "")
             if comp.reason == "rejected":
                 # replica-side load shed: re-route, don't surface —
                 # the request was admitted to the FLEET, and some other
                 # replica (or this one, later) can still serve it
-                self.client.delete(key)
-                e["assigned"] = None
-                self._journal_assign(k, e)
-                self._obs_rerouted.inc()
-                self._backoff[payload.get("replica", "")] = (
-                    self._clock() + self.reject_backoff_s)
-                self._decide("rejected", e,
-                             replica=payload.get("replica"))
+                reroute(key, k, e, replica, "rejected")
+            elif comp.reason in ("corrupt_segment", "wire_error"):
+                # in-band integrity verdicts: the replica caught its
+                # own corruption (NaN-frozen lane / undecodable inbox
+                # payload).  Same answer as a checksum mismatch —
+                # strike + redispatch — just attributed by the replica
+                # itself instead of by this router's verification.
+                if comp.reason == "corrupt_segment":
+                    self._obs_corrupt_seg.inc()
+                else:
+                    self._obs_checksum.inc()
+                log.warning("router: replica %s reported %s for %s; "
+                            "redispatching", replica, comp.reason, k)
+                if self.quarantine is not None and replica:
+                    self.quarantine.strike(replica, comp.reason)
+                    quarantined = self.quarantine.quarantined()
+                reroute(key, k, e, replica, comp.reason)
+            elif replica and replica in quarantined:
+                # a quarantined replica's commit: the checksum proves
+                # the BYTES crossed intact, not that the compute behind
+                # them did — a replica under integrity suspicion does
+                # not get to deliver.  Redispatch to a trusted one
+                # (greedy determinism dedupes any duplicate).
+                reroute(key, k, e, replica, "quarantined")
             else:
                 # commit-point ordering: journal the terminal (WITH the
                 # tokens) before destroying the done key, so a crash in
@@ -1353,6 +1524,14 @@ class Router:
                         reason="failed"))
                     self._decide("failed", e, attempts=e["attempts"])
 
+        # 2.5) quarantine probe cycle: golden-query the quarantined
+        # (still-live) replicas toward reinstatement or retirement.
+        # After the death sweep on purpose — a quarantined replica that
+        # DIED was just dropped and must not be probed.
+        if self.quarantine is not None:
+            self.quarantine.tick(live=live)
+            quarantined = self.quarantine.quarantined()
+
         # 3) dispatch unassigned requests least-loaded
         now = self._clock()
         self._backoff = {r: t for r, t in self._backoff.items() if t > now}
@@ -1373,6 +1552,9 @@ class Router:
                       # graceful drain: admissions steer away; in-flight
                       # work finishes before the replica stops
                       and rid not in draining
+                      # integrity quarantine: alive and heartbeating,
+                      # but under suspicion — probed, never dispatched
+                      and rid not in quarantined
                       # blue-green: traffic is pinned to the active pool
                       and (pool is None or regs.get(rid, {})
                            .get("pool", "default") == pool)
@@ -1575,8 +1757,15 @@ class Router:
                 raw = None
             if raw is not None:
                 self.client.delete(f"{self.ns}/done/{key}")
-                tokens = np.asarray(json.loads(raw.decode())["tokens"],
-                                    np.int32)
+                try:
+                    doc = wire.decode_record(
+                        raw, expect="completion", namespace=self.ns,
+                        key=key, replica=target)
+                except wire.WireError as err:
+                    return rollback(
+                        "canary",
+                        f"undecodable canary answer ({err.reason})")
+                tokens = np.asarray(doc.get("tokens", ()), np.int32)
                 break
             if any(p.poll() is not None for p in procs):
                 return rollback("canary", "green worker died mid-canary")
@@ -1943,8 +2132,15 @@ def exit_reports(client: CoordClient, *,
     prefix = f"{namespace}/exit/"
     for key in client.keys(prefix):
         raw = client.get(key)
-        if raw is not None:
-            out[key[len(prefix):]] = json.loads(raw.decode())
+        if raw is None:
+            continue
+        try:
+            out[key[len(prefix):]] = wire.decode_record(
+                raw, expect="heartbeat", namespace=namespace,
+                key=key[len(prefix):])
+        except wire.WireError as e:
+            log.warning("exit_reports: undecodable report %s (%s)",
+                        key, e.reason)
     return out
 
 

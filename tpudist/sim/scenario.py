@@ -35,9 +35,9 @@ PROMPT_KINDS = ("uniform", "longtail")
 DEADLINE_KINDS = ("none", "uniform", "adversarial")
 # the FaultScript verbs, mirroring the TPUDIST_FAULT_* env knobs:
 # KILL_AFTER_SEGMENTS, HEARTBEAT_STOP_AFTER_S, COORD_OUTAGE_AT_S/_S,
-# ROUTER_KILL_AFTER_POLLS respectively
+# ROUTER_KILL_AFTER_POLLS, FLIP_WIRE_BITS respectively
 FAULT_KINDS = ("kill_replica", "drop_heartbeats", "coord_brownout",
-               "kill_router")
+               "kill_router", "corrupt_replica")
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -136,7 +136,8 @@ def _validate_tenant(t: dict) -> None:
 
 def _validate_fault(f: dict) -> None:
     _check_keys("fault", f,
-                {"kind", "at_s", "for_s", "rid", "at_poll"}, {"kind"})
+                {"kind", "at_s", "for_s", "rid", "at_poll", "every",
+                 "count"}, {"kind"})
     kind = f["kind"]
     _require(kind in FAULT_KINDS,
              f"fault.kind {kind!r} not in {FAULT_KINDS}")
@@ -164,6 +165,20 @@ def _validate_fault(f: dict) -> None:
                     {"kind", "at_poll"})
         _require(int(f["at_poll"]) >= 1,
                  "kill_router needs at_poll >= 1")
+    elif kind == "corrupt_replica":
+        # byzantine replica: from at_s, every Nth committed payload has
+        # a byte flipped AFTER framing (so the wire checksum is what
+        # catches it); an optional count cap lets the replica "heal" —
+        # the path golden-probe reinstatement is gated on
+        _check_keys("fault(corrupt_replica)", f,
+                    {"kind", "at_s", "rid", "every", "count"},
+                    {"kind", "at_s", "rid"})
+        _require(float(f["at_s"]) >= 0,
+                 "corrupt_replica needs at_s >= 0")
+        _require(int(f.get("every", 1)) >= 1,
+                 "corrupt_replica needs every >= 1")
+        _require(f.get("count") is None or int(f["count"]) >= 1,
+                 "corrupt_replica count must be >= 1 when set")
 
 
 _FLEET_DEFAULTS: dict[str, Any] = {
@@ -197,6 +212,14 @@ class Envelope:
     max_burn_rate_300s: float | None = None
     max_replica_deaths: int | None = None
     min_router_recoveries: int = 0
+    # data-plane integrity gates: quarantines the run must produce (a
+    # corruption scenario that never quarantines is a failed detection),
+    # reinstatements it must earn back, and the hard ceiling on
+    # CORRUPTED terminals actually delivered to callers (0 is the whole
+    # point of the checksummed wire)
+    min_quarantines: int = 0
+    min_reinstated: int = 0
+    max_corrupted_terminals: int | None = None
     decisions: dict = field(default_factory=dict)
 
     @classmethod
@@ -256,6 +279,17 @@ class Envelope:
         if recov < self.min_router_recoveries:
             bad.append(f"router_recoveries={recov:g} < min "
                        f"{self.min_router_recoveries}")
+        if num("quarantines") < self.min_quarantines:
+            bad.append(f"quarantines={num('quarantines'):g} < min "
+                       f"{self.min_quarantines}")
+        if num("reinstated") < self.min_reinstated:
+            bad.append(f"reinstated={num('reinstated'):g} < min "
+                       f"{self.min_reinstated}")
+        if self.max_corrupted_terminals is not None:
+            ct = num("corrupted_terminals")
+            if ct > self.max_corrupted_terminals:
+                bad.append(f"corrupted_terminals={ct:g} > "
+                           f"{self.max_corrupted_terminals}")
         for reason, bound in self.decisions.items():
             v = num(f"decisions_{reason}")
             lo, hi = bound.get("min"), bound.get("max")
@@ -502,6 +536,33 @@ BUILTIN: dict[str, dict] = {
             "min_router_recoveries": 1,
             "decisions": {"failed": {"max": 0},
                           "completed": {"min": 250}},
+        },
+    },
+    "silent_corruption": {
+        "name": "silent_corruption",
+        "duration_s": 30.0,
+        "arrival": {"kind": "constant", "rate": 10.0},
+        "seed": 20,
+        # no scale-downs: the quarantine window leaves one active
+        # replica, and draining IT would zero the fleet mid-probe
+        "fleet": {"replicas": 2,
+                  "autoscale": {**_AUTOSCALE_FAST, "idle_polls": 200}},
+        # r1 goes byzantine at 3 s: every committed payload has a byte
+        # flipped post-framing, for 8 payloads, then it "heals".  The
+        # wire checksum must catch every flip BEFORE delivery (zero
+        # corrupted terminals), the strike ledger must quarantine r1,
+        # golden probes must burn through the residual corruption and
+        # then reinstate it — all with zero lost requests, because
+        # every rejected completion is redispatched
+        "faults": [{"kind": "corrupt_replica", "at_s": 3.0,
+                    "rid": "r1", "every": 1, "count": 8}],
+        "envelope": {
+            "max_lost": 0,
+            "min_quarantines": 1,
+            "min_reinstated": 1,
+            "max_corrupted_terminals": 0,
+            "max_replica_deaths": 0,
+            "decisions": {"failed": {"max": 0}},
         },
     },
     "coord_brownout": {

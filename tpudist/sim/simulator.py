@@ -38,9 +38,14 @@ import numpy as np
 
 from tpudist import obs
 from tpudist.obs.registry import values_to_hist
-from tpudist.runtime import faults
+from tpudist.runtime import faults, wire
 from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
-from tpudist.runtime.router import Router, _decode_request
+from tpudist.runtime.router import (
+    GoldenProbe,
+    QuarantineConfig,
+    Router,
+    _decode_request,
+)
 from tpudist.sim.fabric import SimFabric
 from tpudist.sim.scenario import Envelope, ScenarioSpec
 from tpudist.sim.workload import (
@@ -125,6 +130,12 @@ class SimReplica:
         # mirror of ReplicaWorker's bounded done buffer
         self._done_buf: list[tuple[str, bytes]] = []
         self._hb_resume_at: float | None = None
+        # corrupt_replica chaos: every Nth framed commit gets a byte
+        # flipped (None = healthy); _corrupt_left caps the episode so
+        # the replica can heal and earn reinstatement
+        self._corrupt_every: int | None = None
+        self._corrupt_left: int | None = None
+        self._commits = 0
         # registration precedes the first heartbeat, exactly like a real
         # joiner mid-warmup (the router's join grace covers this window)
         import json
@@ -158,6 +169,15 @@ class SimReplica:
         self.fabric.down(f"{self.ns}:{self.rid}")
         self._hb_resume_at = self.clock.monotonic() + float(for_s)
 
+    def corrupt(self, *, every: int = 1, count: int | None = None) -> None:
+        """FLIP_WIRE_BITS equivalent: from now on, every ``every``-th
+        committed payload has one byte flipped AFTER framing — silent
+        corruption the router's wire checksum must catch.  ``count``
+        caps the episode (the replica heals), which is what lets the
+        quarantine's golden probes eventually pass."""
+        self._corrupt_every = max(1, int(every))
+        self._corrupt_left = None if count is None else int(count)
+
     # -- service model -----------------------------------------------------
 
     def _service_s(self, req) -> float:
@@ -175,10 +195,21 @@ class SimReplica:
             self._done_buf.pop(0)
 
     def _commit(self, req, reason: str, tokens: list[int]) -> None:
-        import json
-        payload = json.dumps(
-            {"key": str(req.rid), "tokens": tokens,
-             "reason": reason, "replica": self.rid}).encode()
+        # framed like a real worker's commit, so the router's checksum
+        # verification (and the corrupt_replica chaos below) exercises
+        # the same decode path as production
+        payload = wire.encode_record("completion", {
+            "key": str(req.rid), "tokens": tokens,
+            "reason": reason, "replica": self.rid})
+        self._commits += 1
+        if (self._corrupt_every is not None
+                and self._commits % self._corrupt_every == 0
+                and (self._corrupt_left is None or self._corrupt_left > 0)):
+            if self._corrupt_left is not None:
+                self._corrupt_left -= 1
+            pos = min(len(payload) - 1, max(9, len(payload) // 2))
+            payload = (payload[:pos] + bytes([payload[pos] ^ 0x10])
+                       + payload[pos + 1:])
         key = f"{self.ns}/done/{req.rid}"
         try:
             self._flush_done_buffer()
@@ -384,10 +415,21 @@ class FleetSim:
     # -- fleet construction ------------------------------------------------
 
     def _make_router(self) -> Router:
+        # the sim's golden probe: a SimReplica serves ANY request as
+        # tokens [0..max_new) with reason "length", so the known-exact
+        # answer is range(budget) — deterministic unless the replica is
+        # corrupting its commits, which is exactly what a probe tests
+        golden = GoldenProbe(prompt=(1, 2, 3, 4),
+                             expect=tuple(range(8)), max_new_tokens=8)
+        qcfg = QuarantineConfig(
+            strike_threshold=3, strike_window_s=30.0,
+            probe_interval_s=0.5, probe_timeout_s=10.0,
+            reinstate_after=3, retire_after_fails=10)
         return Router(
             self.fabric, namespace=self.ns,
             poll_s=float(self.spec.fleet["router_poll_s"]),
             use_health=False,
+            golden_probe=golden, quarantine_config=qcfg,
             clock=self.vc.monotonic, wall=self.vc.wall,
             sleeper=self._advance)
 
@@ -450,6 +492,9 @@ class FleetSim:
             target.kill()
         elif ev["kind"] == "drop_heartbeats":
             target.drop_heartbeats(ev["for_s"])
+        elif ev["kind"] == "corrupt_replica":
+            target.corrupt(every=int(ev.get("every", 1)),
+                           count=ev.get("count"))
 
     # -- one scenario run --------------------------------------------------
 
@@ -552,6 +597,18 @@ class FleetSim:
             "router_recoveries": delta.get("router/recoveries", 0.0),
             "burn_rate_300s": round(
                 obs.slo.burn_rates().get(300.0, 0.0), 4),
+            # data-plane integrity accounting (ISSUE 13): flips the
+            # wire checksum caught, quarantine lifecycle counts, and —
+            # the one that must stay zero — terminals DELIVERED whose
+            # tokens differ from the sim's deterministic service output
+            "checksum_mismatches": delta.get(
+                "integrity/checksum_mismatch", 0.0),
+            "quarantines": delta.get("router/quarantines", 0.0),
+            "reinstated": delta.get("router/reinstated", 0.0),
+            "retired": delta.get("router/retired", 0.0),
+            "probe_pass": delta.get("probe/pass", 0.0),
+            "probe_fail": delta.get("probe/fail", 0.0),
+            "corrupted_terminals": _corrupted_terminals(reqs, comps),
         }
         for reason in ("completed", "shed", "rejected", "failed",
                        "timeout"):
@@ -563,6 +620,22 @@ class FleetSim:
         return row
 
 
+def _corrupted_terminals(reqs, comps) -> int:
+    """Delivered completions whose tokens are NOT the sim data plane's
+    deterministic output (``range(max_new_tokens)`` with reason
+    ``length``) — i.e. corruption that made it past every integrity
+    gate to a caller.  The silent_corruption envelope pins this to 0."""
+    want = {str(r.rid): int(r.max_new_tokens) for r in reqs}
+    bad = 0
+    for c in comps:
+        if c.reason != "length" or str(c.rid) not in want:
+            continue
+        if [int(t) for t in np.asarray(c.tokens).tolist()] != list(
+                range(want[str(c.rid)])):
+            bad += 1
+    return bad
+
+
 def _counters_now(ns: str) -> dict[str, float]:
     """Current values of the process-global counters a scenario summary
     is computed from — summaries are before/after DELTAS because the
@@ -572,6 +645,9 @@ def _counters_now(ns: str) -> dict[str, float]:
     for name, m in snap.get("counters", {}).items():
         if name.startswith(("router/decisions/", "slo/bad", "slo/good",
                             "autoscale/", "router/replica_deaths",
-                            "router/recoveries", "coord/")):
+                            "router/recoveries", "coord/",
+                            "integrity/", "probe/", "quarantine/",
+                            "router/quarantines", "router/reinstated",
+                            "router/retired")):
             out[name] = float(m.get("value") or 0.0)
     return out
