@@ -1,0 +1,292 @@
+"""Copy-on-write prefix page sharing (ISSUE 14): pool/cache churn
+properties (no block writable from two live slots, refcounts drain to
+zero, pool returns fully free), hash-chain determinism, and ServeLoop
+exactness reading through shared blocks — chunked-interleaved prefill
+vs the one-shot path vs the dense greedy reference, at pipeline depths
+1 and 2."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.models.kv_pages import (BlockPool, PrefixCache, chain_hashes,
+                                     request_prefix_hash)
+from tpudist.models.serving import Request, ServeLoop
+from tpudist.models.transformer import TransformerConfig, TransformerLM
+
+BS = 16   # block size (must be a multiple of 8)
+
+
+# -- hash chains ------------------------------------------------------------
+
+class TestHashChains:
+    def test_one_hash_per_full_block_and_deterministic(self):
+        toks = np.arange(3 * BS + 5, dtype=np.int32)
+        hs = chain_hashes(toks, BS)
+        assert len(hs) == 3                       # partial block excluded
+        assert hs == chain_hashes(toks.copy(), BS)
+
+    def test_chain_binds_the_entire_prefix(self):
+        """Hash j must name block j's content AND everything before it:
+        two sequences with identical block-1 content but different
+        block-0 content must disagree on hash 1."""
+        a = np.arange(2 * BS, dtype=np.int32)
+        b = a.copy()
+        b[0] += 1
+        ha, hb = chain_hashes(a, BS), chain_hashes(b, BS)
+        assert ha[0] != hb[0]
+        assert ha[1] != hb[1]                     # poisoned by block 0
+
+    def test_request_prefix_hash_opaque_and_stable(self):
+        toks = np.asarray([5, 4, 3, 2, 1], np.int32)
+        h = request_prefix_hash(toks)
+        assert isinstance(h, int)
+        assert h == request_prefix_hash(list(toks))
+        assert h != request_prefix_hash(toks[:-1])
+
+
+# -- refcount / COW mechanics ----------------------------------------------
+
+class TestShareAndCow:
+    def test_share_aliases_without_allocating(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        pool.admit(0, 2 * BS, 0)
+        blocks = list(pool._slot_blocks[0])
+        free_before = pool.free_blocks
+        pool.admit(1, 2 * BS + 4, BS, shared=blocks)
+        # only the partial third block (+reservation) was allocated
+        assert pool.free_blocks < free_before
+        assert pool._slot_blocks[1][:2] == blocks
+        assert all(pool._refcount[b] == 2 for b in blocks)
+        pool.check()
+
+    def test_cow_split_on_aliased_block(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        pool.admit(0, 2 * BS, 0)
+        blocks = list(pool._slot_blocks[0])
+        pool.admit(1, 2 * BS, 0, shared=blocks)
+        new = pool.cow_write(1, 1)
+        assert new != blocks[1]
+        assert pool._refcount[blocks[1]] == 1     # back to slot 0 only
+        assert pool._slot_blocks[1] == [blocks[0], new]
+        pool.check()
+
+    def test_cow_noop_when_private(self):
+        pool = BlockPool(8, BS, 1, 8 * BS)
+        pool.admit(0, BS, 0)
+        blk = pool._slot_blocks[0][0]
+        assert pool.cow_write(0, 0) == blk        # write in place
+
+    def test_only_last_shared_block_is_cow_writable(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        pool.admit(0, 2 * BS, 0)
+        pool.admit(1, 2 * BS, 0, shared=list(pool._slot_blocks[0]))
+        with pytest.raises(RuntimeError, match="last shared block"):
+            pool.cow_write(1, 0)
+
+    def test_free_decrements_and_frees_only_at_zero(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        pool.admit(0, 2 * BS, 0)
+        blocks = list(pool._slot_blocks[0])
+        pool.admit(1, 2 * BS, 0, shared=blocks)
+        pool.free_slot(0)
+        assert all(pool._refcount[b] == 1 for b in blocks)
+        assert pool.used_blocks == 2              # alive under slot 1
+        pool.free_slot(1)
+        assert pool.free_blocks == 8
+        pool.check()
+
+
+# -- prefix cache -----------------------------------------------------------
+
+class TestPrefixCache:
+    def test_register_match_roundtrip_and_lru_eviction(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        cache = PrefixCache(pool)
+        toks = np.arange(2 * BS, dtype=np.int32)
+        pool.admit(0, 2 * BS, 0)
+        held = list(pool._slot_blocks[0])
+        assert cache.register(toks, held) == 2
+        pool.free_slot(0)                          # idle but cached
+        assert pool.used_blocks == 0
+        assert pool.free_blocks == 8               # cached-idle = capacity
+        assert cache.match(toks) == held
+        assert cache.peek(toks) == 2
+        assert cache.evict_one()
+        assert cache.peek(toks) < 2
+        cache.flush()
+        assert len(cache) == 0
+        assert pool.free_blocks == 8
+        pool.check()
+
+    def test_eviction_refuses_live_blocks(self):
+        pool = BlockPool(8, BS, 2, 8 * BS)
+        cache = PrefixCache(pool)
+        toks = np.arange(2 * BS, dtype=np.int32)
+        pool.admit(0, 2 * BS, 0)
+        cache.register(toks, list(pool._slot_blocks[0]))
+        assert not cache.evict_one()               # refcount 1: in use
+        pool.free_slot(0)
+        assert cache.evict_one()
+
+    def test_pool_reclaims_cached_idle_blocks_under_pressure(self):
+        pool = BlockPool(4, BS, 2, 4 * BS)
+        cache = PrefixCache(pool)
+        toks = np.arange(2 * BS, dtype=np.int32)
+        pool.admit(0, 2 * BS, 0)
+        cache.register(toks, list(pool._slot_blocks[0]))
+        pool.free_slot(0)
+        # all 4 blocks free-or-cached; a 4-block admission must succeed
+        # by evicting the cached pair on demand
+        assert pool.can_admit(4 * BS, 0)
+        pool.admit(1, 4 * BS, 0)
+        assert len(pool._slot_blocks[1]) == 4
+        pool.check()
+
+
+# -- 300-step churn property ------------------------------------------------
+
+class TestChurnProperty:
+    def test_admit_share_cow_grow_free_churn(self):
+        """300 random ops over the full protocol surface, ``check()``
+        after every one (no aliased/pinned block ever writable, table
+        consistent, reservation covered); at the end every slot freed +
+        cache flushed must drain the pool to fully free with all
+        refcounts zero."""
+        rng = np.random.default_rng(0xC057)
+        pool = BlockPool(24, BS, 4, 12 * BS)
+        cache = PrefixCache(pool)
+        # a small universe of prompts so shared prefixes actually recur
+        bases = [rng.integers(1, 60, size=n * BS).astype(np.int32)
+                 for n in (1, 2, 3)]
+        live: dict[int, int] = {}                  # slot -> prompt_len
+        for step in range(300):
+            op = rng.random()
+            free_slots = [s for s in range(4) if s not in live]
+            if op < 0.45 and free_slots:
+                slot = int(rng.choice(free_slots))
+                base = bases[int(rng.integers(len(bases)))]
+                tail = rng.integers(1, 60, size=int(
+                    rng.integers(0, BS + 5))).astype(np.int32)
+                prompt = np.concatenate([base, tail])
+                L = int(prompt.size)
+                max_new = int(rng.integers(1, 2 * BS))
+                n_sh = cache.peek(prompt)
+                cow = int(n_sh * BS >= L)
+                if not pool.can_admit(L, max_new, shared=n_sh, cow=cow):
+                    continue
+                blocks = cache.match(prompt)
+                if len(blocks) * BS >= L:          # full-prompt hit
+                    blocks_n = len(blocks)
+                    pool.admit(slot, L, max_new, shared=blocks)
+                    pool.cow_write(slot, blocks_n - 1)
+                else:
+                    pool.admit(slot, L, max_new, shared=blocks)
+                cache.register(prompt, pool._slot_blocks[slot])
+                live[slot] = L
+            elif op < 0.7 and live:
+                slot = int(rng.choice(list(live)))
+                pool.grow(slot, int(rng.integers(1, BS)))
+            elif op < 0.9 and live:
+                slot = int(rng.choice(list(live)))
+                pool.free_slot(slot)
+                del live[slot]
+            else:
+                cache.evict_one()
+            pool.check()
+        for slot in list(live):
+            pool.free_slot(slot)
+        cache.flush()
+        assert pool.free_blocks == pool.num_blocks
+        assert pool.used_blocks == 0
+        assert not any(pool._refcount)
+        assert not pool._pinned
+        pool.check()
+
+
+# -- end-to-end exactness through shared blocks -----------------------------
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, embed_dim=64, max_seq_len=96)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM(CFG).init(
+        jax.random.key(0), jnp.zeros((1, 2), jnp.int32))["params"]
+
+
+def _prompt(seed, n):
+    return np.asarray(jax.random.randint(jax.random.key(seed), (n,), 0, 64))
+
+
+def _want(params, prompt, n):
+    from tpudist.models.generate import greedy_generate
+    out = greedy_generate(CFG, params, jnp.asarray(prompt)[None, :], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _shared_prefix_requests():
+    base = _prompt(7, 24)                          # 3 shared blocks of 8
+    reqs = [Request(np.concatenate([base, _prompt(100 + i, 5 + i)]),
+                    10, rid=i) for i in range(5)]
+    reqs.append(Request(                           # exact repeat of rid=0
+        np.concatenate([base, _prompt(100, 5)]), 10, rid=5))
+    reqs.append(Request(base.copy(), 8, rid=6))    # block-aligned prompt
+    reqs.append(Request(base.copy(), 8, rid=7))    # full hit -> COW split
+    return reqs
+
+
+class TestServeExactness:
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_shared_blocks_bit_exact_vs_greedy(self, params, depth):
+        """Paged attend reading THROUGH shared blocks (including the
+        COW-split full-prompt repeat) must match each request's private
+        dense greedy rollout bit for bit."""
+        loop = ServeLoop(CFG, params, num_slots=3, steps_per_sync=4,
+                         decode_attention="flash", prefill_chunk=8,
+                         cache_layout="paged", kv_block_size=8,
+                         pipeline_depth=depth)
+        comps = loop.run(_shared_prefix_requests())
+        assert loop.prefix_stats["hits"] >= 4
+        assert loop.prefix_stats["prefill_tokens"] < \
+            loop.prefix_stats["prompt_tokens"]
+        for c in comps:
+            np.testing.assert_array_equal(
+                c.tokens, _want(params, c.prompt, len(c.tokens)),
+                err_msg=f"depth={depth} rid={c.rid}")
+        loop.flush_prefix_cache()
+        assert loop.pool.free_blocks == loop.pool.num_blocks
+        loop.pool.check()
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_chunked_matches_one_shot_prefill(self, params, depth):
+        """Chunked-interleaved prefill is a scheduling change only:
+        identical tokens to the non-chunked loop on a mixed
+        long+short-prompt batch."""
+        reqs = [Request(_prompt(50 + i, n), 9, rid=i)
+                for i, n in enumerate((40, 5, 23, 11))]
+        kw = dict(num_slots=2, steps_per_sync=4, prefill_chunk=8,
+                  decode_attention="flash", cache_layout="paged",
+                  kv_block_size=8, pipeline_depth=depth)
+        chunked = ServeLoop(CFG, params, chunked_prefill=True,
+                            prefix_sharing=False, **kw)
+        oneshot = ServeLoop(CFG, params, chunked_prefill=False,
+                            prefix_sharing=False, **kw)
+        a = {c.rid: c.tokens for c in chunked.run(list(reqs))}
+        b = {c.rid: c.tokens for c in oneshot.run(list(reqs))}
+        for rid in a:
+            np.testing.assert_array_equal(a[rid], b[rid],
+                                          err_msg=f"rid={rid}")
+        assert chunked.pool.free_blocks == chunked.pool.num_blocks
+
+    def test_intertoken_samples_recorded(self, params):
+        loop = ServeLoop(CFG, params, num_slots=2, steps_per_sync=4,
+                         prefill_chunk=8, cache_layout="paged",
+                         kv_block_size=8)
+        loop.run([Request(_prompt(1, 7), 12, rid="a"),
+                  Request(_prompt(2, 9), 12, rid="b")])
+        assert loop.intertoken_samples
+        assert all(gap >= 0 and n > 0
+                   for gap, n in loop.intertoken_samples)

@@ -16,6 +16,7 @@ DOCS = {
     "journal": {"schema": "tpudist.journal/1", "rid": "caller",
                 "assigned": None, "attempts": 0, "terminal": None},
     "heartbeat": {"replica": "r0", "served": 12, "clean": True},
+    "prefix": {"replica": "r0", "hashes": [12345678901, 42]},
 }
 
 

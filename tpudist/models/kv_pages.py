@@ -37,12 +37,41 @@ optimistic over-commit (no preemption/swap machinery needed), yet keeps
 the capacity win: a short-prompt / small-budget request holds a few
 blocks, not a ``max_seq_len`` lane.
 
+Prefix sharing (PR 14) adds copy-on-write block aliasing on top:
+
+* every block carries a REFCOUNT (number of slot references); a freed
+  slot decrements instead of freeing, and a block returns to the free
+  list only when its refcount hits zero and the prefix cache does not
+  pin it;
+* :meth:`share` aliases an existing block run into a fresh slot's
+  leading positions (the shared prefix is strictly read-only for that
+  slot — decode and suffix-prefill writes land past it);
+* :meth:`cow_write` splits the one legal write into a shared region —
+  the LAST shared block, written when a full-prompt cache hit must
+  recompute its final position to produce the first output logit — by
+  moving the slot onto a private copy (``serve/cow_splits``);
+* :class:`PrefixCache` maps rolling token-hash chains (one blake2b
+  chain link per full block, so a hash names the block's content AND
+  everything before it) to pool blocks, pinning them so idle prefixes
+  survive ``free_slot``; eviction is LRU over refcount-0 entries only
+  and runs on demand when the free list is empty.
+
+Cached-but-idle blocks (pinned, refcount 0) are RECLAIMABLE capacity:
+:attr:`free_blocks` and the ``serve/kv_blocks_free`` gauge count them,
+``serve/kv_frag`` measures fragmentation over live (slot-referenced)
+blocks only, and :attr:`used_blocks` excludes them — so admission
+control, the drain check, and the autoscaler all see truthful pressure.
+
 The device half lives in :mod:`tpudist.models.transformer`
 (``CausalSelfAttention._paged_attend``) and
 :func:`tpudist.ops.flash_decode.paged_flash_decode`.
 """
 
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from collections.abc import Callable, Sequence
 
 import numpy as np
 
@@ -52,6 +81,42 @@ from tpudist import obs
 def blocks_for(tokens: int, block_size: int) -> int:
     """Blocks needed to cover ``tokens`` positions (ceil division)."""
     return -(-int(tokens) // block_size)
+
+
+def _hash_bytes(data: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> list[int]:
+    """Rolling hash chain over ``tokens``, one link per FULL block.
+
+    Link ``j`` hashes block ``j``'s tokens together with link ``j-1``,
+    so it names the block's content AND the entire prefix before it —
+    two prompts share link ``j`` iff their first ``(j+1)*block_size``
+    tokens are identical.  blake2b over the int32 byte encoding keeps
+    the chain deterministic across processes (router, replicas, and the
+    offline simulator must agree)."""
+    toks = np.asarray(tokens, np.int32)
+    out: list[int] = []
+    prev = b""
+    for j in range(len(toks) // block_size):
+        prev = hashlib.blake2b(
+            prev + toks[j * block_size:(j + 1) * block_size].tobytes(),
+            digest_size=8).digest()
+        out.append(int.from_bytes(prev, "big"))
+    return out
+
+
+def request_prefix_hash(tokens: Sequence[int]) -> int:
+    """Order-64-bit hash of a token span, for wire-level prefix affinity.
+
+    Clients stamp ``Request.prefix_hash`` with this over the shared
+    prefix they know about (e.g. a tenant's system prompt); replicas
+    publish the hashes they recently admitted; the router steers
+    matching requests to a replica that already holds the prefix.  The
+    hash is opaque end to end — nothing needs to agree on block sizes."""
+    return _hash_bytes(np.asarray(tokens, np.int32).tobytes())
 
 
 class BlockPool:
@@ -93,52 +158,115 @@ class BlockPool:
         self._watermark = [0] * num_slots
         self._cap = [0] * num_slots
         self._reserved_total = 0  # blocks promised but not yet allocated
+        # COW bookkeeping: per-block slot-reference counts, the set of
+        # blocks pinned by the prefix cache, and per-slot count of
+        # leading blocks that are SHARED (read-only for that slot)
+        self._refcount = [0] * self.num_blocks
+        self._pinned: set[int] = set()
+        self._shared_upto = [0] * num_slots
+        self._prompt_len = [0] * num_slots
+        # set by PrefixCache: frees >=1 refcount-0 cached block on
+        # demand; lets reservations count evictable blocks as capacity
+        self._evict_hook: Callable[[], bool] | None = None
         self.table = np.zeros(
             (num_slots, self.max_blocks_per_slot), np.int32)
         self._obs_used = obs.gauge("serve/kv_blocks_used", unit="blocks")
         self._obs_free = obs.gauge("serve/kv_blocks_free", unit="blocks")
         self._obs_frag = obs.gauge("serve/kv_frag", unit="fraction")
+        self._obs_cow = obs.counter("serve/cow_splits", unit="blocks")
         self._publish()
 
     # -- accounting --------------------------------------------------------
 
+    def _evictable(self) -> int:
+        """Cached-but-idle blocks: pinned by the prefix cache, referenced
+        by no slot — reclaimable on demand via the eviction hook."""
+        return sum(1 for b in self._pinned if self._refcount[b] == 0)
+
     @property
     def free_blocks(self) -> int:
-        """Blocks neither allocated nor promised to a live reservation."""
-        return len(self._free) - self._reserved_total
+        """Blocks neither live nor promised to a reservation.  Counts
+        cached-but-idle blocks: they are evicted on demand, so they ARE
+        capacity — hiding them would starve admission behind a cache."""
+        return len(self._free) + self._evictable() - self._reserved_total
 
     @property
     def used_blocks(self) -> int:
-        return self.num_blocks - len(self._free)
+        """Blocks holding live, non-reclaimable data.  Cached-but-idle
+        blocks are excluded: a drained pool with a warm prefix cache is
+        still drained."""
+        return self.num_blocks - len(self._free) - self._evictable()
 
     def _publish(self) -> None:
-        used = self.used_blocks
+        evictable = self._evictable()
+        used = self.num_blocks - len(self._free) - evictable
         self._obs_used.set(used)
-        self._obs_free.set(self.num_blocks - used)
+        self._obs_free.set(len(self._free) + evictable)
+        live = {b for blks in self._slot_blocks for b in blks}
         covered = sum(self._watermark)
-        alloc_tokens = used * self.block_size
-        # internal fragmentation of the allocated set: the fraction of
-        # allocated token slots not under any slot's coverage watermark
-        self._obs_frag.set(
-            0.0 if not alloc_tokens else 1.0 - covered / alloc_tokens)
+        alloc_tokens = len(live) * self.block_size
+        # internal fragmentation of the LIVE set: the fraction of live
+        # token slots not under any slot's coverage watermark.  Shared
+        # blocks are counted once but covered by several watermarks, so
+        # the ratio is clamped — sharing is the opposite of waste.
+        frag = 0.0 if not alloc_tokens else 1.0 - covered / alloc_tokens
+        self._obs_frag.set(min(1.0, max(0.0, frag)))
 
     def check(self) -> None:
         """Allocator invariants — cheap enough to run in tests every
-        segment: no block on two live slots, no block both free and
-        allocated, reservation arithmetic consistent."""
-        live = [blk for blks in self._slot_blocks for blk in blks]
-        if len(live) != len(set(live)):
-            raise AssertionError("a block is referenced by two live slots")
-        overlap = set(live) & set(self._free)
-        if overlap:
-            raise AssertionError(f"blocks both free and live: {overlap}")
-        if len(live) + len(self._free) != self.num_blocks:
-            raise AssertionError("leaked blocks: live + free != pool")
-        if self._reserved_total < 0 or (
-                self._reserved_total > len(self._free)):
+        segment: refcounts match slot references, nothing both free and
+        referenced/pinned, shared blocks only ever aliased read-only,
+        reservation arithmetic consistent."""
+        counts = [0] * self.num_blocks
+        for slot, blks in enumerate(self._slot_blocks):
+            if len(blks) != len(set(blks)):
+                raise AssertionError(
+                    f"slot {slot} references a block twice: {blks}")
+            for blk in blks:
+                counts[blk] += 1
+        if counts != self._refcount:
+            bad = [b for b in range(self.num_blocks)
+                   if counts[b] != self._refcount[b]]
             raise AssertionError(
-                f"reservation {self._reserved_total} outside free list "
-                f"{len(self._free)}")
+                f"refcount drift on blocks {bad}: "
+                f"counted {[counts[b] for b in bad]}, "
+                f"recorded {[self._refcount[b] for b in bad]}")
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate blocks on the free list")
+        bad = [b for b in free if counts[b] or b in self._pinned]
+        if bad:
+            raise AssertionError(
+                f"blocks both free and referenced/pinned: {bad}")
+        live = {b for b in range(self.num_blocks) if counts[b]}
+        idle_cached = {b for b in self._pinned if not counts[b]}
+        if len(live) + len(idle_cached) + len(free) != self.num_blocks:
+            raise AssertionError(
+                "leaked blocks: live + cached-idle + free != pool")
+        for slot, blks in enumerate(self._slot_blocks):
+            for j, blk in enumerate(blks):
+                # a slot writes block j only past its shared boundary
+                # AND past its prompt (suffix prefill at admission,
+                # decode appends after) — any aliased or pinned block
+                # in that writable region is a latent corruption
+                writable = (j >= self._shared_upto[slot]
+                            and (j + 1) * self.block_size
+                            > self._prompt_len[slot])
+                if writable and counts[blk] > 1:
+                    raise AssertionError(
+                        f"block {blk} aliased by {counts[blk]} slots but "
+                        f"writable from slot {slot} (index {j}, shared "
+                        f"boundary {self._shared_upto[slot]}, prompt "
+                        f"{self._prompt_len[slot]})")
+                if writable and blk in self._pinned:
+                    raise AssertionError(
+                        f"pinned block {blk} in slot {slot}'s writable "
+                        "region — decode writes would corrupt the cache")
+        if self._reserved_total < 0 or self._reserved_total > (
+                len(self._free) + len(idle_cached)):
+            raise AssertionError(
+                f"reservation {self._reserved_total} outside reclaimable "
+                f"capacity {len(self._free)} + {len(idle_cached)}")
 
     # -- allocation --------------------------------------------------------
 
@@ -147,30 +275,95 @@ class BlockPool:
         total = min(prompt_len + max_new_tokens, self.max_seq_len)
         return blocks_for(total, self.block_size)
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  shared: int = 0, cow: int = 0) -> bool:
+        """``shared`` blocks arrive by aliasing (no allocation); ``cow``
+        is the extra private block a copy-on-write split will draw
+        immediately after admit (full-prompt cache hits)."""
         return (self.request_blocks(prompt_len, max_new_tokens)
-                <= self.free_blocks)
+                - shared + cow <= self.free_blocks)
 
-    def admit(self, slot: int, prompt_len: int,
-              max_new_tokens: int) -> None:
+    def _take_block(self) -> int:
+        if not self._free and not (
+                self._evict_hook is not None and self._evict_hook()):
+            raise RuntimeError("block pool exhausted")
+        return self._free.pop()
+
+    def admit(self, slot: int, prompt_len: int, max_new_tokens: int,
+              shared: Sequence[int] = ()) -> None:
         """Allocate blocks covering the prompt and reserve the rest of
-        the request's footprint.  Caller must have checked
-        :meth:`can_admit` (raises ``RuntimeError`` otherwise)."""
+        the request's footprint.  ``shared`` aliases existing blocks
+        (refcount++) under the slot's leading positions instead of
+        allocating them.  Caller must have checked :meth:`can_admit`
+        with the same ``shared`` count (raises ``RuntimeError``
+        otherwise)."""
         if self._slot_blocks[slot]:
             raise RuntimeError(f"slot {slot} still holds blocks; "
                                "free_slot it before re-admitting")
         total = self.request_blocks(prompt_len, max_new_tokens)
         now = blocks_for(prompt_len, self.block_size)
-        if total > self.free_blocks:
+        if len(shared) > now:
+            raise ValueError(
+                f"{len(shared)} shared blocks exceed the prompt's "
+                f"{now}-block footprint")
+        if total - len(shared) > self.free_blocks:
             raise RuntimeError(
-                f"admit of {total} blocks exceeds free {self.free_blocks}"
-                " (call can_admit first)")
+                f"admit of {total - len(shared)} blocks exceeds free "
+                f"{self.free_blocks} (call can_admit first)")
         self._cap[slot] = min(prompt_len + max_new_tokens,
                               self.max_seq_len)
         self._reserved_total += total - now
+        if shared:
+            self.share(slot, shared)
         self._grow_to(slot, now)
         self._watermark[slot] = prompt_len
+        self._prompt_len[slot] = prompt_len
         self._publish()
+
+    def share(self, slot: int, blocks: Sequence[int]) -> None:
+        """Alias ``blocks`` under ``slot``'s leading positions
+        (refcount++ each).  The slot must be empty — a shared prefix is
+        by construction the FIRST thing in a sequence — and treats the
+        aliased run as read-only: the only legal write into it is the
+        :meth:`cow_write` split of its final block."""
+        blks = self._slot_blocks[slot]
+        if blks:
+            raise RuntimeError(
+                f"share() into non-empty slot {slot}: a shared prefix "
+                "must precede any private blocks")
+        for blk in blocks:
+            self._refcount[blk] += 1
+            self.table[slot, len(blks)] = blk
+            blks.append(blk)
+        self._shared_upto[slot] = len(blks)
+
+    def cow_write(self, slot: int, block_idx: int) -> int:
+        """Make ``slot``'s block at ``block_idx`` privately writable,
+        splitting (new private block, old refcount--) if it is aliased
+        or pinned.  Only the LAST shared block is a legal target: that
+        is the one block the serving protocol ever writes inside a
+        shared region (a full-prompt hit recomputing its final position
+        for the first output logit).  Returns the block now under the
+        slot — the caller re-inserts that block's content from its
+        recomputed dense cache, which IS the copy."""
+        blks = self._slot_blocks[slot]
+        old = blks[block_idx]
+        if self._refcount[old] == 1 and old not in self._pinned:
+            return old  # already private — write in place
+        if block_idx != self._shared_upto[slot] - 1:
+            raise RuntimeError(
+                f"cow_write at index {block_idx} of slot {slot}, but only "
+                f"the last shared block "
+                f"({self._shared_upto[slot] - 1}) is writable")
+        new = self._take_block()
+        self._refcount[old] -= 1
+        self._refcount[new] = 1
+        blks[block_idx] = new
+        self.table[slot, block_idx] = new
+        self._shared_upto[slot] = block_idx
+        self._obs_cow.inc()
+        self._publish()
+        return new
 
     def grow(self, slot: int, steps: int) -> None:
         """Advance ``slot``'s coverage by ``steps`` decode tokens (capped
@@ -188,19 +381,168 @@ class BlockPool:
     def _grow_to(self, slot: int, count: int) -> None:
         blks = self._slot_blocks[slot]
         while len(blks) < count:
-            blk = self._free.pop()
+            blk = self._take_block()
+            self._refcount[blk] = 1
             self.table[slot, len(blks)] = blk
             blks.append(blk)
 
     def free_slot(self, slot: int) -> None:
-        """Return ``slot``'s blocks and its unused reservation to the
-        pool (free-on-finalize: the capacity is reusable immediately)."""
+        """Decrement ``slot``'s block refcounts and return its unused
+        reservation; blocks reaching refcount 0 go back to the free list
+        unless the prefix cache pins them (those stay resident as
+        cached-idle capacity, reclaimed lazily by LRU eviction)."""
         blks = self._slot_blocks[slot]
         held = blocks_for(self._cap[slot], self.block_size) if blks else 0
         self._reserved_total -= max(held - len(blks), 0)
-        self._free.extend(reversed(blks))
+        drop = []
+        for blk in blks:
+            self._refcount[blk] -= 1
+            if not self._refcount[blk] and blk not in self._pinned:
+                drop.append(blk)
+        self._free.extend(reversed(drop))
         blks.clear()
         self.table[slot, :] = 0
         self._watermark[slot] = 0
         self._cap[slot] = 0
+        self._shared_upto[slot] = 0
+        self._prompt_len[slot] = 0
         self._publish()
+
+    # -- prefix-cache pinning ---------------------------------------------
+
+    def cache_pin(self, blk: int) -> None:
+        self._pinned.add(blk)
+
+    def cache_unpin(self, blk: int) -> None:
+        """Drop the cache's pin; if no slot references the block either,
+        it returns to the free list immediately."""
+        self._pinned.discard(blk)
+        if not self._refcount[blk]:
+            self._free.append(blk)
+        self._publish()
+
+
+class PrefixCache:
+    """Host-side map from rolling prefix-hash chains to pool blocks.
+
+    One entry per FULL block of a registered prompt: ``chain_hashes(
+    prompt)[j] -> block``, where the chain construction guarantees the
+    hash names the block's content and its entire prefix.  Matching a
+    new prompt walks its own chain left to right and collects blocks
+    while hashes keep hitting — the longest cached prefix, always
+    block-aligned.
+
+    Entries PIN their blocks in the pool, so an idle prefix survives
+    ``free_slot`` and the next same-prefix admission aliases it back in
+    via :meth:`BlockPool.share`.  Eviction is LRU and only over entries
+    whose block no live slot references (refcount 0) — evicting a block
+    under a live slot would tear KV out from under in-flight decode.
+    The pool calls :meth:`_evict_for_pool` on demand when its free list
+    runs dry, which is what lets cached-idle blocks count as capacity.
+
+    Registration is first-wins: a hash already present keeps its
+    original block (the new admission's identical copy stays private to
+    its slot and is freed normally).  Content safety: a pinned block is
+    written only by the admission that registered it, below its
+    prompt's coverage — decode writes land past the prompt, COW splits
+    move writers OFF the cached block — so a hit always aliases bytes
+    bit-identical to a fresh prefill (greedy determinism holds).
+    """
+
+    def __init__(self, pool: BlockPool,
+                 capacity_blocks: int | None = None) -> None:
+        self.pool = pool
+        self.capacity_blocks = capacity_blocks
+        self._entries: OrderedDict[int, int] = OrderedDict()
+        pool._evict_hook = self._evict_for_pool
+        self._obs_hits = obs.counter("serve/prefix_hits", unit="requests")
+        self._obs_hit_tokens = obs.counter(
+            "serve/prefix_hit_tokens", unit="tokens")
+        self._obs_evictions = obs.counter(
+            "serve/prefix_evictions", unit="blocks")
+        self._obs_cached = obs.gauge(
+            "serve/prefix_cached_blocks", unit="blocks")
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, tokens: Sequence[int]) -> list[int]:
+        """Blocks covering the longest cached prefix of ``tokens``
+        (possibly all of it).  Touches matched entries' LRU recency but
+        takes no references — the caller aliases the blocks via
+        ``admit(..., shared=...)``, which is what protects them from
+        eviction while the request lives."""
+        out: list[int] = []
+        for h in chain_hashes(tokens, self.pool.block_size):
+            blk = self._entries.get(h)
+            if blk is None:
+                break
+            self._entries.move_to_end(h)
+            out.append(blk)
+        if out:
+            self._obs_hits.inc()
+            self._obs_hit_tokens.inc(len(out) * self.pool.block_size)
+        return out
+
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Matched block count WITHOUT touching recency or the hit
+        metrics — admission control's capacity precheck (the real
+        :meth:`match` runs once, at the admit that follows)."""
+        n = 0
+        for h in chain_hashes(tokens, self.pool.block_size):
+            if h not in self._entries:
+                break
+            n += 1
+        return n
+
+    def register(self, tokens: Sequence[int],
+                 slot_blocks: Sequence[int]) -> int:
+        """Pin and index ``tokens``'s fully-covered blocks (first-wins
+        per hash).  ``slot_blocks`` is the owning slot's block list from
+        the admission that just prefilled them.  Returns the number of
+        newly registered blocks."""
+        added = 0
+        hashes = chain_hashes(tokens, self.pool.block_size)
+        for j, h in enumerate(hashes):
+            if h in self._entries:
+                self._entries.move_to_end(h)
+                continue
+            while (self.capacity_blocks is not None
+                   and len(self._entries) >= self.capacity_blocks):
+                if not self.evict_one():
+                    break
+            if (self.capacity_blocks is not None
+                    and len(self._entries) >= self.capacity_blocks):
+                break
+            self._entries[h] = slot_blocks[j]
+            self.pool.cache_pin(slot_blocks[j])
+            added += 1
+        self._obs_cached.set(len(self._entries))
+        self.pool._publish()
+        return added
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used entry whose block no live slot
+        references.  Returns False when every entry is in use."""
+        for h, blk in self._entries.items():  # OrderedDict: LRU first
+            if not self.pool._refcount[blk]:
+                del self._entries[h]
+                self.pool.cache_unpin(blk)
+                self._obs_evictions.inc()
+                self._obs_cached.set(len(self._entries))
+                return True
+        return False
+
+    def _evict_for_pool(self) -> bool:
+        """Pool callback: free at least one block into the free list."""
+        return self.evict_one()
+
+    def flush(self) -> None:
+        """Drop every entry — cached KV is invalid the moment weights
+        hot-swap.  Blocks still referenced by live slots (there are none
+        at the drain-gated swap point, but be safe) just lose their pin
+        and are freed by their slot's finalize."""
+        for h, blk in list(self._entries.items()):
+            del self._entries[h]
+            self.pool.cache_unpin(blk)
+        self._obs_cached.set(0)

@@ -56,7 +56,7 @@ from tpudist.models.generate import (
     _stop_array,
     serving_layout,
 )
-from tpudist.models.kv_pages import BlockPool
+from tpudist.models.kv_pages import BlockPool, PrefixCache
 from tpudist.models.speculative import (
     AdaptiveDraftPolicy,
     _accept_and_next,
@@ -94,7 +94,16 @@ class Request:
     local runs): minted by the router at submit, it rides the fleet
     wire format and keys every lifecycle event this loop records —
     admit, segments, degrade clamps, timeouts, finalize — to the one
-    fleet-wide id that survives a SIGKILL + redispatch."""
+    fleet-wide id that survives a SIGKILL + redispatch.
+
+    ``prefix_hash`` is an opaque client-stamped hash of the prompt's
+    shared prefix (:func:`tpudist.models.kv_pages.request_prefix_hash`
+    over e.g. a tenant's system prompt; ``None`` = no known prefix).
+    The serve loop records recently admitted hashes while prefix
+    sharing is on (:meth:`ServeLoop.prefix_summary`), replicas publish
+    them, and the router steers same-hash requests to a replica whose
+    prefix cache is already warm — fleet-level hit rate survives
+    scale-out without any process agreeing on block sizes."""
 
     prompt: np.ndarray            # [L] int32 tokens, L >= 1
     max_new_tokens: int
@@ -102,6 +111,7 @@ class Request:
     deadline_s: float | None = None
     priority: int = 0             # 0 = best-effort; higher = keep longer
     trace: Any = None             # TraceContext | None (fleet tracing)
+    prefix_hash: int | None = None  # router prefix-affinity key
 
 
 @dataclasses.dataclass
@@ -236,6 +246,23 @@ class ServeLoop:
         from ``spec_ladder`` using the observed acceptance rate and
         measured per-round costs (each ladder K compiles once).
       spec_ladder: candidate K values for the adaptive policy.
+      chunked_prefill: interleave admission prefill with decode
+        (plain decode mode only; speculative keeps one-shot admission).
+        Instead of one fused prefill+insert dispatch, admission
+        dispatches ONE ``prefill_chunk``-wide slice per host-loop
+        iteration between fused decode segments, so a 10k-token prompt
+        can no longer stall every in-flight request's inter-token
+        latency for its whole prefill.  The chunk partition is the SAME
+        grid the one-shot path uses, so output stays token-identical.
+      prefix_sharing: copy-on-write prefix page sharing (paged layout +
+        chunked prefill only; silently off otherwise).  A host-side
+        :class:`~tpudist.models.kv_pages.PrefixCache` maps rolling
+        token-hash chains to pool blocks; an admission whose prompt
+        prefix is cached ALIASES those blocks (refcounted, read-only)
+        and prefills only the suffix — a full-prompt hit recomputes
+        one position through a COW split of the last shared block.
+        The cache is flushed at every weight hot-swap (cached KV is
+        stale the moment params change).
     """
 
     def __init__(
@@ -266,6 +293,8 @@ class ServeLoop:
         draft_params: Any = None,
         num_draft: int | str = "adaptive",
         spec_ladder: Sequence[int] = (2, 4, 8),
+        chunked_prefill: bool = True,
+        prefix_sharing: bool = True,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -388,6 +417,28 @@ class ServeLoop:
         else:
             self.kv_block_size = self.kv_num_blocks = 0
             self.pool = None
+        # chunked-interleaved prefill: plain decode only (the
+        # speculative admit fuses a draft prefill into the same dispatch
+        # and keeps the one-shot path); prefix sharing additionally
+        # needs the paged layout — shared blocks live in the pool
+        self.chunked = bool(chunked_prefill) and decode_mode == "plain"
+        self._prefix_cache = (
+            PrefixCache(self.pool)
+            if prefix_sharing and self.chunked and self.pool is not None
+            else None)
+        # recently admitted request prefix hashes (wire-opaque ints from
+        # Request.prefix_hash), LRU-bounded — the replica's published
+        # affinity summary (see prefix_summary)
+        self._affinity_recent: dict[int, None] = {}
+        # cumulative host-side tallies benches read as deltas (obs
+        # counters also tick; this avoids registry round trips)
+        self.prefix_stats = {"requests": 0, "hits": 0, "hit_tokens": 0,
+                             "prompt_tokens": 0, "prefill_tokens": 0}
+        # per-run (gap_seconds_per_token, tokens) samples, one per
+        # drained decode segment — benches compute p99 inter-token
+        # latency from these (reset at every run())
+        self.intertoken_samples: list[tuple[float, int]] = []
+        self._last_drain_t: float | None = None
         self.model = TransformerLM(cfg, decode=True,
                                    decode_attention=decode_attention,
                                    serve_side_slots=self.side,
@@ -468,6 +519,14 @@ class ServeLoop:
         self._pending_swap: dict | None = None
         self._obs_requests = obs.counter("serve/requests", unit="reqs")
         self._obs_tokens = obs.counter("serve/tokens", unit="tokens")
+        # prefix-sharing accounting: prompt_tokens is every admitted
+        # prompt position, prefill_tokens only the positions actually
+        # recomputed (the suffix past the cached prefix) — their ratio
+        # is the prefill work the cache saved
+        self._obs_prompt_tokens = obs.counter("serve/prompt_tokens",
+                                              unit="tokens")
+        self._obs_prefill_tokens = obs.counter("serve/prefill_tokens",
+                                               unit="tokens")
         self._obs_rejected = obs.counter("serve/rejected", unit="reqs")
         self._obs_timeouts = obs.counter("serve/timeouts", unit="reqs")
         # data-plane integrity: lanes the in-graph NaN/inf logit guard
@@ -543,6 +602,20 @@ class ServeLoop:
         # device work without touching live state
         self._prefill_one = jax.jit(self._prefill_impl,
                                     static_argnames=("true_chunk",))
+        if self.chunked:
+            # chunked admission's three dispatches: (a) gather a shared
+            # prefix's pool blocks into the dense batch-1 prefill cache
+            # (reads self.cache without donating — the segment chain
+            # donates it later, which is fine sequentially), (b) ONE
+            # prompt chunk per host-loop iteration (cache1 is NOT
+            # donated: the first chunk may receive the shared _blank1
+            # template), (c) the finish: insert + lane stamps, donating
+            # the live carry exactly like _admit_dev
+            self._gather_prefix = jax.jit(self._gather_prefix_impl)
+            self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
+                                          static_argnames=("chunk",))
+            self._admit_finish = jax.jit(self._admit_finish_impl,
+                                         donate_argnums=(0, 1, 2, 3, 4))
         if decode_mode == "speculative":
             # num_draft is STATIC (the draft scan's length is a shape);
             # each ladder K compiles once.  first (argnum 7) is NOT
@@ -691,13 +764,17 @@ class ServeLoop:
         first = self._select(last[None, :], key)[0].astype(jnp.int32)
         return cache, first
 
-    def _insert_impl(self, cache, cache1, slot, true_len, pages):
+    def _insert_impl(self, cache, cache1, slot, true_len, pages,
+                     write_block=0):
         """Scatter the prefilled batch-1 cache into slot ``slot`` —
         matched BY NAME because the slot cache carries side buffers the
         prefill cache does not (they are left untouched: side_index is 0
         between segments and stale side rows are masked).  Paged nodes
         are intercepted whole: the prefill cache is always DENSE and its
-        row is re-blocked into the slot's pages."""
+        row is re-blocked into the slot's pages.  ``write_block`` skips
+        the scatter below that block index — a shared-prefix admission
+        must not rewrite blocks other slots alias (its page row still
+        maps them; only the suffix's private blocks take writes)."""
         def walk(big, small):
             if not isinstance(big, dict):
                 if big.ndim == 1:      # cache_index vector <- true length
@@ -705,23 +782,27 @@ class ServeLoop:
                 return big.at[slot].set(small[0])
             if "paged_key" in big:
                 return self._insert_paged_node(
-                    big, small, slot, true_len, pages)
+                    big, small, slot, true_len, pages, write_block)
             return {k: (walk(v, small[k]) if k in small else v)
                     for k, v in big.items()}
         return walk(cache, cache1)
 
-    def _insert_paged_node(self, big, small, slot, true_len, pages):
+    def _insert_paged_node(self, big, small, slot, true_len, pages,
+                           write_block=0):
         """Scatter one layer's dense batch-1 prefill row into the block
         pool through the slot's page row: the ``[S, F]`` row reshapes to
         ``[M, block, F]`` blocks and lands at pool indices ``pages``;
-        blocks past the prompt's coverage target the (out-of-range)
-        index ``num_blocks`` and are DROPPED — only allocated pages are
-        written, so no live block of another slot can be hit."""
+        blocks past the prompt's coverage — and below ``write_block``
+        (shared-prefix blocks owned by the cache) — target the
+        (out-of-range) index ``num_blocks`` and are DROPPED — only this
+        admission's own allocated pages are written, so no live or
+        cached block of another owner can be hit."""
         out = dict(big)
         bs = self.kv_block_size
         m = pages.shape[0]
         n_pool = big["paged_key"].shape[0]
-        covered = jnp.arange(m) * bs < true_len
+        covered = ((jnp.arange(m) * bs < true_len)
+                   & (jnp.arange(m) >= write_block))
         tgt = jnp.where(covered, pages, n_pool)
         for name, src in (("paged_key", "cached_key"),
                           ("paged_value", "cached_value")):
@@ -749,6 +830,74 @@ class ServeLoop:
         cache1, first = self._prefill_impl(
             params, prompt_padded, true_len, key, true_chunk=true_chunk)
         cache = self._insert_impl(cache, cache1, slot, true_len, pages)
+        tok = tok.at[slot].set(first)
+        act = max_new > 1
+        if self._stop is not None:
+            act = act & ~jnp.isin(first, self._stop)
+        active = active.at[slot].set(act)
+        remaining = remaining.at[slot].set(max_new - 1)
+        first_buf = first_buf.at[slot].set(first)
+        return cache, tok, active, remaining, first_buf
+
+    # -- chunked-interleaved admission (see chunked_prefill) ---------------
+
+    def _gather_prefix_impl(self, cache, blank1, pages):
+        """Build a fresh batch-1 dense prefill cache whose leading rows
+        hold a shared prefix's KV gathered from pool blocks ``pages``
+        (the slot's full padded page row).  Rows past the prefix carry
+        whatever lives in the referenced blocks — suffix chunks
+        overwrite the covered span and attention never reads past the
+        write cursor, so the garbage is unreachable.  KV bytes come
+        straight from the original admission's prefill, which is what
+        makes a cache-hit admission bitwise-identical to recomputing."""
+        def walk(big, small):
+            if not isinstance(small, dict):
+                return small
+            if "cached_key" in small and "paged_key" in big:
+                out = dict(small)
+                for pname, dname in (("paged_key", "cached_key"),
+                                     ("paged_value", "cached_value")):
+                    rows = big[pname][pages]          # [M, bs, F]
+                    flat = rows.reshape(-1, rows.shape[-1])
+                    S = small[dname].shape[1]
+                    flat = flat[:S]
+                    if flat.shape[0] < S:
+                        flat = jnp.pad(
+                            flat, ((0, S - flat.shape[0]), (0, 0)))
+                    out[dname] = flat[None].astype(small[dname].dtype)
+                return out
+            return {k: (walk(big[k], v) if k in big else v)
+                    for k, v in small.items()}
+        return walk(cache, blank1)
+
+    def _prefill_chunk_impl(self, params, cache1, toks, off, *, chunk):
+        """ONE prompt chunk through the scalar-index prefill path:
+        write cursor forced to ``off`` (dynamic — every chunk of a given
+        width shares one executable), positions ``off + [0, chunk)``.
+        The chunk grid matches :func:`_prefill`'s exactly (same widths
+        at the same offsets), so the per-chunk dispatches produce
+        bitwise the same cache and logits as the fused one-shot path —
+        chunking changes WHEN prefill work runs, never its result."""
+        cache1 = _set_cache_index(cache1, off)
+        logits, mut = self._prefill_model.apply(
+            {"params": params, "cache": cache1}, toks,
+            positions=off + jnp.arange(chunk)[None, :], mutable=["cache"])
+        return mut["cache"], logits
+
+    def _admit_finish_impl(self, cache, tok, active, remaining, first_buf,
+                           cache1, logits, off, true_len, slot, max_new,
+                           pages, write_block, key):
+        """The tail of a chunked admission, one dispatch: insert the
+        prefilled batch-1 cache into the slot (skipping shared blocks
+        below ``write_block``), sample the deferred first token from the
+        LAST chunk's logits (position ``true_len - 1`` lives at row
+        ``true_len - 1 - off`` of that chunk), stamp the lane."""
+        cache1 = _set_cache_index(cache1, true_len)
+        cache = self._insert_impl(cache, cache1, slot, true_len, pages,
+                                  write_block=write_block)
+        last = lax.dynamic_index_in_dim(
+            logits[0], true_len - 1 - off, keepdims=False)
+        first = self._select(last[None, :], key)[0].astype(jnp.int32)
         tok = tok.at[slot].set(first)
         act = max_new > 1
         if self._stop is not None:
@@ -1030,14 +1179,65 @@ class ServeLoop:
                     f"{self.pool.num_blocks}; it could never be admitted "
                     "(raise kv_num_blocks or shrink the request)")
 
+    def _prefix_plan(self, prompt: np.ndarray,
+                     L: int) -> tuple[list[int], int, bool]:
+        """Match ``prompt`` against the prefix cache: returns
+        ``(shared_blocks, suffix_start, cow)``.  ``suffix_start`` is the
+        first position prefill must actually compute; a FULL-prompt hit
+        still recomputes position ``L - 1`` (the first output logit has
+        to come from somewhere) and that write lands in the last shared
+        block — the ``cow`` split."""
+        blocks = self._prefix_cache.match(prompt)
+        if not blocks:
+            return [], 0, False
+        matched = len(blocks) * self.kv_block_size
+        if matched >= L:
+            return blocks, L - 1, True
+        return blocks, matched, False
+
+    def prefix_summary(self, limit: int = 64) -> list[int]:
+        """Most recently admitted ``Request.prefix_hash`` values while
+        prefix sharing is on — the replica's published affinity summary
+        (the router steers matching requests here).  Empty when sharing
+        is off: never advertise affinity this loop cannot honor."""
+        return list(self._affinity_recent)[-limit:]
+
+    def flush_prefix_cache(self) -> None:
+        """Drop every cached prefix (idle blocks return to the free
+        list).  Called automatically at weight hot-swaps; benches call
+        it before asserting a fully drained pool."""
+        if self._prefix_cache is not None:
+            self._prefix_cache.flush()
+        self._affinity_recent.clear()
+
     def _admit(self, slot: int, req: Request) -> dict:
         """Admit ``req`` into ``slot`` WITHOUT a host sync: the prefill
         and the state stamp are dispatched; the first token stays a
         device scalar until the next segment sync resolves it (by which
-        point the decode segment has already hidden the prefill)."""
+        point the decode segment has already hidden the prefill).
+
+        With ``chunked_prefill`` the prefill is NOT dispatched here:
+        admission allocates (and prefix-aliases) pool blocks, stages a
+        batch-1 prefill cache, and returns a slot state carrying a
+        ``prefill`` phase — the run loop dispatches one prompt chunk
+        per iteration between decode segments and finishes with the
+        insert + lane stamps (see ``advance_admissions``)."""
         self._validate(req)
         prompt = np.asarray(req.prompt, np.int32)
         L = int(prompt.size)
+        self.prefix_stats["requests"] += 1
+        self.prefix_stats["prompt_tokens"] += L
+        self._obs_prompt_tokens.inc(L)
+        if req.prefix_hash is not None and self._prefix_cache is not None:
+            self._affinity_recent.pop(int(req.prefix_hash), None)
+            self._affinity_recent[int(req.prefix_hash)] = None
+            while len(self._affinity_recent) > 128:
+                self._affinity_recent.pop(
+                    next(iter(self._affinity_recent)))
+        if self.chunked:
+            return self._admit_start(slot, req, prompt, L)
+        self.prefix_stats["prefill_tokens"] += L
+        self._obs_prefill_tokens.inc(L)
         if self.pool is not None:
             # allocate-on-admit: pages covering the prompt now, the rest
             # of the worst-case footprint RESERVED (growth at dispatch
@@ -1073,6 +1273,71 @@ class ServeLoop:
                 np.int32(slot), np.int32(req.max_new_tokens), pages, pk,
                 true_chunk=chunk)
         return {"req": req, "tokens": [], "pending_first": True}
+
+    def _admit_start(self, slot: int, req: Request, prompt: np.ndarray,
+                     L: int) -> dict:
+        """Phase A of a chunked admission — all host bookkeeping, at
+        most one device dispatch (the shared-prefix gather):
+
+        * pool admit, with cached prefix blocks ALIASED in via
+          ``shared=`` and the full-prompt-hit COW split applied (the
+          split block's content is rewritten whole by the finish insert,
+          which IS the copy);
+        * the newly prefilled prefix registered into the cache
+          (first-wins; an already-cached hash keeps its block);
+        * the chunk worklist: the SAME ``prefill_chunk`` grid the
+          one-shot path uses (full-width chunks plus one remainder —
+          identical executables, bitwise-identical output), starting at
+          the chunk containing ``suffix_start`` so a cache hit skips
+          the covered prefix entirely (positions below ``suffix_start``
+          inside the first chunk are recomputed to identical bytes).
+
+        The run loop pops one ``(off, width)`` per iteration."""
+        max_new = int(req.max_new_tokens)
+        suffix_start = 0
+        write_block = 0
+        shared_n = 0
+        if self._prefix_cache is not None:
+            blocks, suffix_start, cow = self._prefix_plan(prompt, L)
+            shared_n = len(blocks)
+            self.pool.admit(slot, L, max_new, shared=blocks)
+            if cow:
+                self.pool.cow_write(slot, len(blocks) - 1)
+            # registration is DEFERRED to the finish dispatch: the
+            # prompt's KV only lands in these blocks at the finish
+            # insert, and registering now would let a concurrent
+            # admission match and gather blocks not yet written
+            write_block = suffix_start // self.kv_block_size
+            if shared_n:
+                self.prefix_stats["hits"] += 1
+                self.prefix_stats["hit_tokens"] += (
+                    shared_n * self.kv_block_size)
+        elif self.pool is not None:
+            self.pool.admit(slot, L, max_new)
+        self.prefix_stats["prefill_tokens"] += L - suffix_start
+        self._obs_prefill_tokens.inc(L - suffix_start)
+        if self.pool is not None:
+            pages = jnp.asarray(self.pool.table[slot])
+            cache1 = (self._gather_prefix(self.cache, self._blank1, pages)
+                      if suffix_start else self._blank1)
+        else:
+            pages = _NO_PAGES
+            cache1 = self._blank1
+        C = min(self.prefill_chunk, self.cfg.max_seq_len)
+        Lp = min(-(-L // C) * C, self.cfg.max_seq_len)
+        padded = np.full((1, Lp), self.pad_token, np.int32)
+        padded[0, :L] = prompt
+        chunks = []
+        off = (suffix_start // C) * C
+        while off < Lp:
+            w = min(C, Lp - off)
+            chunks.append((off, w))
+            off += w
+        return {"req": req, "tokens": [], "pending_first": True,
+                "prefill": {"cache1": cache1, "padded": padded,
+                            "chunks": chunks, "logits": None,
+                            "off_last": 0, "L": L, "max_new": max_new,
+                            "pages": pages, "write_block": write_block}}
 
     def _plan_steps(self, slot_state) -> int:
         """Per-dispatch segment length: ``steps_per_sync``, CLAMPED
@@ -1180,6 +1445,8 @@ class ServeLoop:
         dispatched AFTER the kill never write it."""
         for req in requests:  # fail BEFORE any slot is touched, not mid-run
             self._validate(req)
+        self.intertoken_samples = []
+        self._last_drain_t = None
         pending: deque[tuple[Request, float]] = deque()
         slot_state: list[dict | None] = [None] * self.B
         done: list[Completion] = []
@@ -1290,7 +1557,14 @@ class ServeLoop:
                                     tokens=len(st["tokens"]))
                 tev("timeout", st["req"], stage="decode", slot=slot,
                     tokens=len(st["tokens"]))
-                if self.pool is not None and inflight:
+                if "prefill" in st:
+                    # mid-prefill kill: the lane was never stamped
+                    # active, so in-flight segments have lived=0 for it
+                    # (merges masked) and its chunk dispatches touched
+                    # only the transient batch-1 cache — the pool refund
+                    # is safe immediately, no zombie needed
+                    finalize(slot, "timeout")
+                elif self.pool is not None and inflight:
                     finalize(slot, "timeout", free_pool=False)
                     slot_state[slot] = {"zombie": True, "free_at": seq}
                 else:
@@ -1328,14 +1602,28 @@ class ServeLoop:
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
                     req, t_q = pending[0]
-                    if self.pool is not None and not self.pool.can_admit(
-                            int(np.asarray(req.prompt).size),
-                            int(req.max_new_tokens)):
-                        # capacity gate: QUEUE instead of OOMing the
-                        # pool.  FIFO — the head waits for blocks rather
-                        # than being jumped by a smaller request behind
-                        # it, which would starve long prompts
-                        break
+                    if self.pool is not None:
+                        L_q = int(np.asarray(req.prompt).size)
+                        if self._prefix_cache is not None:
+                            # count the aliased prefix against nothing:
+                            # shared blocks cost no allocation, but a
+                            # full-prompt hit draws one COW block
+                            n_sh = self._prefix_cache.peek(req.prompt)
+                            cow = int(
+                                n_sh * self.kv_block_size >= L_q)
+                            ok = self.pool.can_admit(
+                                L_q, int(req.max_new_tokens),
+                                shared=n_sh, cow=cow)
+                        else:
+                            ok = self.pool.can_admit(
+                                L_q, int(req.max_new_tokens))
+                        if not ok:
+                            # capacity gate: QUEUE instead of OOMing the
+                            # pool.  FIFO — the head waits for blocks
+                            # rather than being jumped by a smaller
+                            # request behind it, which would starve
+                            # long prompts
+                            break
                     pending.popleft()
                     if (self._degraded and req.priority <= 0
                             and req.max_new_tokens > self.degrade_max_new):
@@ -1351,9 +1639,13 @@ class ServeLoop:
                     with obs.span("serve/admit", slot=slot):
                         slot_state[slot] = self._admit(slot, req)
                     # stamped here, not in _admit: benches wrap
-                    # loop._admit, and latency must cover the wrapper
+                    # loop._admit, and latency must cover the wrapper.
+                    # A chunked admission gets its seq stamp at the
+                    # FINISH dispatch (advance_admissions) — its tokens
+                    # cannot surface before that segment.
                     slot_state[slot]["t_admit"] = time.perf_counter()
-                    slot_state[slot]["seq"] = seq
+                    if "prefill" not in slot_state[slot]:
+                        slot_state[slot]["seq"] = seq
                     self._obs_requests.inc()
                     obs.recorder.record(
                         "serve_admit", slot=slot, seq=seq,
@@ -1410,18 +1702,81 @@ class ServeLoop:
                     finalize(slot, "length")
                     return
 
-        def busy_live() -> bool:
+        def advance_admissions() -> None:
+            """Chunked prefill: advance every prefilling lane by ONE
+            prompt chunk per outer-loop iteration, interleaved with the
+            decode segments ``dispatch()`` chains — a 10k-token prompt
+            spreads its prefill across many iterations instead of
+            stalling every in-flight request behind one long dense
+            pass.  Each chunk is an async dispatch into the lane's
+            transient batch-1 cache (same chunk grid as the one-shot
+            ``_prefill``, so the KV and logits are bitwise identical).
+            When the worklist empties, the FINISH dispatch scatters the
+            batch-1 cache into the paged table (suffix blocks only —
+            shared prefix blocks are read in place), selects the first
+            token from the final chunk's logits, stamps the lane
+            active, and the slot joins decode with its drain gated on
+            the NEXT segment."""
+            for slot in range(self.B):
+                st = slot_state[slot]
+                if st is None or "prefill" not in st:
+                    continue
+                pf = st["prefill"]
+                if pf["chunks"]:
+                    off, w = pf["chunks"].pop(0)
+                    toks = pf["padded"][:, off:off + w]
+                    with obs.span("serve/prefill_chunk", slot=slot,
+                                  off=off, width=w):
+                        pf["cache1"], pf["logits"] = self._prefill_chunk(
+                            self.params, pf["cache1"], toks,
+                            np.int32(off), chunk=w)
+                    pf["off_last"] = off
+                    tev("prefill_chunk", st["req"], slot=slot,
+                        off=off, width=w, left=len(pf["chunks"]))
+                    continue
+                self._key, pk = jax.random.split(self._key)
+                with obs.span("serve/admit_finish", slot=slot):
+                    (self.cache, self._tok, self._active,
+                     self._remaining, self._first) = self._admit_finish(
+                        self.cache, self._tok, self._active,
+                        self._remaining, self._first, pf["cache1"],
+                        pf["logits"], np.int32(pf["off_last"]),
+                        np.int32(pf["L"]), np.int32(slot),
+                        np.int32(pf["max_new"]), pf["pages"],
+                        np.int32(pf["write_block"]), pk)
+                if self._prefix_cache is not None:
+                    # register AFTER the insert dispatch: any later
+                    # match's gather is host-ordered behind the write
+                    # (first-wins — a hash cached meanwhile keeps its
+                    # original block)
+                    self._prefix_cache.register(
+                        pf["padded"][0, :pf["L"]],
+                        self.pool._slot_blocks[slot])
+                tev("prefill_done", st["req"], slot=slot, seq=seq,
+                    prompt_len=pf["L"])
+                del st["prefill"]
+                # tokens first surface in the NEXT dispatched segment
+                st["seq"] = seq
+
+        def busy_decode() -> bool:
+            """Lanes a decode segment could advance — zombie and
+            PREFILL-phase slots excluded: a prefilling lane is inactive
+            on device until its finish dispatch lands, so segments
+            dispatched for it alone would run empty."""
             return any(st is not None and not st.get("zombie")
-                       for st in slot_state)
+                       and "prefill" not in st for st in slot_state)
 
         def can_work() -> bool:
             """Is there decode work a dispatch could advance?  A pending
             swap gates QUEUED requests out (the admission barrier means
             they cannot reach a slot, so dispatching for them would spin
             empty segments forever); lanes already decoding still count
-            — they must run to completion before the swap lands."""
-            return busy_live() or (bool(pending)
-                                   and self._pending_swap is None)
+            — they must run to completion before the swap lands.
+            ``pending`` alone also counts: queued requests can be
+            blocked on pool blocks held by ZOMBIE lanes, whose refund
+            only lands when segments drain past the kill point."""
+            return busy_decode() or (bool(pending)
+                                     and self._pending_swap is None)
 
         def maybe_swap() -> None:
             """Apply a pending weight swap once the loop is fully
@@ -1442,6 +1797,12 @@ class ServeLoop:
                     self._obs_swaps.inc()
                     if swap["version"] is not None:
                         self._obs_weights_version.set(int(swap["version"]))
+                    # cached prefix KV was computed under the OLD
+                    # weights — serving it to a post-swap admission
+                    # would break exactness.  The loop is drained here,
+                    # so every refcount is zero and the flush returns
+                    # every cached block to the free list.
+                    self.flush_prefix_cache()
             obs.recorder.record("serve_swap", seq=seq,
                                 version=swap["version"],
                                 applied=tree is not None)
@@ -1473,7 +1834,11 @@ class ServeLoop:
                 # blocks just wait for the refund.
                 for slot in range(self.B):
                     st = slot_state[slot]
-                    if st is not None and not st.get("zombie"):
+                    if (st is not None and not st.get("zombie")
+                            and "prefill" not in st):
+                        # prefill-phase lanes don't grow: nothing
+                        # decodes there yet, and their prompt coverage
+                        # was allocated at admit
                         self.pool.grow(slot, n + k)
                 self._stamp_table()
             # the segment splits per-step keys and returns the advanced
@@ -1506,7 +1871,8 @@ class ServeLoop:
             self._obs_dispatches.inc()
             for slot in range(self.B):
                 st = slot_state[slot]
-                if st is not None and not st.get("zombie"):
+                if (st is not None and not st.get("zombie")
+                        and "prefill" not in st):
                     tev("segment", st["req"], slot=slot, seq=seq,
                         steps=n, tokens=len(st["tokens"]),
                         spt=(round(self._step_ema, 6)
@@ -1535,13 +1901,24 @@ class ServeLoop:
              t_disp) = inflight.popleft()
             self._obs_depth.set(len(inflight))
             if any(st is not None and not st.get("zombie")
-                   and st["seq"] <= s_idx for st in slot_state):
+                   and "seq" in st and st["seq"] <= s_idx
+                   for st in slot_state):
                 t0 = time.perf_counter()
                 emits = np.asarray(emits_dev)
                 stats = (np.asarray(stats_dev)
                          if stats_dev is not None else None)
                 self._obs_host_wait.record(time.perf_counter() - t0)
                 n_tok = n_disp if stats is None else int(stats[0])
+                # inter-token latency sample: wall gap between
+                # consecutive decode-segment drains, per token of this
+                # segment.  A one-shot long-prompt admission lands
+                # between two segments and shows up here as one huge
+                # gap — exactly the stall chunked prefill removes.
+                now_t = time.perf_counter()
+                if self._last_drain_t is not None and n_tok > 0:
+                    self.intertoken_samples.append(
+                        ((now_t - self._last_drain_t) / n_tok, n_tok))
+                self._last_drain_t = now_t
                 dt = time.perf_counter() - t_disp
                 if n_tok > 0:
                     # dispatch->drain wall time per token; under
@@ -1579,7 +1956,7 @@ class ServeLoop:
                 for slot in range(self.B):
                     st = slot_state[slot]
                     if (st is not None and not st.get("zombie")
-                            and st["seq"] <= s_idx):
+                            and "seq" in st and st["seq"] <= s_idx):
                         if corrupt is not None and bool(corrupt[slot]):
                             # the in-graph guard froze this lane before
                             # emitting anything from the bad step, but
@@ -1626,6 +2003,7 @@ class ServeLoop:
                         admit_free()
                         shed()
                 expire_inflight()
+                advance_admissions()
                 if can_work():
                     dispatch()
                 # fetch when the pipeline is full — or when there is

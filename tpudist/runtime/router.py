@@ -213,6 +213,12 @@ def _request_doc(key: str, req) -> dict:
     trace = getattr(req, "trace", None)
     if trace is not None:
         doc["trace"] = trace.to_wire()
+    # prefix affinity: the OPAQUE client-stamped hash rides the wire so
+    # the replica can advertise it back in its prefix summary — router
+    # and replica never have to agree on block size or hash chaining
+    phash = getattr(req, "prefix_hash", None)
+    if phash is not None:
+        doc["prefix_hash"] = int(phash)
     return doc
 
 
@@ -232,11 +238,13 @@ def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
     d = wire.decode_record(raw, expect="request", namespace=namespace,
                            key=key, replica=replica)
     try:
+        phash = d.get("prefix_hash")
         return Request(prompt=np.asarray(d["prompt"], np.int32),
                        max_new_tokens=int(d["max_new_tokens"]),
                        rid=d["key"], deadline_s=d.get("deadline_s"),
                        priority=int(d.get("priority", 0)),
-                       trace=TraceContext.from_wire(d.get("trace")))
+                       trace=TraceContext.from_wire(d.get("trace")),
+                       prefix_hash=None if phash is None else int(phash))
     except (KeyError, ValueError, TypeError):
         raise wire.WireError("schema", kind="request",
                              namespace=namespace, key=key,
@@ -311,6 +319,9 @@ class ReplicaWorker:
         # the outage, and greedy determinism re-produces it).
         self._done_buf: list[tuple[str, bytes]] = []
         self._done_buf_cap = 4096
+        # last published prefix-affinity summary; republished only on
+        # change so an idle replica costs the coord store nothing
+        self._prefix_pub: tuple[int, ...] | None = None
         self._weights_version = 0
         self._roll: dict | None = None   # the in-progress swap-chain turn
         self._obs_version = obs.gauge("serve/weights_version",
@@ -494,6 +505,7 @@ class ReplicaWorker:
         done commits flush here too)."""
         try:
             self._flush_done_buffer()
+            self._publish_prefix()
             if (self.client.get(f"{self.ns}/stop") is not None
                     or self.client.get(
                         f"{self.ns}/stop/{self.replica_id}") is not None):
@@ -539,6 +551,25 @@ class ReplicaWorker:
         except ConnectionError:
             return []
         return out
+
+    def _publish_prefix(self) -> None:
+        """Advertise the loop's recently admitted prefix hashes at
+        ``{ns}/prefix/{rid}`` (checksummed frame, kind="prefix") so the
+        router can steer matching requests here.  Purely advisory:
+        stale or missing summaries only cost cache hits, never
+        correctness, so a publish failure is swallowed."""
+        fn = getattr(self.loop, "prefix_summary", None)
+        summ = tuple(int(h) for h in fn()) if fn is not None else ()
+        if summ == self._prefix_pub:
+            return
+        try:
+            self.client.set(
+                f"{self.ns}/prefix/{self.replica_id}",
+                wire.encode_record("prefix", {
+                    "replica": self.replica_id, "hashes": list(summ)}))
+        except ConnectionError:
+            return   # advisory: retry on the next poll
+        self._prefix_pub = summ
 
     def _sink(self, comp) -> None:
         """Commit one completion.  This write is the commit point of the
@@ -744,6 +775,8 @@ class Router:
                                        unit="replicas")
         self._obs_joins = obs.counter("router/joins", unit="replicas")
         self._obs_slo_shed = obs.counter("router/slo_shed", unit="reqs")
+        self._obs_prefix_affinity = obs.counter("router/prefix_affinity",
+                                                unit="reqs")
         self._obs_drains = obs.counter("router/drains", unit="replicas")
         self._obs_rolls = obs.counter("router/structural_rolls",
                                       unit="rolls")
@@ -914,23 +947,55 @@ class Router:
                 self._backoff[rid] = now + self.reject_backoff_s
             self._rejected_seen[rid] = l["rejected"]
 
+    def _prefix_map(self, candidates: Sequence[str]) -> dict[str, set[int]]:
+        """One read of every candidate's published prefix-affinity
+        summary (``{ns}/prefix/{rid}``), once per poll.  Corrupt or
+        missing summaries degrade to no-affinity — the hash steer is
+        advisory, the least-loaded tie-break still places the request."""
+        out: dict[str, set[int]] = {}
+        for rid in candidates:
+            try:
+                raw = self.client.get(f"{self.ns}/prefix/{rid}")
+            except ConnectionError:
+                break
+            if raw is None:
+                continue
+            try:
+                doc = wire.decode_record(raw, expect="prefix",
+                                         namespace=self.ns, key=rid,
+                                         replica=rid)
+                out[rid] = {int(h) for h in doc.get("hashes", [])}
+            except (wire.WireError, ValueError, TypeError):
+                continue
+        return out
+
     def _pick(self, candidates: Sequence[str], loads: dict[str, dict],
-              assigned: dict[str, int]) -> str | None:
-        """Least-loaded: fewest known-outstanding work first (the
-        router's own assignments are fresher than any published gauge),
-        then shortest published queue wait, then most free KV blocks
-        (a dense replica has no block limit and sorts as infinite)."""
+              assigned: dict[str, int],
+              prefix_hash: int | None = None,
+              prefix_map: dict[str, set[int]] | None = None) -> str | None:
+        """Least-loaded with prefix affinity: replicas whose published
+        prefix-cache summary holds the request's prefix hash sort ahead
+        (their shared KV pages make the admission nearly prefill-free),
+        then fewest known-outstanding work (the router's own
+        assignments are fresher than any published gauge), then
+        shortest published queue wait, then most free KV blocks (a
+        dense replica has no block limit and sorts as infinite)."""
         best, best_score = None, None
         for rid in candidates:
             l = loads.get(rid, {})
             free = l.get("kv_blocks_free")
+            hit = (prefix_hash is not None and prefix_map is not None
+                   and prefix_hash in prefix_map.get(rid, ()))
             score = (
+                0 if hit else 1,
                 assigned.get(rid, 0) + l.get("queue_depth", 0.0),
                 l.get("queue_wait_mean", 0.0),
                 -(free if free is not None else float("inf")),
             )
             if best_score is None or score < best_score:
                 best, best_score = rid, score
+        if best is not None and best_score[0] == 0:
+            self._obs_prefix_affinity.inc()
         return best
 
     def _sweep_dead(self, rid: str, regs: dict[str, dict]) -> None:
@@ -944,6 +1009,7 @@ class Router:
         for key in (f"{self.ns}/replica/{rid}",
                     f"{self.ns}/metrics/{regs.get(rid, {}).get('rank')}",
                     f"{self.ns}/draining/{rid}",
+                    f"{self.ns}/prefix/{rid}",
                     f"{self.ns}/quarantined/{rid}"):
             try:
                 self.client.delete(key)
@@ -1575,6 +1641,9 @@ class Router:
                     assigned_counts[e["assigned"]] = (
                         assigned_counts.get(e["assigned"], 0) + 1)
             wall = self._wall()
+            # prefix affinity summaries: one coord read per candidate
+            # per poll, shared by every dispatch decision below
+            prefix_map = self._prefix_map(candidates)
             # the SLO predictor: the best queue-wait any candidate
             # advertises at the configured percentile — if even that
             # replica would (probably) blow a request's deadline, no
@@ -1609,7 +1678,10 @@ class Router:
                     self._decide("shed", e, predicted_wait_s=best_wait)
                     progressed = True
                     continue
-                rid = self._pick(candidates, loads, assigned_counts)
+                rid = self._pick(
+                    candidates, loads, assigned_counts,
+                    prefix_hash=getattr(req, "prefix_hash", None),
+                    prefix_map=prefix_map)
                 if rid is None:
                     break
                 trace = e.get("trace")

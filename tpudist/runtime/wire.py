@@ -53,6 +53,7 @@ WIRE_KINDS = {
     "completion": 2,   # replica -> router done commit
     "journal": 3,      # router crash-recovery lifecycle record
     "heartbeat": 4,    # liveness/exit report payloads
+    "prefix": 5,       # replica -> router prefix-cache affinity summary
 }
 _TAG_TO_KIND = {tag: kind for kind, tag in WIRE_KINDS.items()}
 

@@ -220,6 +220,11 @@ class Envelope:
     min_quarantines: int = 0
     min_reinstated: int = 0
     max_corrupted_terminals: int | None = None
+    # prefix-cache affinity gate (ISSUE 14): the fleet-level hit rate a
+    # shared-prefix workload must sustain — checked only when the row
+    # reports one (a workload with no stamped hashes is exempt, not
+    # failing at 0.0)
+    min_prefix_hit_rate: float | None = None
     decisions: dict = field(default_factory=dict)
 
     @classmethod
@@ -290,6 +295,12 @@ class Envelope:
             if ct > self.max_corrupted_terminals:
                 bad.append(f"corrupted_terminals={ct:g} > "
                            f"{self.max_corrupted_terminals}")
+        if (self.min_prefix_hit_rate is not None
+                and row.get("prefix_hit_rate") is not None):
+            phr = num("prefix_hit_rate")
+            if phr < self.min_prefix_hit_rate:
+                bad.append(f"prefix_hit_rate={phr:.4g} < min "
+                           f"{self.min_prefix_hit_rate}")
         for reason, bound in self.decisions.items():
             v = num(f"decisions_{reason}")
             lo, hi = bound.get("min"), bound.get("max")
@@ -456,6 +467,12 @@ BUILTIN: dict[str, dict] = {
             "max_lost": 0,
             "max_p99_queue_wait_s": 0.5,
             "max_priority_bad": 0,   # paid traffic burns zero budget
+            # the router's prefix-affinity steer must keep tenant
+            # traffic landing where its prefix is cached: after each
+            # tenant's first admission per replica, everything else
+            # should hit (three tenants, two replicas — ≥ 0.5 is a
+            # loose floor well below the steady-state rate)
+            "min_prefix_hit_rate": 0.5,
             "decisions": {"completed": {"min": 200}},
         },
     },

@@ -136,6 +136,15 @@ class SimReplica:
         self._corrupt_every: int | None = None
         self._corrupt_left: int | None = None
         self._commits = 0
+        # prefix-affinity accounting: recently admitted prefix hashes
+        # (the SimReplica mirror of ServeLoop._affinity_recent) — a
+        # request whose stamped hash is already here would have hit the
+        # replica's prefix cache.  Published at {ns}/prefix/{rid} so the
+        # ROUTER's affinity steer runs the same code path offline.
+        self._affinity: dict[int, None] = {}
+        self._prefix_pub: tuple[int, ...] | None = None
+        self.prefix_requests = 0
+        self.prefix_hits = 0
         # registration precedes the first heartbeat, exactly like a real
         # joiner mid-warmup (the router's join grace covers this window)
         import json
@@ -250,6 +259,17 @@ class SimReplica:
                             json.dumps(snap).encode())
         except ConnectionError:
             pass   # latest-wins snapshots: the next publish catches up
+        summ = tuple(self._affinity)
+        if summ != self._prefix_pub:
+            try:
+                self.fabric.set(
+                    f"{self.ns}/prefix/{self.rid}",
+                    wire.encode_record("prefix", {
+                        "replica": self.rid,
+                        "hashes": list(summ)[-64:]}))
+                self._prefix_pub = summ
+            except ConnectionError:
+                pass
         self._next_pub = now + self.publish_interval_s
 
     def step(self) -> None:
@@ -318,10 +338,21 @@ class SimReplica:
                 # expired while queued: the replica-side deadline kill
                 self._commit(req, "timeout", [])
                 continue
+            phash = getattr(req, "prefix_hash", None)
+            hit = False
+            if phash is not None:
+                self.prefix_requests += 1
+                hit = int(phash) in self._affinity
+                self.prefix_hits += int(hit)
+                self._affinity.pop(int(phash), None)
+                self._affinity[int(phash)] = None
+                while len(self._affinity) > 128:
+                    self._affinity.pop(next(iter(self._affinity)))
             if req.trace is not None:
                 obs.events.record("admit", trace=req.trace.trace_id,
                                   replica=self.rid,
-                                  queue_wait_s=round(wait, 6))
+                                  queue_wait_s=round(wait, 6),
+                                  prefix_hit=hit)
             self._cur = (req, now + self._service_s(req))
 
         if now >= self._next_pub:
@@ -545,6 +576,18 @@ class FleetSim:
         wall_s = time.perf_counter() - t0
         return self._summarize(reqs, comps, base, wall_s)
 
+    def _prefix_hit_rate(self) -> float | None:
+        """Fleet-wide offline prefix-cache hit rate: admissions whose
+        stamped hash was already in the admitting replica's recent set,
+        over all hash-stamped admissions.  ``None`` when the workload
+        stamps no hashes (no tenant prefixes) — an envelope bound on an
+        unstamped trace would be vacuous, not zero."""
+        req_n = sum(r.prefix_requests for r in self.replicas)
+        if req_n == 0:
+            return None
+        hits = sum(r.prefix_hits for r in self.replicas)
+        return round(hits / req_n, 4)
+
     def _summarize(self, reqs, comps, base: dict, wall_s: float) -> dict:
         spec = self.spec
         reasons: dict[str, int] = {}
@@ -609,6 +652,12 @@ class FleetSim:
             "probe_pass": delta.get("probe/pass", 0.0),
             "probe_fail": delta.get("probe/fail", 0.0),
             "corrupted_terminals": _corrupted_terminals(reqs, comps),
+            # prefix-affinity accounting (ISSUE 14): the fleet-level hit
+            # rate the router's hash steer is supposed to preserve under
+            # scale-out, plus how many dispatches the steer decided
+            "prefix_hit_rate": self._prefix_hit_rate(),
+            "prefix_affinity_dispatches": delta.get(
+                "router/prefix_affinity", 0.0),
         }
         for reason in ("completed", "shed", "rejected", "failed",
                        "timeout"):
@@ -648,6 +697,6 @@ def _counters_now(ns: str) -> dict[str, float]:
                             "router/recoveries", "coord/",
                             "integrity/", "probe/", "quarantine/",
                             "router/quarantines", "router/reinstated",
-                            "router/retired")):
+                            "router/retired", "router/prefix")):
             out[name] = float(m.get("value") or 0.0)
     return out

@@ -83,6 +83,7 @@ class Workload:
         ``base_wall + at + rel_deadline`` (pass the clock the router
         will read — ``time.time()`` live, ``VirtualClock.wall()``
         simulated), plus the matching arrival-offset list."""
+        from tpudist.models.kv_pages import request_prefix_hash
         from tpudist.models.serving import Request
 
         rng = np.random.default_rng(self.seed ^ 0x5EED)
@@ -104,10 +105,14 @@ class Workload:
                 [pre, rng.integers(1, _VOCAB, size=tail_n).astype(np.int32)])
             deadline = None if w.rel_deadline_s is None else \
                 base_wall + w.at + w.rel_deadline_s
+            # the tenant's shared system prefix, stamped as the opaque
+            # affinity hash the router's prefix steering matches on (and
+            # FleetSim's offline hit-rate accounting counts)
+            phash = (request_prefix_hash(pre) if pre.size else None)
             reqs.append(Request(
                 prompt=prompt, max_new_tokens=int(w.max_new),
                 rid=f"{self.name}-{n:05d}", deadline_s=deadline,
-                priority=int(w.priority)))
+                priority=int(w.priority), prefix_hash=phash))
             arrivals.append(float(w.at))
         return reqs, arrivals
 
