@@ -3244,6 +3244,145 @@ def bench_serve_prefix_batching(on_tpu: bool) -> None:
           pool_drained=bool(m_ch_dr and m_ref_dr))
 
 
+def bench_serve_disagg(on_tpu: bool) -> None:
+    """Disaggregated prefill/decode serving (ISSUE 15): the same
+    mixed long+short-prompt workload routed through two 3-replica
+    fleets — a unified one (every replica prefills AND decodes) and a
+    split one (1 prefill-only + 2 decode-only, KV pages migrating at
+    handoff).  Each row reports p99 TTFT (merged trace events,
+    enqueue -> prefill_done), p99 inter-token latency (segment-event
+    gaps), tokens/sec, ``exact_match`` (greedy output vs one
+    uninterrupted local loop — adoption must be byte-identical),
+    ``lost_requests`` (must be 0) and ``pool_drained``.  The expected
+    shape: the split fleet wins TTFT because a long prompt's prefill
+    never queues behind another request's decode segments."""
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        exit_reports, launch_local_fleet,
+                                        scale_fleet, stop_fleet,
+                                        wait_live)
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_serve_disagg", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    n_requests = 8
+
+    def make_requests():
+        rng = np.random.default_rng(0)
+        out = []
+        for i in range(n_requests):
+            # alternate near-max-context and short prompts: the mix
+            # where a unified replica's prefill stalls its decodes
+            n = 56 if i % 2 else 5 + i % 4
+            out.append(Request(rng.integers(0, 64, n).astype(np.int32),
+                               16 + 2 * (i % 3), rid=f"q{i}"))
+        return out
+
+    # the exactness oracle: one uninterrupted local loop, same seed-0
+    # weights and layout both fleets run
+    cfg, params = build_tiny_lm(seed=0)
+    ref = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                    prefill_chunk=8, cache_layout="paged",
+                    kv_block_size=16)
+    want = {c.rid: tuple(c.tokens.tolist())
+            for c in ref.run(make_requests())}
+
+    base_args = ["--cache-layout", "paged", "--kv-block-size", "16",
+                 "--ttl", "1.0", "--steps-per-sync", "4",
+                 "--prefill-chunk", "8"]
+
+    def _latencies(trace_doc):
+        """(p99 TTFT, p99 per-token inter-token gap) from the merged
+        fleet trace: TTFT is enqueue -> first prefill_done; inter-token
+        gaps divide the wall between consecutive decode segments by the
+        tokens that segment produced (token-weighted, the same estimator
+        ServeLoop.intertoken_samples uses in-process)."""
+        timelines = obs.group_timelines(trace_doc["events"])
+        ttfts, gaps = [], []
+        for tl in timelines.values():
+            enq = next((e["t"] for e in tl if e["kind"] == "enqueue"),
+                       None)
+            pf = [e["t"] for e in tl if e["kind"] == "prefill_done"]
+            if enq is not None and pf:
+                ttfts.append(min(pf) - enq)
+            segs = sorted((e["t"], int(e.get("tokens") or 0))
+                          for e in tl if e["kind"] == "segment")
+            for (t0, k0), (t1, k1) in zip(segs, segs[1:]):
+                n = k1 - k0
+                if n > 0 and t1 > t0:
+                    gaps.extend([(t1 - t0) / n] * n)
+        p = lambda v: (round(float(np.percentile(v, 99)), 5)  # noqa: E731
+                       if v else None)
+        return p(ttfts), p(gaps)
+
+    rows = {}
+    for mode in ("unified", "disagg"):
+        ns = f"bench-disagg-{mode}"
+        client = CoordClient(port=server.port)
+        obs.events.clear()
+        obs.slo.clear()
+        addr = f"127.0.0.1:{server.port}"
+        if mode == "unified":
+            procs = launch_local_fleet(addr, 3, namespace=ns,
+                                       replica_args=base_args)
+        else:
+            procs = launch_local_fleet(
+                addr, 1, namespace=ns,
+                replica_args=base_args + ["--role", "prefill"])
+            procs += scale_fleet(
+                addr, 2, start_index=1, namespace=ns,
+                replica_args=base_args + ["--role", "decode"])
+        try:
+            wait_live(client, 3, namespace=ns, timeout_s=120.0)
+            before = obs.snapshot()["counters"]
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            t0 = time.perf_counter()
+            comps = router.run(make_requests(), timeout_s=180.0)
+            wall = time.perf_counter() - t0
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        got = {c.rid: tuple(c.tokens.tolist()) for c in comps}
+        reports = exit_reports(client, namespace=ns)
+        trace_doc = obs.merge_events(
+            collected=obs.collect_events(client, f"{ns}/events"),
+            router=obs.events.snapshot())
+        p99_ttft, p99_inter = _latencies(trace_doc)
+        rows[mode] = {"p99_ttft_s": p99_ttft}
+        _emit("serve_disagg_tokens_per_s",
+              round(sum(len(t) for t in got.values()) / wall, 1),
+              "tokens/sec", None, mode=mode, replicas=3,
+              prefill_replicas=(1 if mode == "disagg" else 0),
+              decode_replicas=(2 if mode == "disagg" else 0),
+              requests=n_requests,
+              lost_requests=n_requests - len(got),
+              exact_match=all(got.get(r) == w for r, w in want.items()),
+              pool_drained=all(r.get("pool_drained")
+                               for r in reports.values()),
+              handoffs=int(delta("router/handoffs")),
+              handoff_fallbacks=int(delta("serve/handoff_fallbacks")),
+              p99_ttft_s=p99_ttft, p99_intertoken_s=p99_inter,
+              wall_s=round(wall, 2))
+    u, d = rows["unified"]["p99_ttft_s"], rows["disagg"]["p99_ttft_s"]
+    _emit("serve_disagg_ttft_speedup",
+          (round(u / d, 2) if u and d else None), "x", None,
+          unified_p99_ttft_s=u, disagg_p99_ttft_s=d)
+    server.stop()
+
+
 def main() -> None:
     import jax
 
@@ -3265,7 +3404,7 @@ def main() -> None:
                bench_serve_autoscale, bench_scenario_matrix,
                bench_sim_replay, bench_router_failover,
                bench_coord_brownout, bench_corruption_quarantine,
-               bench_serve_prefix_batching]
+               bench_serve_prefix_batching, bench_serve_disagg]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

@@ -27,6 +27,16 @@ The elastic tier (ISSUE 7):
   and serves a second batch — verified token-identical to a local
   reference on the NEW weights, with zero requests lost to the roll.
 
+The disaggregated tier (ISSUE 15):
+
+* ``--disagg`` splits the fleet into 1 prefill replica + N-1 decode
+  replicas.  The prefill replica runs chunked prefill to completion,
+  serializes the finished slot's KV pages, and hands them to a decode
+  replica over the coord store (``router/handoffs`` ticks); the decode
+  replica adopts the pages without re-prefilling.  Greedy decoding over
+  identical weights keeps the output token-identical to a unified
+  single-loop reference, which the demo verifies.
+
 The control-plane tier (ISSUE 9):
 
 * ``--autoscale`` hands the fleet to the `Autoscaler` instead of
@@ -44,6 +54,7 @@ Run (CPU works; each replica is a separate process):
 
     python examples/serve_fleet_tpu.py --replicas 2 --requests 6 --kill
     python examples/serve_fleet_tpu.py --replicas 2 --join --hot-swap
+    python examples/serve_fleet_tpu.py --replicas 3 --disagg
     python examples/serve_fleet_tpu.py --replicas 1 --autoscale
     python examples/serve_fleet_tpu.py --replicas 1 --roll-structural
 """
@@ -84,6 +95,11 @@ def main(argv=None) -> int:
                              "change (paged block size 16 -> 8) with "
                              "a canary exact-check, then a second "
                              "batch on green")
+    parser.add_argument("--disagg", action="store_true",
+                        help="split the fleet into 1 prefill + N-1 "
+                             "decode replicas; finished prefill KV "
+                             "pages migrate to a decode replica "
+                             "instead of being recomputed")
     parser.add_argument("--ttl", type=float, default=1.0,
                         help="replica heartbeat lease (the death-"
                              "detection latency floor)")
@@ -91,6 +107,13 @@ def main(argv=None) -> int:
     if args.hot_swap and args.roll_structural:
         parser.error("--hot-swap and --roll-structural are separate "
                      "demos; pick one")
+    if args.disagg and (args.kill or args.join or args.hot_swap
+                        or args.autoscale or args.roll_structural):
+        parser.error("--disagg is its own demo; run it without the "
+                     "other mode flags")
+    if args.disagg and args.replicas < 2:
+        parser.error("--disagg needs --replicas >= 2 "
+                     "(1 prefill + N-1 decode)")
 
     from tpudist.models.serving import Request, ServeLoop
     from tpudist.runtime.coord import CoordClient, CoordServer
@@ -138,13 +161,29 @@ def main(argv=None) -> int:
         replica_args += ["--snapshot-dir", snap_dir,
                          "--swap-turn-timeout", "5.0"]
 
-    print(f"launching {args.replicas} replicas"
-          + (f" (replica r{args.replicas - 1} will SIGKILL itself after "
-             f"{args.kill_after_segments} decode segments)"
-             if args.kill else ""))
-    procs = launch_local_fleet(
-        f"127.0.0.1:{server.port}", args.replicas,
-        replica_args=replica_args, env_overrides=env)
+    if args.disagg:
+        # prefill replicas require chunked prefill; pin the chunk and
+        # fused segment length to match the reference loop
+        replica_args += ["--prefill-chunk", "8",
+                         "--steps-per-sync", "4"]
+        print(f"launching disaggregated fleet: 1 prefill + "
+              f"{args.replicas - 1} decode replicas (KV pages migrate "
+              "at handoff)")
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", 1,
+            replica_args=replica_args + ["--role", "prefill"])
+        procs += scale_fleet(
+            f"127.0.0.1:{server.port}", args.replicas - 1,
+            start_index=1,
+            replica_args=replica_args + ["--role", "decode"])
+    else:
+        print(f"launching {args.replicas} replicas"
+              + (f" (replica r{args.replicas - 1} will SIGKILL itself "
+                 f"after {args.kill_after_segments} decode segments)"
+                 if args.kill else ""))
+        procs = launch_local_fleet(
+            f"127.0.0.1:{server.port}", args.replicas,
+            replica_args=replica_args, env_overrides=env)
     requests = make_requests(args.requests, seed=0)
     comps2: list = []
     scaler = None
@@ -181,6 +220,14 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         comps = router.run(requests, timeout_s=180.0)
         wall = time.perf_counter() - t0
+        if args.disagg:
+            from tpudist import obs
+
+            snap = obs.snapshot()["counters"]
+            handoffs = int(snap.get("router/handoffs",
+                                    {}).get("value", 0))
+            print(f"{handoffs} KV handoffs crossed the "
+                  "prefill -> decode seam")
         if scaler is not None:
             from tpudist import obs
 

@@ -88,15 +88,18 @@ class TestAllocation:
 
 class TestChurnProperty:
     def test_ragged_churn_drains_and_never_double_references(self):
-        """Many admit/grow/free cycles with ragged lengths across slots:
-        after every operation no block is on two slots (check()), and
-        when everything finishes the pool is fully free again."""
+        """Many admit/grow/free/migrate cycles with ragged lengths
+        across slots: after every operation no block is on two slots
+        (check()), a mid-export slot stays frozen (its pages off the
+        free list, never grown or freed), and when everything finishes
+        the pool is fully free again."""
         rng = np.random.default_rng(42)
         S = 512
         pool = BlockPool(48, 16, 4, S)
         live: dict[int, int] = {}                  # slot -> segments left
+        migrating: set[int] = set()                # frozen by export_slot
         for step in range(300):
-            op = rng.integers(0, 3)
+            op = rng.integers(0, 6)
             if op == 0:                            # admit into a free slot
                 free_slots = [s for s in range(4) if s not in live]
                 if free_slots:
@@ -108,19 +111,63 @@ class TestChurnProperty:
                         live[slot] = int(rng.integers(1, 6))
             elif op == 1:                          # one decode segment
                 for slot in list(live):
+                    if slot in migrating:          # frozen: no growth
+                        continue
                     pool.grow(slot, 32)
                     live[slot] -= 1
-            else:                                  # finalize finished slots
-                for slot in [s for s, left in live.items() if left <= 0]:
+            elif op == 2:                          # finalize finished slots
+                for slot in [s for s, left in live.items()
+                             if left <= 0 and s not in migrating]:
                     pool.free_slot(slot)
                     del live[slot]
+            elif op == 3:                          # begin a KV export
+                cands = [s for s in live if s not in migrating
+                         and pool._slot_blocks[s]]
+                if cands:
+                    slot = cands[int(rng.integers(0, len(cands)))]
+                    man = pool.export_slot(slot)
+                    assert man["blocks"] == list(pool._slot_blocks[slot])
+                    assert man["block_size"] == pool.block_size
+                    with np.testing.assert_raises(RuntimeError):
+                        pool.export_slot(slot)     # no double export
+                    migrating.add(slot)
+            elif op == 4:                          # resolve an export
+                if migrating:
+                    slot = sorted(migrating)[0]
+                    migrating.discard(slot)
+                    if rng.integers(0, 2):
+                        pool.complete_export(slot)  # acked: slot frees
+                        del live[slot]
+                    else:
+                        pool.abort_export(slot)     # slot whole again
+            else:                                  # adopt a migrated-in seq
+                free_slots = [s for s in range(4) if s not in live]
+                if free_slots:
+                    L = int(rng.integers(1, 200))
+                    mn = int(rng.integers(1, min(120, S - L)))
+                    if pool.can_admit(L, mn):
+                        slot = free_slots[0]
+                        blks = pool.adopt_blocks(slot, L, mn)
+                        # private pages covering the whole prompt, in
+                        # logical order through the table
+                        assert len(blks) == blocks_for(L, pool.block_size)
+                        assert blks == pool.table[slot][:len(blks)].tolist()
+                        live[slot] = int(rng.integers(1, 6))
             pool.check()
+            # a frozen slot's pages never reach the free list
+            for slot in migrating:
+                assert not (set(pool._slot_blocks[slot])
+                            & set(pool._free))
             # no page referenced by two live slots THROUGH THE TABLE
             # either: only rows of live slots count (free rows are zeroed)
             rows = [pool.table[s][:len(pool._slot_blocks[s])]
                     for s in live]
             flat = np.concatenate(rows) if rows else np.zeros(0, int)
             assert len(flat) == len(set(flat.tolist()))
+        for slot in sorted(migrating):
+            pool.abort_export(slot)                # slots whole again
+        with np.testing.assert_raises(RuntimeError):
+            pool.complete_export(0)                # nothing mid-export
         for slot in list(live):
             pool.free_slot(slot)
         pool.check()

@@ -341,6 +341,9 @@ def _passing_row(name: str) -> dict:
             "lost_requests": 0, "p99_queue_wait_s": 0.05,
             "recovery_s": 5.0,
             "scale_ups": env.min_scale_ups, "drains": env.min_drains,
+            "scale_ups_prefill": env.min_scale_ups_prefill,
+            "scale_ups_decode": env.min_scale_ups_decode,
+            "p99_ttft_s": 0.05,
             "priority_bad": 0, "replica_deaths": 0,
             "router_recoveries": env.min_router_recoveries,
             "quarantines": env.min_quarantines,

@@ -17,6 +17,14 @@ DOCS = {
                 "assigned": None, "attempts": 0, "terminal": None},
     "heartbeat": {"replica": "r0", "served": 12, "clean": True},
     "prefix": {"replica": "r0", "hashes": [12345678901, 42]},
+    "kv_migration": {"key": "00000007", "rid": "caller",
+                     "prompt": [3, 1, 4], "max_new_tokens": 9,
+                     "first": 5, "true_len": 3, "block_size": 8,
+                     "chain": [], "published_at": 12.5,
+                     "layers": [{"k": {"b64": "AAAA", "dtype": "float32",
+                                       "shape": [1, 8, 4]},
+                                 "v": {"b64": "AAAA", "dtype": "float32",
+                                       "shape": [1, 8, 4]}}]},
 }
 
 

@@ -168,6 +168,11 @@ class BlockPool:
         # set by PrefixCache: frees >=1 refcount-0 cached block on
         # demand; lets reservations count evictable blocks as capacity
         self._evict_hook: Callable[[], bool] | None = None
+        # slots whose KV is mid-migration to another pool: slot -> the
+        # frozen block list snapshotted at export_slot().  Until the ack
+        # (complete_export) or abort lands, the slot may not be freed,
+        # grown, or COW-split — the exporter is still reading the pages.
+        self._migrating: dict[int, list[int]] = {}
         self.table = np.zeros(
             (num_slots, self.max_blocks_per_slot), np.int32)
         self._obs_used = obs.gauge("serve/kv_blocks_used", unit="blocks")
@@ -267,6 +272,21 @@ class BlockPool:
             raise AssertionError(
                 f"reservation {self._reserved_total} outside reclaimable "
                 f"capacity {len(self._free)} + {len(idle_cached)}")
+        for slot, snapshot in self._migrating.items():
+            if self._slot_blocks[slot] != snapshot:
+                raise AssertionError(
+                    f"slot {slot} mutated mid-migration: exported "
+                    f"{snapshot}, now holds {self._slot_blocks[slot]}")
+            bad = [b for b in snapshot if b in free]
+            if bad:
+                raise AssertionError(
+                    f"in-migration blocks of slot {slot} on the free "
+                    f"list: {bad}")
+            bad = [b for b in snapshot if self._refcount[b] < 1]
+            if bad:
+                raise AssertionError(
+                    f"in-migration blocks of slot {slot} unreferenced: "
+                    f"{bad}")
 
     # -- allocation --------------------------------------------------------
 
@@ -346,6 +366,9 @@ class BlockPool:
         for the first output logit).  Returns the block now under the
         slot — the caller re-inserts that block's content from its
         recomputed dense cache, which IS the copy."""
+        if slot in self._migrating:
+            raise RuntimeError(
+                f"cow_write on slot {slot} while its KV is in migration")
         blks = self._slot_blocks[slot]
         old = blks[block_idx]
         if self._refcount[old] == 1 and old not in self._pinned:
@@ -369,6 +392,9 @@ class BlockPool:
         """Advance ``slot``'s coverage by ``steps`` decode tokens (capped
         at its reservation), allocating from the reserved budget — this
         can never fail for an admitted slot."""
+        if slot in self._migrating:
+            raise RuntimeError(
+                f"grow on slot {slot} while its KV is in migration")
         target = min(self._watermark[slot] + steps, self._cap[slot])
         need = blocks_for(target, self.block_size)
         have = len(self._slot_blocks[slot])
@@ -391,6 +417,10 @@ class BlockPool:
         reservation; blocks reaching refcount 0 go back to the free list
         unless the prefix cache pins them (those stay resident as
         cached-idle capacity, reclaimed lazily by LRU eviction)."""
+        if slot in self._migrating:
+            raise RuntimeError(
+                f"free_slot on slot {slot} while its KV is in migration; "
+                "complete_export or abort_export it first")
         blks = self._slot_blocks[slot]
         held = blocks_for(self._cap[slot], self.block_size) if blks else 0
         self._reserved_total -= max(held - len(blks), 0)
@@ -407,6 +437,60 @@ class BlockPool:
         self._shared_upto[slot] = 0
         self._prompt_len[slot] = 0
         self._publish()
+
+    # -- KV migration (disaggregated prefill/decode) ----------------------
+
+    def export_slot(self, slot: int) -> dict:
+        """Begin migrating ``slot``'s KV to another pool.
+
+        Returns the migration manifest — the slot's ORDERED block list
+        (pool indices, leftmost = logical position 0), its prompt
+        length, coverage watermark, and shared-prefix boundary — and
+        freezes the slot: until :meth:`complete_export` (the ack) or
+        :meth:`abort_export` lands, the slot may not be freed, grown,
+        or COW-split, and :meth:`check` asserts its pages stay off the
+        free list.  The caller reads the device pages named by
+        ``blocks`` while the freeze holds."""
+        blks = self._slot_blocks[slot]
+        if not blks:
+            raise RuntimeError(f"export_slot on empty slot {slot}")
+        if slot in self._migrating:
+            raise RuntimeError(f"slot {slot} already in migration")
+        self._migrating[slot] = list(blks)
+        return {
+            "blocks": list(blks),
+            "prompt_len": self._prompt_len[slot],
+            "watermark": self._watermark[slot],
+            "shared_upto": self._shared_upto[slot],
+            "block_size": self.block_size,
+        }
+
+    def complete_export(self, slot: int) -> None:
+        """Ack ``slot``'s migration: the payload has been copied out of
+        the pool's pages, so the freeze lifts and the slot frees."""
+        if slot not in self._migrating:
+            raise RuntimeError(f"slot {slot} is not in migration")
+        del self._migrating[slot]
+        self.free_slot(slot)
+
+    def abort_export(self, slot: int) -> None:
+        """Cancel ``slot``'s migration without freeing it — the slot is
+        whole again (the export never mutated it) and the caller decides
+        what happens next (resume serving it locally, or free it)."""
+        self._migrating.pop(slot, None)
+
+    def adopt_blocks(self, slot: int, prompt_len: int,
+                     max_new_tokens: int) -> list[int]:
+        """Allocate pages for a migrated-in sequence: ``slot`` receives
+        fresh blocks covering ``prompt_len`` positions plus the same
+        worst-case reservation :meth:`admit` would take, and the caller
+        scatters the received KV bytes into the returned block indices.
+        No prefix aliasing — migrated pages are private to the slot
+        (the local prefix cache never saw their token chain prefill
+        here, so registration happens separately if at all).  Caller
+        must have checked :meth:`can_admit` first."""
+        self.admit(slot, prompt_len, max_new_tokens)
+        return list(self._slot_blocks[slot])
 
     # -- prefix-cache pinning ---------------------------------------------
 

@@ -56,7 +56,7 @@ from tpudist.models.generate import (
     _stop_array,
     serving_layout,
 )
-from tpudist.models.kv_pages import BlockPool, PrefixCache
+from tpudist.models.kv_pages import BlockPool, PrefixCache, chain_hashes
 from tpudist.models.speculative import (
     AdaptiveDraftPolicy,
     _accept_and_next,
@@ -112,6 +112,12 @@ class Request:
     priority: int = 0             # 0 = best-effort; higher = keep longer
     trace: Any = None             # TraceContext | None (fleet tracing)
     prefix_hash: int | None = None  # router prefix-affinity key
+    # disaggregated serving: a KV-migration payload from a prefill
+    # replica (see tpudist.runtime.disagg).  A decode-role loop ADOPTS
+    # the migrated pages instead of prefilling; None (or a payload that
+    # fails verification) means ordinary admission — the re-prefill
+    # fallback that keeps a lost handoff exact.
+    kv_handoff: Any = None
 
 
 @dataclasses.dataclass
@@ -123,8 +129,11 @@ class Completion:
     # full admission queue), "timeout" (deadline_s passed), "invalid"
     # (service-mode request failed validation), "shed" (router-side SLO
     # admission refused it before any replica paid a prefill — see
-    # tpudist.runtime.router)
+    # tpudist.runtime.router), "handoff" (a prefill-role loop finished
+    # the prompt and exported its KV; `handoff` carries the migration
+    # payload and the DECODE stage produces the tokens)
     reason: str
+    handoff: Any = None           # KV-migration payload (prefill role)
 
 
 def _index_leaves(cache: Any) -> tuple[jnp.ndarray, jnp.ndarray | None]:
@@ -263,6 +272,21 @@ class ServeLoop:
         one position through a COW split of the last shared block.
         The cache is flushed at every weight hot-swap (cached KV is
         stale the moment params change).
+      role: ``"both"`` (default — the unified loop), ``"prefill"``, or
+        ``"decode"`` — the disaggregated fleet split
+        (:mod:`tpudist.runtime.disagg`).  A PREFILL loop runs chunked
+        prefill to completion, exports the slot's KV pages plus the
+        first sampled token as a migration payload
+        (``Completion(reason="handoff", handoff=payload)``), frees the
+        slot, and never dispatches a decode segment — its lanes turn
+        over at prompt cadence.  A DECODE loop admits requests whose
+        ``Request.kv_handoff`` carries such a payload by ADOPTING the
+        pages into its own pool (no prefill) and decoding from the
+        migrated state; a missing or unverifiable payload falls back
+        to an ordinary prefill of the same prompt, which greedy
+        decoding over identical weights makes byte-identical.
+        ``"prefill"`` requires the paged layout + chunked prefill
+        (plain decode); ``"decode"`` requires the paged layout.
     """
 
     def __init__(
@@ -295,6 +319,7 @@ class ServeLoop:
         spec_ladder: Sequence[int] = (2, 4, 8),
         chunked_prefill: bool = True,
         prefix_sharing: bool = True,
+        role: str = "both",
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -320,6 +345,22 @@ class ServeLoop:
             raise ValueError(
                 "cache_layout='paged' has no sliding-window trim yet; "
                 "serve windowed models with the dense layout")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill', or 'decode', got "
+                f"{role!r}")
+        if role == "prefill" and not (cache_layout == "paged"
+                                      and chunked_prefill
+                                      and decode_mode == "plain"):
+            raise ValueError(
+                "role='prefill' needs cache_layout='paged' with chunked "
+                "prefill under plain decode: the handoff exports pool "
+                "pages at the chunked-admission finish")
+        if role == "decode" and cache_layout != "paged":
+            raise ValueError(
+                "role='decode' needs cache_layout='paged': handoff "
+                "adoption scatters migrated pages into the block pool")
+        self.role = role
         self.cfg = cfg
         self.params = params
         self.B = num_slots
@@ -602,6 +643,21 @@ class ServeLoop:
         # device work without touching live state
         self._prefill_one = jax.jit(self._prefill_impl,
                                     static_argnames=("true_chunk",))
+        if cache_layout == "paged":
+            # disaggregated handoff adoption: one dispatch scatters the
+            # migrated KV blocks into this pool's pages and stamps the
+            # lane (the decode-side mirror of _admit_finish, minus any
+            # prefill).  Compiled per distinct used-block count, which
+            # max_blocks_per_slot bounds.
+            self._adopt_dev = jax.jit(self._adopt_dev_impl,
+                                      donate_argnums=(0, 1, 2, 3, 4))
+        # disaggregation accounting: adoptions took the migrated-KV
+        # path; fallbacks re-prefilled because the payload was missing
+        # or failed verification (both exact by construction — the
+        # counters tell which path a request took)
+        self._obs_adoptions = obs.counter("serve/adoptions", unit="reqs")
+        self._obs_handoff_fallbacks = obs.counter(
+            "serve/handoff_fallbacks", unit="reqs")
         if self.chunked:
             # chunked admission's three dispatches: (a) gather a shared
             # prefix's pool blocks into the dense batch-1 prefill cache
@@ -898,6 +954,51 @@ class ServeLoop:
         last = lax.dynamic_index_in_dim(
             logits[0], true_len - 1 - off, keepdims=False)
         first = self._select(last[None, :], key)[0].astype(jnp.int32)
+        tok = tok.at[slot].set(first)
+        act = max_new > 1
+        if self._stop is not None:
+            act = act & ~jnp.isin(first, self._stop)
+        active = active.at[slot].set(act)
+        remaining = remaining.at[slot].set(max_new - 1)
+        first_buf = first_buf.at[slot].set(first)
+        return cache, tok, active, remaining, first_buf
+
+    def _adopt_dev_impl(self, cache, tok, active, remaining, first_buf,
+                        kv, pages_used, full_row, true_len, slot,
+                        max_new, first):
+        """Adopt a MIGRATED prefill into ``slot``, one dispatch: the
+        handoff's per-layer KV blocks scatter into this pool's freshly
+        allocated pages and the lane stamps mirror
+        :meth:`_admit_finish_impl`'s tail exactly — except ``first`` is
+        the token the EXPORTER sampled (carried in the payload), not a
+        local selection, so no prefill runs here at all.  ``kv`` walks
+        the cache's paged nodes in natural dict order, the SAME order
+        :meth:`_paged_nodes` exported them in: every replica builds an
+        identical cache structure from the same model code, so index
+        ``i`` here names the layer index ``i`` named there."""
+        i = 0
+
+        def walk(node):
+            nonlocal i
+            if not isinstance(node, dict):
+                return node
+            if "paged_key" in node:
+                k, v = kv[i]
+                i += 1
+                out = dict(node)
+                out["paged_key"] = node["paged_key"].at[pages_used].set(
+                    k.astype(node["paged_key"].dtype))
+                out["paged_value"] = (
+                    node["paged_value"].at[pages_used].set(
+                        v.astype(node["paged_value"].dtype)))
+                out["page_table"] = (
+                    node["page_table"].at[slot].set(full_row))
+                out["cache_index"] = (
+                    node["cache_index"].at[slot].set(true_len))
+                return out
+            return {key: walk(val) for key, val in node.items()}
+
+        cache = walk(cache)
         tok = tok.at[slot].set(first)
         act = max_new > 1
         if self._stop is not None:
@@ -1234,6 +1335,18 @@ class ServeLoop:
             while len(self._affinity_recent) > 128:
                 self._affinity_recent.pop(
                     next(iter(self._affinity_recent)))
+        if (req.kv_handoff is not None and self.pool is not None
+                and self.role != "prefill"):
+            # disaggregated decode stage: adopt the migrated pages —
+            # zero prefill compute — unless the payload fails
+            # verification, in which case fall THROUGH to an ordinary
+            # admission of the same prompt (greedy + fleet-identical
+            # weights make the re-prefill output byte-identical, so the
+            # fallback trades only latency)
+            st = self._admit_adopt(slot, req, prompt, L)
+            if st is not None:
+                return st
+            self._obs_handoff_fallbacks.inc()
         if self.chunked:
             return self._admit_start(slot, req, prompt, L)
         self.prefix_stats["prefill_tokens"] += L
@@ -1338,6 +1451,111 @@ class ServeLoop:
                             "chunks": chunks, "logits": None,
                             "off_last": 0, "L": L, "max_new": max_new,
                             "pages": pages, "write_block": write_block}}
+
+    # -- disaggregated handoff (see tpudist.runtime.disagg) ----------------
+
+    def _paged_nodes(self, cache) -> list:
+        """The cache's paged layer nodes in natural dict order — the
+        canonical layer order for KV migration payloads.  Export and
+        adoption both walk this order (see ``_adopt_dev_impl``), which
+        is stable fleet-wide because every replica instantiates the
+        same model structure."""
+        out = []
+
+        def walk(node):
+            if not isinstance(node, dict):
+                return
+            if "paged_key" in node:
+                out.append(node)
+                return
+            for v in node.values():
+                walk(v)
+
+        walk(cache)
+        return out
+
+    def _build_handoff(self, slot: int, req: Request, pf: dict) -> dict:
+        """Serialize ``slot``'s finished prefill as a migration payload
+        (see :mod:`tpudist.runtime.disagg` for the schema) and free the
+        slot.  The page gather syncs the device — acceptable on a
+        prefill-only replica, where no decode cadence exists to stall —
+        and the export freeze guarantees the pages it reads are this
+        slot's (``check()`` would catch a mutation mid-copy)."""
+        manifest = self.pool.export_slot(slot)
+        pages = np.asarray(manifest["blocks"], np.int32)
+        layers = [{"k": np.asarray(node["paged_key"][pages]),
+                   "v": np.asarray(node["paged_value"][pages])}
+                  for node in self._paged_nodes(self.cache)]
+        prompt = np.asarray(req.prompt, np.int32)
+        payload = {
+            "key": None,   # stamped by the worker at publish
+            "rid": req.rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            # the exporter's sampled first token rides along: the decode
+            # side emits it verbatim instead of re-running the prompt's
+            # last logit
+            "first": int(self._first[slot]),
+            "true_len": int(pf["L"]),
+            "block_size": int(self.kv_block_size),
+            "chain": chain_hashes(prompt, self.kv_block_size),
+            "published_at": time.time(),
+            "layers": layers,
+        }
+        self.pool.complete_export(slot)
+        return payload
+
+    def _admit_adopt(self, slot: int, req: Request, prompt: np.ndarray,
+                     L: int) -> dict | None:
+        """Admit ``req`` by ADOPTING its migrated KV payload — zero
+        prefill compute.  Returns ``None`` when the payload fails any
+        verification gate (structure, lengths, block size, prefix-hash
+        chain, layer count/shape): the caller falls back to an ordinary
+        re-prefill of the carried prompt, which greedy decoding over
+        fleet-identical weights makes byte-identical."""
+        payload = req.kv_handoff
+        try:
+            first = int(payload["first"])
+            true_len = int(payload["true_len"])
+            bs = int(payload["block_size"])
+            chain = [int(h) for h in payload["chain"]]
+            layers = payload["layers"]
+        except (KeyError, TypeError, ValueError):
+            return None
+        nodes = self._paged_nodes(self.cache)
+        if (true_len != L or bs != self.kv_block_size
+                or chain != chain_hashes(prompt, self.kv_block_size)
+                or len(layers) != len(nodes)):
+            return None
+        max_new = int(req.max_new_tokens)
+        blocks = self.pool.adopt_blocks(slot, L, max_new)
+        m_used = len(blocks)
+        kv = []
+        for l in layers:
+            try:
+                k = jnp.asarray(l["k"])
+                v = jnp.asarray(l["v"])
+            except (KeyError, TypeError, ValueError):
+                k = v = None
+            if (k is None or k.ndim != 3 or k.shape[0] != m_used
+                    or k.shape[1] != bs or v.shape != k.shape):
+                # shape lies past the chain check: un-admit and let the
+                # fallback prefill take the slot instead
+                self.pool.free_slot(slot)
+                return None
+            kv.append((k, v))
+        pages_used = jnp.asarray(np.asarray(blocks, np.int32))
+        full_row = jnp.asarray(self.pool.table[slot])
+        (self.cache, self._tok, self._active, self._remaining,
+         self._first) = self._adopt_dev(
+            self.cache, self._tok, self._active, self._remaining,
+            self._first, tuple(kv), pages_used, full_row,
+            np.int32(true_len), np.int32(slot), np.int32(max_new),
+            np.int32(first))
+        self._obs_adoptions.inc()
+        obs.recorder.record("serve_adopt", slot=slot, prompt_len=L,
+                            blocks=m_used)
+        return {"req": req, "tokens": [], "pending_first": True}
 
     def _plan_steps(self, slot_state) -> int:
         """Per-dispatch segment length: ``steps_per_sync``, CLAMPED
@@ -1717,6 +1935,7 @@ class ServeLoop:
             token from the final chunk's logits, stamps the lane
             active, and the slot joins decode with its drain gated on
             the NEXT segment."""
+            freed_by_handoff: list[int] = []
             for slot in range(self.B):
                 st = slot_state[slot]
                 if st is None or "prefill" not in st:
@@ -1754,9 +1973,43 @@ class ServeLoop:
                         self.pool._slot_blocks[slot])
                 tev("prefill_done", st["req"], slot=slot, seq=seq,
                     prompt_len=pf["L"])
+                if self.role == "prefill":
+                    # disaggregated handoff: this loop's job ENDS at
+                    # prefill_done.  Undo the finish dispatch's active
+                    # stamp (no decode segment may advance this lane),
+                    # export the slot's pages + first token as the
+                    # migration payload, and emit a reason="handoff"
+                    # completion the router turns into a decode-stage
+                    # dispatch.  complete_export (inside _build_handoff)
+                    # frees the slot, so the lane recycles immediately —
+                    # the structural TTFT win of a prefill-only replica.
+                    self._active = self._active.at[slot].set(False)
+                    payload = self._build_handoff(slot, st["req"], pf)
+                    tev("handoff_export", st["req"], slot=slot, seq=seq,
+                        prompt_len=pf["L"],
+                        blocks=-(-pf["L"] // self.kv_block_size))
+                    emit(Completion(
+                        rid=st["req"].rid,
+                        prompt=np.asarray(st["req"].prompt),
+                        tokens=np.zeros((0,), np.int32),
+                        reason="handoff", handoff=payload))
+                    if "t_admit" in st:
+                        self._obs_latency.record(
+                            time.perf_counter() - st["t_admit"])
+                    del st["prefill"]
+                    slot_state[slot] = None
+                    freed_by_handoff.append(slot)
+                    continue
                 del st["prefill"]
                 # tokens first surface in the NEXT dispatched segment
                 st["seq"] = seq
+            if freed_by_handoff:
+                # a prefill-role loop has no decode dispatches, so
+                # nothing else would refill a lane freed by export —
+                # pull from the queue NOW or an idle source starves the
+                # loop with work still pending
+                admit_free()
+                shed()
 
         def busy_decode() -> bool:
             """Lanes a decode segment could advance — zombie and
@@ -1774,7 +2027,11 @@ class ServeLoop:
             — they must run to completion before the swap lands.
             ``pending`` alone also counts: queued requests can be
             blocked on pool blocks held by ZOMBIE lanes, whose refund
-            only lands when segments drain past the kill point."""
+            only lands when segments drain past the kill point.  A
+            prefill-role loop NEVER decodes: its lanes hand off at
+            prefill_done, so decode segments would only spin empty."""
+            if self.role == "prefill":
+                return False
             return busy_decode() or (bool(pending)
                                      and self._pending_swap is None)
 

@@ -95,7 +95,8 @@ class AutoscaleConfig:
                  poll_s: float = 0.5,
                  step: int = 1,
                  max_metric_age_s: float = 5.0,
-                 max_burn_rate: float | None = None) -> None:
+                 max_burn_rate: float | None = None,
+                 min_kv_free_frac: float | None = None) -> None:
         if min_replicas < 0 or max_replicas < max(min_replicas, 1):
             raise ValueError(
                 f"need 0 <= min_replicas <= max_replicas (>=1), got "
@@ -136,6 +137,17 @@ class AutoscaleConfig:
         # slow queues.  None disables the signal.
         self.max_burn_rate = (None if max_burn_rate is None
                               else float(max_burn_rate))
+        # KV-pressure up-signal: a poll where the pool's merged free
+        # block fraction (free / (free + used)) sits below this counts
+        # as a breach even with an empty queue — the DECODE pool's load
+        # is resident cache, not queue wait, so waiting for queue-wait
+        # breach means admissions are already stalling on pages.  None
+        # disables the signal (the prefill pool's load IS queue wait).
+        if min_kv_free_frac is not None and not 0.0 < min_kv_free_frac < 1.0:
+            raise ValueError(f"min_kv_free_frac must be in (0, 1), got "
+                             f"{min_kv_free_frac}")
+        self.min_kv_free_frac = (None if min_kv_free_frac is None
+                                 else float(min_kv_free_frac))
 
     @classmethod
     def from_env(cls, environ=None, **overrides) -> "AutoscaleConfig":
@@ -156,7 +168,8 @@ class AutoscaleConfig:
                 ("POLL_S", "poll_s", float),
                 ("STEP", "step", int),
                 ("MAX_METRIC_AGE_S", "max_metric_age_s", float),
-                ("MAX_BURN_RATE", "max_burn_rate", float)):
+                ("MAX_BURN_RATE", "max_burn_rate", float),
+                ("MIN_KV_FREE_FRAC", "min_kv_free_frac", float)):
             v = _env(env, name)
             if v is not None:
                 kw[key] = cast(v)
@@ -177,6 +190,17 @@ class Autoscaler:
         fake; multi-host deployments inject their pod launcher).
       replica_args / platform: forwarded to the default spawner so
         joiners run the same serve configuration as the fleet.
+      pool: ``None`` (unified fleet — every replica) or a disaggregated
+        stage, ``"prefill"`` / ``"decode"``.  A pool-scoped instance
+        observes and acts ONLY on replicas registered with that role:
+        its live/draining/quarantined views, metric merge, victim pick
+        and spawner (joiners get ``--role {pool}``) all filter by the
+        registration's role, and every ``autoscale/*`` metric it owns
+        is suffixed ``~pool={pool}`` so the two control loops of a
+        disaggregated fleet never collide.  The pools' load signals
+        differ by design: prefill load is QUEUE WAIT (bursty compute),
+        decode load is RESIDENT KV (steady memory) — size the decode
+        pool with ``min_kv_free_frac``.
       clock: injectable monotonic clock (deterministic cooldown tests).
 
     :meth:`poll` is ONE control decision — observe, decide, act — and
@@ -192,9 +216,14 @@ class Autoscaler:
                  replica_args: Sequence[str] = (),
                  env_extra: dict | None = None,
                  platform: str = "cpu",
+                 pool: str | None = None,
                  clock=time.monotonic) -> None:
+        if pool not in (None, "prefill", "decode"):
+            raise ValueError(f"pool must be None, 'prefill' or 'decode', "
+                             f"got {pool!r}")
         self.client = client
         self.ns = namespace
+        self.pool = pool
         self.cfg = config or AutoscaleConfig.from_env()
         self.replica_args = list(replica_args)
         self.env_extra = dict(env_extra or {})
@@ -223,30 +252,37 @@ class Autoscaler:
         self._last_down: float | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._obs_ups = obs.counter("autoscale/scale_ups", unit="replicas")
-        self._obs_downs = obs.counter("autoscale/scale_downs",
+        tag = "" if pool is None else f"~pool={pool}"
+        self._obs_ups = obs.counter(f"autoscale/scale_ups{tag}",
+                                    unit="replicas")
+        self._obs_downs = obs.counter(f"autoscale/scale_downs{tag}",
                                       unit="replicas")
-        self._obs_drained = obs.counter("autoscale/drain_completed",
+        self._obs_drained = obs.counter(f"autoscale/drain_completed{tag}",
                                         unit="replicas")
-        self._obs_polls = obs.counter("autoscale/polls", unit="polls")
+        self._obs_polls = obs.counter(f"autoscale/polls{tag}",
+                                      unit="polls")
         self._obs_suppressed = obs.counter(
-            "autoscale/suppressed_polls", unit="polls",
+            f"autoscale/suppressed_polls{tag}", unit="polls",
             help="polls skipped because the coord store was unreachable "
                  "(no scaling verdicts on blind data)")
-        self._obs_replicas = obs.gauge("autoscale/replicas",
+        self._obs_replicas = obs.gauge(f"autoscale/replicas{tag}",
                                        unit="replicas")
-        self._obs_wait = obs.gauge("autoscale/wait_q", unit="s")
-        self._obs_breach = obs.gauge("autoscale/breach_polls",
+        self._obs_wait = obs.gauge(f"autoscale/wait_q{tag}", unit="s")
+        self._obs_breach = obs.gauge(f"autoscale/breach_polls{tag}",
                                      unit="polls")
-        self._obs_idle = obs.gauge("autoscale/idle_polls", unit="polls")
+        self._obs_idle = obs.gauge(f"autoscale/idle_polls{tag}",
+                                   unit="polls")
         self._obs_burn = obs.gauge(
-            "autoscale/burn_rate", unit="x",
+            f"autoscale/burn_rate{tag}", unit="x",
             help="SLO burn rate the scaling decision saw (max of fleet "
                  "gauges and the local tracker's shortest window)")
 
     def _default_spawner(self, n: int) -> list:
+        args = list(self.replica_args)
+        if self.pool is not None and "--role" not in args:
+            args += ["--role", self.pool]
         return scale_fleet(self.coord_addr, n, namespace=self.ns,
-                           replica_args=self.replica_args,
+                           replica_args=args,
                            env_extra=self.env_extra,
                            platform=self.platform)
 
@@ -278,13 +314,35 @@ class Autoscaler:
                 out[key[len(prefix):]] = json.loads(raw.decode())
         return out
 
+    def _pool_rids(self, regs: dict[str, dict]) -> set[str] | None:
+        """Replicas this instance manages: ``None`` means ALL (the
+        unified loop); a pool-scoped loop keeps only registrations
+        carrying its role.  A live-but-unregistered joiner is invisible
+        until it registers — its capacity-on-the-way is already counted
+        through :meth:`_pending_joiners`."""
+        if self.pool is None:
+            return None
+        return {rid for rid, info in regs.items()
+                if info.get("role", "both") == self.pool}
+
     def _observe(self) -> dict:
-        """The merged fleet view one decision is made from."""
+        """The merged fleet view one decision is made from (pool-scoped
+        instances see only their own pool's replicas and metrics)."""
         live = self.live()
         draining = self.draining()
         quarantined = self.quarantined()
         snaps = collect(self.client, f"{self.ns}/metrics",
                         max_age_s=self.cfg.max_metric_age_s)
+        regs = self._registrations() if self.pool is not None else {}
+        mine = self._pool_rids(regs)
+        if mine is not None:
+            live &= mine
+            draining &= mine
+            quarantined &= mine
+            rank_to_rid = {int(info.get("rank", -1)): rid
+                           for rid, info in regs.items()}
+            snaps = {rank: s for rank, s in snaps.items()
+                     if rank_to_rid.get(rank) in mine}
         merged = merge_snapshots(snaps)
         wait = merged["histograms"].get("serve/queue_wait_s")
         wait_q = (hist_quantile(wait, self.cfg.quantile)
@@ -295,6 +353,11 @@ class Autoscaler:
                  or {}).get("value") or 0.0
         free = (merged["gauges"].get("serve/kv_blocks_free")
                 or {}).get("value")
+        used = (merged["gauges"].get("serve/kv_blocks_used")
+                or {}).get("value")
+        kv_free_frac = (free / (free + used)
+                        if free is not None and used is not None
+                        and free + used > 0 else None)
         # burn rate: worst across the fleet's published slo/burn_rate_*
         # gauges (per_worker max — summing rates across replicas would
         # overstate) and the local tracker's shortest window (a rank-0
@@ -312,6 +375,7 @@ class Autoscaler:
         return {"live": live, "draining": draining,
                 "quarantined": quarantined, "wait_q": wait_q,
                 "queue_depth": depth, "kv_blocks_free": free,
+                "kv_free_frac": kv_free_frac,
                 "burn_rate": burn, "snaps": snaps}
 
     def _pending_joiners(self, live: set[str]) -> list:
@@ -396,7 +460,8 @@ class Autoscaler:
             self._breach = 0
             self._idle = 0
             self._obs_suppressed.inc()
-            record = {"action": None, "suppressed": True,
+            record = {"action": None, "pool": self.pool,
+                      "suppressed": True,
                       "error": str(err), "poll": self._poll_n,
                       "t": self._clock()}
             self._poll_n += 1
@@ -420,7 +485,12 @@ class Autoscaler:
 
         burning = (self.cfg.max_burn_rate is not None
                    and view["burn_rate"] > self.cfg.max_burn_rate)
-        if view["wait_q"] > self.cfg.target_wait_s or burning:
+        # decode-pool pressure: resident KV, not queue wait — scale up
+        # BEFORE admissions stall on pages
+        starved = (self.cfg.min_kv_free_frac is not None
+                   and view["kv_free_frac"] is not None
+                   and view["kv_free_frac"] < self.cfg.min_kv_free_frac)
+        if view["wait_q"] > self.cfg.target_wait_s or burning or starved:
             self._breach += 1
             self._idle = 0
         elif (view["wait_q"] < self.cfg.low_wait_s
@@ -438,11 +508,16 @@ class Autoscaler:
                 and (self._last_up is None
                      or now - self._last_up >= self.cfg.up_cooldown_s)):
             n = min(self.cfg.step, self.cfg.max_replicas - capacity)
-            log.info("autoscale: wait %s=%.3fs > target %.3fs for %d "
-                     "polls; scaling up by %d (active=%d pending=%d)",
-                     f"p{int(self.cfg.quantile * 100)}", view["wait_q"],
-                     self.cfg.target_wait_s, self._breach, n,
-                     len(active), len(pending))
+            why = ("kv_free_frac=%.2f < %.2f" % (
+                       view["kv_free_frac"], self.cfg.min_kv_free_frac)
+                   if starved else
+                   "wait p%d=%.3fs > target %.3fs" % (
+                       int(self.cfg.quantile * 100), view["wait_q"],
+                       self.cfg.target_wait_s))
+            log.info("autoscale%s: %s for %d polls; scaling up by %d "
+                     "(active=%d pending=%d)",
+                     "" if self.pool is None else f"[{self.pool}]", why,
+                     self._breach, n, len(active), len(pending))
             self.procs.extend(self.spawner(n))
             self._obs_ups.inc(n)
             self._last_up = now
@@ -472,11 +547,13 @@ class Autoscaler:
         self._obs_breach.set(self._breach)
         self._obs_idle.set(self._idle)
         self._obs_burn.set(view["burn_rate"])
-        record = {"action": action, "wait_q": view["wait_q"],
+        record = {"action": action, "pool": self.pool,
+                  "wait_q": view["wait_q"],
                   "active": sorted(active), "draining": sorted(draining),
                   "quarantined": sorted(view["quarantined"]),
                   "pending": len(pending),
                   "queue_depth": view["queue_depth"],
+                  "kv_free_frac": view["kv_free_frac"],
                   "burn_rate": view["burn_rate"],
                   "breach": self._breach, "idle": self._idle,
                   "poll": self._poll_n, "t": now}

@@ -106,6 +106,19 @@ Environment knobs (all optional):
                                     a quarantined replica that keeps failing
                                     its golden probes (N large: retirement;
                                     N small: fail-then-reinstate)
+``TPUDIST_FAULT_HANDOFF_DROP``      swallow the first N KV-migration payload
+                                    publishes: the prefill replica believes
+                                    the handoff landed but the payload never
+                                    reaches the store — the decode side must
+                                    fall back to re-prefill with identical
+                                    output
+``TPUDIST_FAULT_KILL_AT_HANDOFF``   SIGKILL self immediately after
+                                    publishing the Nth KV-migration payload,
+                                    BEFORE committing the handoff done
+                                    record — the router must redispatch the
+                                    request exactly-once (re-prefill on a
+                                    surviving replica, byte-identical
+                                    output)
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -122,7 +135,8 @@ __all__ = ["FaultInjected", "RouterKilled", "FaultPlan", "plan",
            "install", "reset", "coord_op", "drop_heartbeat",
            "drop_publish", "on_segment", "on_warmup", "corrupt_canary",
            "autoscale_poll", "on_router_poll", "flip_wire_bits",
-           "poison_logits", "corrupt_probe"]
+           "poison_logits", "corrupt_probe", "drop_handoff",
+           "on_handoff_published"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -172,6 +186,8 @@ class FaultPlan:
         flip_wire_bits: str | int | None = None,
         nan_after_tokens: int | None = None,
         probe_fail: int | None = None,
+        handoff_drop: int | None = None,
+        kill_at_handoff: int | None = None,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -234,12 +250,23 @@ class FaultPlan:
             raise ValueError(
                 f"probe_fail must be >= 1, got {probe_fail}")
         self.probe_fail = None if probe_fail is None else int(probe_fail)
+        if handoff_drop is not None and int(handoff_drop) < 1:
+            raise ValueError(
+                f"handoff_drop must be >= 1, got {handoff_drop}")
+        self.handoff_drop = (None if handoff_drop is None
+                             else int(handoff_drop))
+        if kill_at_handoff is not None and int(kill_at_handoff) < 1:
+            raise ValueError(
+                f"kill_at_handoff must be >= 1, got {kill_at_handoff}")
+        self.kill_at_handoff = (None if kill_at_handoff is None
+                                else int(kill_at_handoff))
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self._segments = 0
         self._router_polls = 0
         self._wire_payloads = 0
+        self._handoffs_published = 0
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
@@ -247,7 +274,8 @@ class FaultPlan:
                          "heartbeat_delay": 0, "canary_corrupt": 0,
                          "autoscale_delay": 0, "coord_outage": 0,
                          "router_kill": 0, "wire_flip": 0,
-                         "nan_logits": 0, "probe_corrupt": 0}
+                         "nan_logits": 0, "probe_corrupt": 0,
+                         "handoff_drop": 0, "handoff_kill": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
                            or kill_after_segments is not None
@@ -259,7 +287,9 @@ class FaultPlan:
                            or coord_outage_at_s is not None
                            or self.flip_wire_every is not None
                            or self.nan_after_tokens is not None
-                           or self.probe_fail is not None)
+                           or self.probe_fail is not None
+                           or self.handoff_drop is not None
+                           or self.kill_at_handoff is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -290,6 +320,12 @@ class FaultPlan:
                 else int(_env_float(env, "NAN_AFTER_TOKENS"))),
             probe_fail=(None if _env_float(env, "PROBE_FAIL") is None
                         else int(_env_float(env, "PROBE_FAIL"))),
+            handoff_drop=(
+                None if _env_float(env, "HANDOFF_DROP") is None
+                else int(_env_float(env, "HANDOFF_DROP"))),
+            kill_at_handoff=(
+                None if _env_float(env, "KILL_AT_HANDOFF") is None
+                else int(_env_float(env, "KILL_AT_HANDOFF"))),
             seed=int(_env_float(env, "SEED") or 0),
         )
 
@@ -440,6 +476,37 @@ class FaultPlan:
             self.injected["probe_corrupt"] += 1
         return True
 
+    def drop_handoff(self) -> bool:
+        """True when this KV-migration payload should be lost in flight:
+        the first ``handoff_drop`` publishes are swallowed — the prefill
+        replica's publish "succeeds" but the payload never lands, so the
+        decode side's fetch misses and must re-prefill from the prompt
+        (byte-identical output is the contract being tested)."""
+        if self.handoff_drop is None:
+            return False
+        with self._lock:
+            if self.injected["handoff_drop"] >= self.handoff_drop:
+                return False
+            self.injected["handoff_drop"] += 1
+        return True
+
+    def on_handoff_published(self) -> None:
+        """Count one published KV-migration payload; SIGKILL self at the
+        configured count — after the payload is in the store but BEFORE
+        the handoff done record commits.  The harshest handoff-window
+        death: the router's sweep must redispatch the request (the
+        orphaned payload is garbage-collected) and the retry must
+        produce byte-identical output."""
+        if self.kill_at_handoff is None:
+            return
+        with self._lock:
+            self._handoffs_published += 1
+            n = self._handoffs_published
+            if n >= self.kill_at_handoff:
+                self.injected["handoff_kill"] += 1
+        if n >= self.kill_at_handoff:
+            os.kill(os.getpid(), signal.SIGKILL)
+
     def autoscale_poll(self) -> None:
         """Stall one autoscaler control poll (a wedged control plane —
         the data plane must keep serving, just without scaling)."""
@@ -543,6 +610,17 @@ def poison_logits(tokens_served: int) -> bool:
 def corrupt_probe(rid: str) -> bool:
     p = plan()
     return p.active and p.corrupt_probe(rid)
+
+
+def drop_handoff() -> bool:
+    p = plan()
+    return p.active and p.drop_handoff()
+
+
+def on_handoff_published() -> None:
+    p = plan()
+    if p.active:
+        p.on_handoff_published()
 
 
 def autoscale_poll() -> None:

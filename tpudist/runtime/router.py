@@ -199,7 +199,7 @@ JOURNAL_SCHEMA = "tpudist.journal/1"
 # tpudist.runtime.wire for the crc32c framing and the legacy
 # unframed-JSON fallback every decoder keeps) ------------------------------
 
-def _request_doc(key: str, req) -> dict:
+def _request_doc(key: str, req, handoff_ref: str | None = None) -> dict:
     doc = {
         "key": key,
         "prompt": np.asarray(req.prompt).astype(int).tolist(),
@@ -207,6 +207,12 @@ def _request_doc(key: str, req) -> dict:
         "deadline_s": req.deadline_s,
         "priority": int(getattr(req, "priority", 0)),
     }
+    # disaggregated decode-stage dispatch: the KV-migration payload's
+    # transport ref rides the wire (never the payload itself — the
+    # request stays small); the replica fetches and adopts, or
+    # re-prefills from the prompt above when the fetch misses
+    if handoff_ref is not None:
+        doc["handoff_ref"] = str(handoff_ref)
     # distributed tracing: the trace context rides the wire so the
     # replica's lifecycle events join the router's under one trace id
     # (and SURVIVE a redispatch — the router re-sends the same context)
@@ -222,8 +228,10 @@ def _request_doc(key: str, req) -> dict:
     return doc
 
 
-def _encode_request(key: str, req) -> bytes:
-    return wire.encode_record("request", _request_doc(key, req))
+def _encode_request(key: str, req,
+                    handoff_ref: str | None = None) -> bytes:
+    return wire.encode_record(
+        "request", _request_doc(key, req, handoff_ref=handoff_ref))
 
 
 def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
@@ -239,25 +247,38 @@ def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
                            key=key, replica=replica)
     try:
         phash = d.get("prefix_hash")
+        ref = d.get("handoff_ref")
         return Request(prompt=np.asarray(d["prompt"], np.int32),
                        max_new_tokens=int(d["max_new_tokens"]),
                        rid=d["key"], deadline_s=d.get("deadline_s"),
                        priority=int(d.get("priority", 0)),
                        trace=TraceContext.from_wire(d.get("trace")),
-                       prefix_hash=None if phash is None else int(phash))
+                       prefix_hash=None if phash is None else int(phash),
+                       # a ref-only stub: the worker resolves it into
+                       # the real payload (or None) before admission
+                       kv_handoff=(None if ref is None
+                                   else {"handoff_ref": str(ref)}))
     except (KeyError, ValueError, TypeError):
         raise wire.WireError("schema", kind="request",
                              namespace=namespace, key=key,
                              replica=replica) from None
 
 
-def _encode_completion(replica_id: str, comp) -> bytes:
-    return wire.encode_record("completion", {
+def _encode_completion(replica_id: str, comp,
+                       handoff_ref: str | None = None) -> bytes:
+    doc = {
         "key": comp.rid,
         "tokens": np.asarray(comp.tokens).astype(int).tolist(),
         "reason": comp.reason,
         "replica": replica_id,
-    })
+    }
+    # reason="handoff" commits carry the migration payload's transport
+    # ref, NOT the payload (that crossed separately, before this
+    # commit): the router journals the ref and re-sends it on the
+    # decode-stage dispatch
+    if handoff_ref is not None:
+        doc["handoff_ref"] = str(handoff_ref)
+    return wire.encode_record("completion", doc)
 
 
 # -- the replica side ------------------------------------------------------
@@ -295,10 +316,22 @@ class ReplicaWorker:
                  idle_wait_s: float = 0.01,
                  snapshot_dir: str | os.PathLike | None = None,
                  swap_turn_timeout_s: float = 10.0,
-                 pool: str = "default") -> None:
+                 pool: str = "default",
+                 kv_transport=None) -> None:
+        from tpudist.runtime.disagg import CoordKVTransport
+
         self.loop = loop
         self.client = client
         self.replica_id = replica_id
+        # KV-migration channel for disaggregated handoffs: a prefill
+        # replica publishes finished-slot KV here before committing the
+        # handoff; a decode replica fetches by the dispatched ref.  The
+        # coord store is the baseline; pass an IciKVTransport for the
+        # device-to-device fast path (colocated loops share one
+        # instance).  Unified ("both") replicas never touch it.
+        self.kv_transport = (kv_transport if kv_transport is not None
+                             else CoordKVTransport(client,
+                                                   namespace=namespace))
         self.rank = int(rank)
         self.ns = namespace
         # blue-green pool tag: the router only dispatches to the ACTIVE
@@ -368,6 +401,10 @@ class ReplicaWorker:
             "kv_block_size": self.loop.kv_block_size or None,
             "ttl_s": self.ttl_s,
             "pool": self.pool,
+            # two-stage scheduling: the router sends fresh requests to
+            # role prefill/both and handoff (decode-stage) requests to
+            # role decode/both
+            "role": getattr(self.loop, "role", "both"),
         }
         self.client.set(f"{self.ns}/replica/{self.replica_id}",
                         json.dumps(info).encode())
@@ -547,10 +584,29 @@ class ReplicaWorker:
                     continue
                 if req.trace is not None:
                     self._traces[str(req.rid)] = req.trace
+                req = self._resolve_handoff(req)
                 out.append(req)
         except ConnectionError:
             return []
         return out
+
+    def _resolve_handoff(self, req):
+        """Swap a decode-stage request's ref stub for the real
+        KV-migration payload.  A miss (dropped, corrupt, exporter died
+        pre-publish) resolves to ``None`` — the loop re-prefills from
+        the carried prompt, so this NEVER raises and never loses the
+        request."""
+        stub = getattr(req, "kv_handoff", None)
+        if not (isinstance(stub, dict) and "handoff_ref" in stub
+                and "layers" not in stub):
+            return req
+        payload = self.kv_transport.fetch(stub["handoff_ref"])
+        if payload is None:
+            obs.counter("serve/handoff_fallbacks", unit="reqs").inc()
+            log.warning("replica %s: KV payload %s missing; request %s "
+                        "falls back to re-prefill", self.replica_id,
+                        stub["handoff_ref"], req.rid)
+        return dataclasses.replace(req, kv_handoff=payload)
 
     def _publish_prefix(self) -> None:
         """Advertise the loop's recently admitted prefix hashes at
@@ -591,7 +647,29 @@ class ReplicaWorker:
             tokens = (tokens + 1 if tokens.size
                       else np.asarray([1], np.int32))
             comp = dataclasses.replace(comp, tokens=tokens)
-        payload = _encode_completion(self.replica_id, comp)
+        handoff_ref = None
+        if comp.reason == "handoff" and comp.handoff is not None:
+            # disaggregated handoff, publish-then-commit: the KV payload
+            # crosses the transport FIRST, then the done record (with
+            # the ref) commits.  A death in the window — exactly what
+            # KILL_AT_HANDOFF injects below — leaves no done key, so the
+            # router re-runs the prefill elsewhere: at-least-once
+            # publish under an exactly-once commit, with greedy
+            # determinism collapsing any re-run to identical output.
+            doc = dict(comp.handoff)
+            doc["key"] = str(comp.rid)
+            try:
+                handoff_ref, _ = self.kv_transport.publish(
+                    str(comp.rid), doc)
+            except ConnectionError:
+                # coord brownout mid-publish: commit WITHOUT a ref —
+                # the decode side re-prefills (exact), nothing is lost
+                log.warning("replica %s: KV publish for %s failed; "
+                            "decode side will re-prefill",
+                            self.replica_id, comp.rid)
+            faults.on_handoff_published()
+        payload = _encode_completion(self.replica_id, comp,
+                                     handoff_ref=handoff_ref)
         # injected wire corruption: flip a bit in the ENCODED frame, so
         # the router-side checksum — not any replica-side check — is
         # the thing that has to catch it
@@ -795,6 +873,14 @@ class Router:
                                         unit="keys")
         self._obs_outage_polls = obs.counter("router/outage_polls",
                                              unit="polls")
+        # disaggregated two-stage scheduling: handoff consumptions
+        # (prefill done -> decode dispatch) and the per-stage depth of
+        # the outstanding set — the two pools' load signals
+        self._obs_handoffs = obs.counter("router/handoffs", unit="reqs")
+        self._obs_stage_depth = {
+            stage: obs.gauge(f"router/stage_depth~stage={stage}",
+                             unit="reqs")
+            for stage in ("prefill", "decode")}
         # data-plane integrity: payloads that failed checksum/schema
         # verification at a router decode site, and corrupt-segment
         # verdicts replicas reported in-band.  Both feed the quarantine
@@ -1084,6 +1170,22 @@ class Router:
         doc["attempts"] = int(e["attempts"])
         self._journal_write(k)
 
+    def _journal_handoff(self, k: str, e: dict) -> None:
+        """The stage transition's journal record: stage=decode plus the
+        payload ref, written BEFORE the prefill done key is destroyed —
+        a router crash in between recovers into a decode-stage entry
+        and redispatches it exactly once (to the decode pool, payload
+        ref intact; a lost payload degrades to re-prefill, never to a
+        lost or doubled request)."""
+        doc = self._journal_docs.get(k)
+        if doc is None:
+            return
+        doc["stage"] = "decode"
+        doc["handoff_ref"] = e.get("handoff_ref")
+        doc["assigned"] = None
+        doc["attempts"] = int(e["attempts"])
+        self._journal_write(k)
+
     def _journal_terminal(self, k: str, reason: str, tokens,
                           serve_reason: str | None = None) -> None:
         doc = self._journal_docs.get(k)
@@ -1239,6 +1341,12 @@ class Router:
             entries[k] = {"req": req,
                           "assigned": doc.get("assigned"),
                           "attempts": int(doc.get("attempts", 0)),
+                          # a journaled handoff recovers mid-pipeline:
+                          # stage=decode + the payload ref, so the
+                          # replacement router dispatches straight to
+                          # the decode pool (ref missing -> re-prefill)
+                          "stage": doc.get("stage", "prefill"),
+                          "handoff_ref": doc.get("handoff_ref"),
                           "trace": tc, "at": 0.0, "arrived": True}
             obs.events.record("recover_adopt", trace=tc.trace_id,
                               key=k, rid=rid,
@@ -1279,6 +1387,16 @@ class Router:
             finish.append(key)
             remaining.discard(key)
             self._obs_completions.inc()
+            # payload lifecycle belongs to the ROUTER (the request's
+            # owner): the KV-migration payload dies with the request's
+            # terminal, whatever the terminal was — an exporter death
+            # cannot leak it
+            ref = (entries.get(key) or {}).get("handoff_ref")
+            if ref:
+                try:
+                    self.client.delete(ref)
+                except ConnectionError:
+                    pass
             if on_complete is not None:
                 on_complete(key, comp)
 
@@ -1497,6 +1615,25 @@ class Router:
                 # not get to deliver.  Redispatch to a trusted one
                 # (greedy determinism dedupes any duplicate).
                 reroute(key, k, e, replica, "quarantined")
+            elif comp.reason == "handoff":
+                # two-stage scheduling: a prefill replica finished its
+                # half and migrated the KV.  NOT a terminal — flip the
+                # entry to the decode stage and let dispatch place it
+                # on the decode pool.  Journal-then-delete ordering
+                # mirrors the terminal path: a crash in between
+                # recovers a decode-stage record (payload ref intact)
+                # and redispatches exactly once.
+                e["stage"] = "decode"
+                e["handoff_ref"] = payload.get("handoff_ref")
+                e["assigned"] = None
+                self._journal_handoff(k, e)
+                self.client.delete(key)
+                self._obs_handoffs.inc()
+                trace = e.get("trace")
+                if trace is not None:
+                    obs.events.record("handoff", trace=trace.trace_id,
+                                      from_replica=replica,
+                                      ref=e["handoff_ref"])
             else:
                 # commit-point ordering: journal the terminal (WITH the
                 # tokens) before destroying the done key, so a crash in
@@ -1634,6 +1771,23 @@ class Router:
         degraded = any(loads.get(rid, {}).get("degraded")
                        for rid in candidates)
         self._obs_degraded.set(1.0 if degraded else 0.0)
+        # two-stage scheduling: fresh (prefill-stage) requests go to
+        # prefill/both replicas, handoff (decode-stage) requests to
+        # decode/both — a homogeneous "both" fleet degenerates to one
+        # shared pool and nothing below changes
+        stage_cands = {
+            "prefill": [rid for rid in candidates
+                        if regs.get(rid, {}).get("role", "both")
+                        in ("prefill", "both")],
+            "decode": [rid for rid in candidates
+                       if regs.get(rid, {}).get("role", "both")
+                       in ("decode", "both")]}
+        depth = {"prefill": 0, "decode": 0}
+        for k2, e2 in entries.items():
+            if k2 not in done and e2.get("arrived", True):
+                depth[e2.get("stage", "prefill")] += 1
+        for stage, g in self._obs_stage_depth.items():
+            g.set(depth[stage])
         if candidates:
             assigned_counts: dict[str, int] = {}
             for e in entries.values():
@@ -1665,11 +1819,13 @@ class Router:
                     progressed = True
                     continue
                 if (req.deadline_s is not None and e["attempts"] == 0
+                        and e.get("stage", "prefill") == "prefill"
                         and wall + best_wait > req.deadline_s):
                     # SLO admission: shed BEFORE any replica pays a
                     # prefill.  Only ever on first dispatch — a request
-                    # already prefilled once (redispatch) is sunk cost
-                    # and races the deadline instead.
+                    # already prefilled once (redispatch, or a
+                    # decode-stage handoff) is sunk cost and races the
+                    # deadline instead.
                     self._obs_slo_shed.inc()
                     self._journal_terminal(k, "shed", ())
                     complete(k, Completion(
@@ -1678,12 +1834,19 @@ class Router:
                     self._decide("shed", e, predicted_wait_s=best_wait)
                     progressed = True
                     continue
+                stage = e.get("stage", "prefill")
                 rid = self._pick(
-                    candidates, loads, assigned_counts,
-                    prefix_hash=getattr(req, "prefix_hash", None),
+                    stage_cands[stage], loads, assigned_counts,
+                    # prefix affinity only steers PREFILL placement:
+                    # a decode-stage admission adopts migrated private
+                    # pages and never reads the prefix cache
+                    prefix_hash=(getattr(req, "prefix_hash", None)
+                                 if stage == "prefill" else None),
                     prefix_map=prefix_map)
                 if rid is None:
-                    break
+                    # this stage's pool is empty right now; the OTHER
+                    # stage may still have capacity — keep scanning
+                    continue
                 trace = e.get("trace")
                 send = req if trace is None else dataclasses.replace(
                     req, trace=trace)
@@ -1702,8 +1865,10 @@ class Router:
                             "degrade_clamp", trace=trace.trace_id,
                             stage="router",
                             max_new=self.degrade_max_new)
-                self.client.set(f"{self.ns}/inbox/{rid}/{k}",
-                                _encode_request(k, send))
+                self.client.set(
+                    f"{self.ns}/inbox/{rid}/{k}",
+                    _encode_request(k, send,
+                                    handoff_ref=e.get("handoff_ref")))
                 e["assigned"] = rid
                 # inbox FIRST, then journal: a crash in between leaves
                 # the record open-unassigned -> recovery redispatches
@@ -2328,6 +2493,14 @@ def main() -> None:  # pragma: no cover - subprocess entry point
     ap.add_argument("--pool", default="default",
                     help="blue-green pool tag; the router only "
                          "dispatches to the active pool")
+    ap.add_argument("--role", default="both",
+                    choices=["both", "prefill", "decode"],
+                    help="disaggregated serving role: 'prefill' runs "
+                         "chunked prefill to completion and hands the "
+                         "KV off; 'decode' adopts migrated KV and "
+                         "decodes; 'both' (default) is a unified "
+                         "replica (requires --cache-layout paged for "
+                         "prefill/decode)")
     ap.add_argument("--snapshot-dir", default="",
                     help="fleet weight snapshot dir (Checkpointer, "
                          "layout=steps): restored at startup (joiners "
@@ -2366,7 +2539,8 @@ def main() -> None:  # pragma: no cover - subprocess entry point
         max_queue=None if args.max_queue < 0 else args.max_queue,
         degrade_queue=None if args.degrade_queue < 0
         else args.degrade_queue,
-        degrade_max_new=args.degrade_max_new)
+        degrade_max_new=args.degrade_max_new,
+        role=args.role)
     host, port = args.coord.rsplit(":", 1)
     client = CoordClient(host, int(port))
     worker = ReplicaWorker(loop, client, args.replica_id,

@@ -54,6 +54,9 @@ WIRE_KINDS = {
     "journal": 3,      # router crash-recovery lifecycle record
     "heartbeat": 4,    # liveness/exit report payloads
     "prefix": 5,       # replica -> router prefix-cache affinity summary
+    "kv_migration": 6,  # prefill replica -> decode replica KV handoff
+    #                     (ordered pages + lengths + prefix-hash chain;
+    #                     see tpudist.runtime.disagg)
 }
 _TAG_TO_KIND = {tag: kind for kind, tag in WIRE_KINDS.items()}
 

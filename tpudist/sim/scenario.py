@@ -191,6 +191,17 @@ _FLEET_DEFAULTS: dict[str, Any] = {
     "wait_window_s": 15.0,
     "router_poll_s": 0.05,
     "autoscale": None,          # dict of AutoscaleConfig overrides
+    # disaggregated fleet: when prefill_replicas > 0 the fleet splits
+    # into a prefill pool and a decode pool (``replicas`` is ignored)
+    # and each pool can run its own autoscaler policy — the two-signal
+    # split the live Autoscaler(pool=...) instances implement
+    "prefill_replicas": 0,
+    "decode_replicas": 0,
+    "autoscale_prefill": None,
+    "autoscale_decode": None,
+    # per-replica KV capacity the sim's decode occupancy model publishes
+    # through serve/kv_blocks_{used,free} (0 disables the gauges)
+    "kv_blocks_total": 0,
 }
 
 
@@ -225,6 +236,13 @@ class Envelope:
     # reports one (a workload with no stamped hashes is exempt, not
     # failing at 0.0)
     min_prefix_hit_rate: float | None = None
+    # disaggregated-serving gates (ISSUE 15): the TTFT ceiling the
+    # prefill pool must hold under the mixed-length workload, and the
+    # per-pool scale-up floors that prove the two control loops sized
+    # their pools INDEPENDENTLY (one shared loop would show one signal)
+    max_p99_ttft_s: float | None = None
+    min_scale_ups_prefill: int = 0
+    min_scale_ups_decode: int = 0
     decisions: dict = field(default_factory=dict)
 
     @classmethod
@@ -301,6 +319,16 @@ class Envelope:
             if phr < self.min_prefix_hit_rate:
                 bad.append(f"prefix_hit_rate={phr:.4g} < min "
                            f"{self.min_prefix_hit_rate}")
+        if self.max_p99_ttft_s is not None:
+            ttft = num("p99_ttft_s")
+            if ttft > self.max_p99_ttft_s:
+                bad.append(f"p99_ttft_s={ttft:.4g} > "
+                           f"{self.max_p99_ttft_s}")
+        for pool in ("prefill", "decode"):
+            floor = getattr(self, f"min_scale_ups_{pool}")
+            v = num(f"scale_ups_{pool}")
+            if v < floor:
+                bad.append(f"scale_ups_{pool}={v:g} < min {floor}")
         for reason, bound in self.decisions.items():
             v = num(f"decisions_{reason}")
             lo, hi = bound.get("min"), bound.get("max")
@@ -358,7 +386,17 @@ class ScenarioSpec:
                  "at most one kill_router fault per scenario")
         _check_keys("fleet", self.fleet, set(_FLEET_DEFAULTS))
         merged = {**_FLEET_DEFAULTS, **self.fleet}
-        _require(int(merged["replicas"]) >= 1, "fleet.replicas must be >= 1")
+        if int(merged["prefill_replicas"]) > 0:
+            _require(int(merged["decode_replicas"]) >= 1,
+                     "a disaggregated fleet needs decode_replicas >= 1")
+        else:
+            _require(int(merged["decode_replicas"]) == 0
+                     and merged["autoscale_prefill"] is None
+                     and merged["autoscale_decode"] is None,
+                     "decode_replicas / per-pool autoscale need "
+                     "prefill_replicas >= 1")
+            _require(int(merged["replicas"]) >= 1,
+                     "fleet.replicas must be >= 1")
         _require(float(merged["seconds_per_token"]) > 0,
                  "fleet.seconds_per_token must be > 0")
         # frozen dataclass: route the normalized fleet through __setattr__
@@ -579,6 +617,41 @@ BUILTIN: dict[str, dict] = {
             "min_reinstated": 1,
             "max_corrupted_terminals": 0,
             "max_replica_deaths": 0,
+            "decisions": {"failed": {"max": 0}},
+        },
+    },
+    "disagg_mixed_prompts": {
+        "name": "disagg_mixed_prompts",
+        "duration_s": 40.0,
+        "arrival": {"kind": "constant", "rate": 20.0},
+        # the disaggregation workload: mostly short prompts with a long
+        # tail — on a unified fleet the tail's prefill stalls every
+        # decoding lane behind it; split pools keep TTFT bounded
+        "prompt": {"kind": "longtail", "lo": 4, "typical": 16,
+                   "tail": 512, "tail_frac": 0.08},
+        "max_new": {"kind": "uniform", "lo": 16, "hi": 48},
+        "seed": 21,
+        # both pools start at 1 and BOTH are undersized, but for
+        # different resources: the prefill pool drowns in queue wait
+        # (compute), the decode pool drowns in resident KV (memory).
+        # Each pool's autoscaler watches only its own signal — the
+        # decode loop's queue-wait target is parked out of reach so a
+        # scale-up there can only come from the kv-pressure signal
+        "fleet": {"prefill_replicas": 1, "decode_replicas": 1,
+                  "prefill_per_token_s": 0.002,
+                  "kv_blocks_total": 64,
+                  "autoscale_prefill": {
+                      **_AUTOSCALE_FAST, "max_replicas": 3,
+                      "idle_polls": 200},
+                  "autoscale_decode": {
+                      **_AUTOSCALE_FAST, "max_replicas": 3,
+                      "idle_polls": 200, "target_wait_s": 30.0,
+                      "low_wait_s": 0.1, "min_kv_free_frac": 0.3}},
+        "envelope": {
+            "max_lost": 0,
+            "max_p99_ttft_s": 6.0,
+            "min_scale_ups_prefill": 1,
+            "min_scale_ups_decode": 1,
             "decisions": {"failed": {"max": 0}},
         },
     },

@@ -97,27 +97,41 @@ class SimReplica:
 
     def __init__(self, fabric: SimFabric, clock: VirtualClock, *,
                  rank: int, namespace: str,
+                 role: str = "both",
                  seconds_per_token: float = 0.002,
                  prefill_s: float = 0.005,
                  prefill_per_token_s: float = 0.0002,
                  warmup_s: float = 0.0,
                  publish_interval_s: float = 0.25,
-                 wait_window_s: float = 15.0) -> None:
+                 wait_window_s: float = 15.0,
+                 kv_blocks_total: int = 0) -> None:
         self.fabric = fabric
         self.clock = clock
         self.rank = int(rank)
         self.replica_index = int(rank)   # the spawner/joiner contract
         self.rid = f"r{rank}"
         self.ns = namespace
+        # the disaggregated stage split (ISSUE 15): a "prefill" replica
+        # serves only the prompt pass and commits reason="handoff"; a
+        # "decode" replica serves a handed-off request at decode cost
+        # only (the pages arrived with it); "both" is the unified shape
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both/prefill/decode, "
+                             f"got {role!r}")
+        self.role = role
         self.spt = float(seconds_per_token)
         self.prefill_s = float(prefill_s)
         self.prefill_per_token_s = float(prefill_per_token_s)
         self.publish_interval_s = float(publish_interval_s)
         self.wait_window_s = float(wait_window_s)
+        # synthetic KV occupancy (decode-pool autoscale signal): each
+        # resident request pins ceil((prompt + budget) / 16) of these
+        self.kv_blocks_total = int(kv_blocks_total)
         self.alive = True
         self.killed = False
         self.served = 0
         self.all_waits: list[float] = []          # every queue wait (sim s)
+        self.all_ttfts: list[float] = []          # first-token latencies
         self._live = False
         self._live_at = clock.monotonic() + max(0.0, float(warmup_s))
         self._stopping = False
@@ -150,7 +164,8 @@ class SimReplica:
         import json
         fabric.set(f"{namespace}/replica/{self.rid}",
                    json.dumps({"replica_id": self.rid,
-                               "rank": self.rank}).encode())
+                               "rank": self.rank,
+                               "role": self.role}).encode())
 
     # -- the spawner/process contract --------------------------------------
 
@@ -189,10 +204,28 @@ class SimReplica:
 
     # -- service model -----------------------------------------------------
 
-    def _service_s(self, req) -> float:
+    def _prefill_s_of(self, req) -> float:
         prompt = int(np.asarray(req.prompt).size)
-        return (self.prefill_s + prompt * self.prefill_per_token_s
+        return self.prefill_s + prompt * self.prefill_per_token_s
+
+    def _service_s(self, req) -> float:
+        if self.role == "prefill":
+            return self._prefill_s_of(req)
+        if getattr(req, "kv_handoff", None) is not None:
+            # adopted pages: the prompt pass already ran upstream
+            return int(req.max_new_tokens) * self.spt
+        return (self._prefill_s_of(req)
                 + int(req.max_new_tokens) * self.spt)
+
+    def _kv_blocks_of(self, req) -> int:
+        prompt = int(np.asarray(req.prompt).size)
+        return -(-(prompt + int(req.max_new_tokens)) // 16)
+
+    def _kv_used(self) -> int:
+        resident = [r for r, _ in self._queue]
+        if self._cur is not None:
+            resident.append(self._cur[0])
+        return sum(self._kv_blocks_of(r) for r in resident)
 
     def _flush_done_buffer(self) -> None:
         while self._done_buf:
@@ -203,13 +236,14 @@ class SimReplica:
                 return
             self._done_buf.pop(0)
 
-    def _commit(self, req, reason: str, tokens: list[int]) -> None:
+    def _commit(self, req, reason: str, tokens: list[int],
+                extra: dict | None = None) -> None:
         # framed like a real worker's commit, so the router's checksum
         # verification (and the corrupt_replica chaos below) exercises
         # the same decode path as production
         payload = wire.encode_record("completion", {
             "key": str(req.rid), "tokens": tokens,
-            "reason": reason, "replica": self.rid})
+            "reason": reason, "replica": self.rid, **(extra or {})})
         self._commits += 1
         if (self._corrupt_every is not None
                 and self._commits % self._corrupt_every == 0
@@ -251,6 +285,11 @@ class SimReplica:
             "counters": {},
             "histograms": {},
         }
+        if self.kv_blocks_total > 0:
+            used = min(self._kv_used(), self.kv_blocks_total)
+            snap["gauges"]["serve/kv_blocks_used"] = {"value": float(used)}
+            snap["gauges"]["serve/kv_blocks_free"] = {
+                "value": float(self.kv_blocks_total - used)}
         if self._waits:
             snap["histograms"]["serve/queue_wait_s"] = values_to_hist(
                 [w for _, w in self._waits], unit="s")
@@ -321,11 +360,25 @@ class SimReplica:
         # per step when service times are shorter than the quantum
         while True:
             if self._cur is not None:
-                req, finish_at = self._cur
+                req, enq_t, start, finish_at = self._cur
                 if now < finish_at:
                     break
-                self._commit(req, "length",
-                             list(range(int(req.max_new_tokens))))
+                if self.role == "prefill":
+                    # stage done: first token exists, KV migrated.  The
+                    # ref is synthetic (the sim carries no pages) — the
+                    # decode side's adopted-cost model keys off the stub
+                    self.all_ttfts.append(finish_at - enq_t)
+                    self._commit(req, "handoff", [],
+                                 extra={"handoff_ref":
+                                        f"sim://{req.rid}"})
+                else:
+                    if getattr(req, "kv_handoff", None) is None:
+                        # unified service: the first token landed when
+                        # this replica's own prompt pass finished
+                        self.all_ttfts.append(
+                            start + self._prefill_s_of(req) - enq_t)
+                    self._commit(req, "length",
+                                 list(range(int(req.max_new_tokens))))
                 self._cur = None
             if not self._queue:
                 break
@@ -353,7 +406,7 @@ class SimReplica:
                                   replica=self.rid,
                                   queue_wait_s=round(wait, 6),
                                   prefix_hit=hit)
-            self._cur = (req, now + self._service_s(req))
+            self._cur = (req, enq_t, now, now + self._service_s(req))
 
         if now >= self._next_pub:
             self._publish()
@@ -407,17 +460,35 @@ class FleetSim:
             else:
                 self._fault_due.append(dict(f))
         self._fault_due.sort(key=lambda f: f["at_s"])
-        for _ in range(int(fleet["replicas"])):
-            self._spawn_one(warmup_s=0.0)
+        if int(fleet.get("prefill_replicas") or 0) > 0:
+            # disaggregated fleet: two pools instead of a unified one
+            for _ in range(int(fleet["prefill_replicas"])):
+                self._spawn_one(warmup_s=0.0, role="prefill")
+            for _ in range(int(fleet["decode_replicas"])):
+                self._spawn_one(warmup_s=0.0, role="decode")
+        else:
+            for _ in range(int(fleet["replicas"])):
+                self._spawn_one(warmup_s=0.0)
         self.router = self._make_router()
         self.scaler: Autoscaler | None = None
-        self._next_scaler_poll = None
+        self.scalers: list[Autoscaler] = []
         if fleet.get("autoscale"):
             self.scaler = Autoscaler(
                 self.fabric, namespace=self.ns,
                 config=AutoscaleConfig(**fleet["autoscale"]),
                 spawner=self._spawn_n, clock=self.vc.monotonic)
-            self._next_scaler_poll = self.scaler.cfg.poll_s
+            self.scalers.append(self.scaler)
+        for pool in ("prefill", "decode"):
+            # one control loop per pool, each watching only its own
+            # replicas' metrics — the live two-Autoscaler deployment
+            if fleet.get(f"autoscale_{pool}"):
+                self.scalers.append(Autoscaler(
+                    self.fabric, namespace=self.ns,
+                    config=AutoscaleConfig(**fleet[f"autoscale_{pool}"]),
+                    spawner=(lambda n, p=pool: [
+                        self._spawn_one(role=p) for _ in range(n)]),
+                    pool=pool, clock=self.vc.monotonic))
+        self._scaler_next = [s.cfg.poll_s for s in self.scalers]
 
     @classmethod
     def from_trace(cls, doc: dict, *, name: str = "trace_replay",
@@ -469,19 +540,22 @@ class FleetSim:
             rid, self.rates.get("*",
                                 self.spec.fleet["seconds_per_token"])))
 
-    def _spawn_one(self, warmup_s: float | None = None) -> SimReplica:
+    def _spawn_one(self, warmup_s: float | None = None,
+                   role: str = "both") -> SimReplica:
         fleet = self.spec.fleet
         rank = self._next_rank
         self._next_rank += 1
         r = SimReplica(
             self.fabric, self.vc, rank=rank, namespace=self.ns,
+            role=role,
             seconds_per_token=self._rate_for(f"r{rank}"),
             prefill_s=float(fleet["prefill_s"]),
             prefill_per_token_s=float(fleet["prefill_per_token_s"]),
             warmup_s=(float(fleet["warmup_s"]) if warmup_s is None
                       else warmup_s),
             publish_interval_s=float(fleet["publish_interval_s"]),
-            wait_window_s=float(fleet["wait_window_s"]))
+            wait_window_s=float(fleet["wait_window_s"]),
+            kv_blocks_total=int(fleet.get("kv_blocks_total") or 0))
         if warmup_s == 0.0:
             r.step()   # live (and publishing) before the first poll
         self.replicas.append(r)
@@ -509,10 +583,10 @@ class FleetSim:
                 self._fire_fault(self._fault_due.pop(0))
             for r in self.replicas:
                 r.step()
-            if (self._next_scaler_poll is not None
-                    and self.vc.monotonic() >= self._next_scaler_poll):
-                self.scaler.poll()
-                self._next_scaler_poll += self.scaler.cfg.poll_s
+            for i, s in enumerate(self.scalers):
+                if self.vc.monotonic() >= self._scaler_next[i]:
+                    s.poll()
+                    self._scaler_next[i] += s.cfg.poll_s
 
     def _fire_fault(self, ev: dict) -> None:
         target = next((r for r in self.replicas
@@ -594,24 +668,29 @@ class FleetSim:
         for c in comps:
             reasons[c.reason] = reasons.get(c.reason, 0) + 1
         waits = [w for r in self.replicas for w in r.all_waits]
+        ttfts = [t for r in self.replicas for t in r.all_ttfts]
         now = _counters_now(self.ns)
         delta = {k: now.get(k, 0.0) - base.get(k, 0.0) for k in now}
 
         ups = drains = 0
+        ups_by_pool = {"prefill": 0, "decode": 0}
         recovery_s = 0.0
-        if self.scaler is not None:
-            for rec in self.scaler.decision_log:
+        for scaler in self.scalers:
+            for rec in scaler.decision_log:
                 if rec["action"] is not None:
                     if rec["action"][0] == "up":
                         ups += 1
+                        if scaler.pool in ups_by_pool:
+                            ups_by_pool[scaler.pool] += 1
                     else:
                         drains += 1
-            breach_ts = [rec["t"] for rec in self.scaler.decision_log
+            breach_ts = [rec["t"] for rec in scaler.decision_log
                          if not rec.get("suppressed")
-                         and rec["wait_q"] > self.scaler.cfg.target_wait_s]
+                         and rec["wait_q"] > scaler.cfg.target_wait_s]
             if breach_ts:
-                recovery_s = (max(breach_ts) - min(breach_ts)
-                              + self.scaler.cfg.poll_s)
+                recovery_s = max(recovery_s,
+                                 max(breach_ts) - min(breach_ts)
+                                 + scaler.cfg.poll_s)
 
         row = {
             "scenario": spec.name,
@@ -622,8 +701,17 @@ class FleetSim:
             "p99_queue_wait_s": (
                 round(float(np.percentile(waits, 99)), 6)
                 if waits else 0.0),
+            # first-token latency (ISSUE 15): replica-local wait +
+            # prompt-pass time, sampled on whichever replica produced
+            # the first token — a prefill replica at handoff, a unified
+            # replica at its own prompt-pass finish
+            "p99_ttft_s": (
+                round(float(np.percentile(ttfts, 99)), 6)
+                if ttfts else 0.0),
             "recovery_s": round(recovery_s, 3),
             "scale_ups": ups,
+            "scale_ups_prefill": ups_by_pool["prefill"],
+            "scale_ups_decode": ups_by_pool["decode"],
             "drains": drains,
             "priority_bad": delta.get("slo/bad~class=priority", 0.0),
             "final_replicas": sum(1 for r in self.replicas if r.alive),
