@@ -3383,6 +3383,237 @@ def bench_serve_disagg(on_tpu: bool) -> None:
     server.stop()
 
 
+def bench_kv_tier(on_tpu: bool) -> None:
+    """Tiered KV memory (ISSUE 16), two rows:
+
+    * ``kv_tier_capacity`` — a tenant-interleaved shared-prefix trace
+      whose prefix working set overflows the pool's idle capacity, run
+      with the host tier OFF vs ON (``TPUDIST_KV_HOST_TIER_BYTES``).
+      The metric is the effective-cache-capacity ratio: reusable cached
+      prefix tokens per HBM KV byte with the tier, over without — the
+      tier's whole claim is that host RAM multiplies what one
+      accelerator's HBM can keep hot.  Also: global (HBM + tier) vs
+      local-only hit rates, tier spill/re-admit traffic, wall speedup,
+      ``exact_match`` (greedy output must be byte-identical on every
+      path), ``lost_requests`` and ``pool_drained``/``tier_drained``.
+    * ``kv_tier_pull_ttft`` — pull-mode peer adoption: a cold replica
+      installs an owner's exported prefix run (``export_prefix`` ->
+      ``install_prefix``) and serves the suffix, vs re-prefilling the
+      whole prompt from scratch.  TTFT speedup, with exactness.
+    """
+    import os
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist import obs as _obs
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.models.kv_pages import chain_hashes
+    from tpudist.models.serving import Request, ServeLoop
+
+    cfg = TransformerConfig(
+        vocab_size=32000 if on_tpu else 128,
+        num_layers=8 if on_tpu else 2,
+        num_heads=8, num_kv_heads=2,
+        embed_dim=512 if on_tpu else 64,
+        max_seq_len=2048 if on_tpu else 256,
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    bs = 32 if on_tpu else 16
+    chunk = 256 if on_tpu else 16
+    attn = "flash" if on_tpu else "dense"
+    num_blocks = 64 if on_tpu else 28
+    rng = np.random.default_rng(_bench_seed())
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+
+    def make_loop(tier_bytes: int, **kw):
+        saved = os.environ.get("TPUDIST_KV_HOST_TIER_BYTES")
+        os.environ["TPUDIST_KV_HOST_TIER_BYTES"] = str(int(tier_bytes))
+        try:
+            return ServeLoop(
+                cfg, params, num_slots=2, steps_per_sync=4,
+                prefill_chunk=chunk, pipeline_depth=2,
+                decode_attention=attn, cache_layout="paged",
+                kv_block_size=bs, kv_num_blocks=num_blocks,
+                auto_unstack=False, chunked_prefill=True,
+                prefix_sharing=True, **kw)
+        finally:
+            if saved is None:
+                os.environ.pop("TPUDIST_KV_HOST_TIER_BYTES", None)
+            else:
+                os.environ["TPUDIST_KV_HOST_TIER_BYTES"] = saved
+
+    # ---- row 1: tenant working set > HBM idle capacity ---------------
+    # 8 tenants x 6 prefix blocks = 48 blocks of shared prefix against
+    # a pool whose idle (cacheable) capacity is ~half that: round-robin
+    # tenant traffic evicts every tenant's chain between its own uses.
+    # Without the tier each eviction means a full re-prefill next round;
+    # with it the chain re-admits from host RAM
+    tenants = 8
+    pre_n = (6 * bs) if not on_tpu else (12 * bs)
+    gen = 8 if not on_tpu else 32
+    prefixes = [rng.integers(0, cfg.vocab_size, (pre_n,)).astype(np.int32)
+                for _ in range(tenants)]
+    reqs = []
+    for rnd in range(3):
+        for t in range(tenants):
+            reqs.append(Request(np.concatenate(
+                [prefixes[t],
+                 rng.integers(0, cfg.vocab_size,
+                              (4 + (rnd + t) % 5,)).astype(np.int32)]),
+                gen, rid=f"r{rnd}t{t}"))
+
+    def counter(name):
+        return (_obs.snapshot()["counters"]
+                .get(name, {}).get("value") or 0)
+
+    def arm(tier_bytes: int):
+        loop = make_loop(tier_bytes)
+        loop.run(list(reqs))             # warm every executable/shape
+        loop.flush_prefix_cache()        # timed run starts cold
+        for k in loop.prefix_stats:
+            loop.prefix_stats[k] = 0
+        before = {n: counter(n) for n in
+                  ("serve/tier_spills", "serve/tier_readmits",
+                   "serve/tier_hits", "serve/tier_evictions")}
+        t0 = _t.perf_counter()
+        comps = loop.run(list(reqs))
+        wall = _t.perf_counter() - t0
+        sig = {c.rid: (tuple(c.tokens.tolist()), c.reason)
+               for c in comps}
+        tierc = {n.removeprefix("serve/"): int(counter(n) - before[n])
+                 for n in before}
+        # steady-state reusable capacity, measured BEFORE the drain
+        # flush: HBM prefix blocks + tier blocks, and the HBM KV bytes
+        # they lean on (per-block bytes from the tier's own accounting
+        # when available, else computed from the layout)
+        hbm_blocks = len(loop._prefix_cache._entries)
+        tier_blocks = len(loop._tier) if loop._tier is not None else 0
+        if loop._tier is not None and len(loop._tier):
+            per_block = loop._tier.nbytes / len(loop._tier)
+        else:
+            dt = np.dtype(np.float32 if not on_tpu else np.float16)
+            per_block = (cfg.num_layers * 2 * bs * cfg.num_kv_heads
+                         * (cfg.embed_dim // cfg.num_heads)
+                         * dt.itemsize)
+        hbm_bytes = num_blocks * per_block
+        tokens_per_hbm_byte = ((hbm_blocks + tier_blocks) * bs
+                               / max(hbm_bytes, 1e-9))
+        stats = dict(loop.prefix_stats)
+        loop.flush_prefix_cache()
+        drained = (loop.pool.used_blocks == 0
+                   and loop.tier_drained() in (None, True))
+        loop.pool.check()
+        return {"sig": sig, "wall": wall, "stats": stats,
+                "tier": tierc, "hbm_blocks": hbm_blocks,
+                "tier_blocks": tier_blocks,
+                "tokens_per_hbm_byte": tokens_per_hbm_byte,
+                "lost": len(reqs) - len(sig), "drained": drained}
+
+    nt = arm(0)                          # no-tier baseline
+    ti = arm(64 << 20)                   # tiered arm
+    ratio = (ti["tokens_per_hbm_byte"]
+             / max(nt["tokens_per_hbm_byte"], 1e-12))
+    _emit("kv_tier_capacity", round(ratio, 2), "x", None,
+          requests=len(reqs), tenants=tenants, prefix_tokens=pre_n,
+          kv_blocks=num_blocks, block_size=bs,
+          tokens_per_hbm_byte=round(ti["tokens_per_hbm_byte"], 8),
+          ref_tokens_per_hbm_byte=round(nt["tokens_per_hbm_byte"], 8),
+          hbm_cached_blocks=ti["hbm_blocks"],
+          tier_cached_blocks=ti["tier_blocks"],
+          global_hit_rate=round(
+              ti["stats"]["hits"] / max(ti["stats"]["requests"], 1), 4),
+          local_hit_rate=round(
+              nt["stats"]["hits"] / max(nt["stats"]["requests"], 1), 4),
+          tier_hit_rate=round(
+              ti["tier"]["tier_hits"]
+              / max(ti["stats"]["requests"], 1), 4),
+          hit_tokens_frac=round(
+              ti["stats"]["hit_tokens"]
+              / max(ti["stats"]["prompt_tokens"], 1), 4),
+          ref_hit_tokens_frac=round(
+              nt["stats"]["hit_tokens"]
+              / max(nt["stats"]["prompt_tokens"], 1), 4),
+          tier_spills=ti["tier"]["tier_spills"],
+          tier_readmits=ti["tier"]["tier_readmits"],
+          tier_evictions=ti["tier"]["tier_evictions"],
+          wall_s=round(ti["wall"], 3),
+          ref_wall_s=round(nt["wall"], 3),
+          speedup=round(nt["wall"] / max(ti["wall"], 1e-9), 2),
+          lost_requests=ti["lost"] + nt["lost"],
+          exact_match=bool(ti["sig"] == nt["sig"]),
+          pool_drained=bool(ti["drained"] and nt["drained"]),
+          tier_drained=bool(ti["drained"]))
+
+    # ---- row 2: pull-mode adoption vs re-prefill ---------------------
+    # an owner loop holds one tenant's chain (HBM + tier); a cold peer
+    # either adopts the exported pages and prefills only the suffix, or
+    # re-prefills the whole prompt — the router's pull-vs-fallback
+    # choice, measured end to end in-process
+    owner = make_loop(64 << 20)
+    pull_pre = rng.integers(0, cfg.vocab_size,
+                            ((12 * bs) if not on_tpu
+                             else (32 * bs),)).astype(np.int32)
+    seed_req = Request(np.concatenate(
+        [pull_pre, rng.integers(0, cfg.vocab_size,
+                                (5,)).astype(np.int32)]),
+        gen, rid="seed")
+    owner.run([seed_req])                # chain now resident on owner
+    probe = Request(np.concatenate(
+        [pull_pre, rng.integers(0, cfg.vocab_size,
+                                (7,)).astype(np.int32)]),
+        gen, rid="probe")
+    chain = chain_hashes(
+        [int(t) for t in probe.prompt.tolist()], bs)
+
+    def cold_peer():
+        peer = make_loop(0)
+        warm = Request(np.asarray(probe.prompt).copy(), gen, rid="warm")
+        peer.run([warm])                 # compile outside the timing
+        peer.flush_prefix_cache()
+        return peer
+
+    peer_a = cold_peer()                 # adopts the owner's pages
+
+    def pull_once():
+        """export -> install -> serve, flushed after: run twice and
+        time the second so the install scatter's compile and the
+        adopted-prefix admission shapes stay out of the measurement."""
+        t0 = _t.perf_counter()
+        payload = owner.export_prefix(chain)
+        n = (peer_a.install_prefix(probe.prompt, payload)
+             if payload is not None else 0)
+        comps = peer_a.run([Request(np.asarray(probe.prompt).copy(),
+                                    gen, rid="probe")])
+        w = _t.perf_counter() - t0
+        peer_a.flush_prefix_cache()
+        return n, comps, w
+
+    pull_once()                          # warm the whole adoption path
+    installed, pull_comps, pull_wall = pull_once()
+    peer_b = cold_peer()                 # re-prefills from scratch
+    t0 = _t.perf_counter()
+    ref_comps = peer_b.run([Request(np.asarray(probe.prompt).copy(),
+                                    gen, rid="probe")])
+    ref_wall = _t.perf_counter() - t0
+    pull_sig = [tuple(c.tokens.tolist()) for c in pull_comps]
+    ref_sig = [tuple(c.tokens.tolist()) for c in ref_comps]
+    for lp in (owner, peer_a, peer_b):
+        lp.flush_prefix_cache()
+    _emit("kv_tier_pull_ttft",
+          round(ref_wall / max(pull_wall, 1e-9), 2), "x", None,
+          prefix_tokens=int(pull_pre.size), block_size=bs,
+          installed_blocks=int(installed),
+          pull_ttft_s=round(pull_wall, 4),
+          reprefill_ttft_s=round(ref_wall, 4),
+          exact_match=bool(pull_sig == ref_sig and installed > 0),
+          pool_drained=bool(all(lp.pool.used_blocks == 0
+                                for lp in (owner, peer_a, peer_b))),
+          tier_drained=bool(owner.tier_drained() in (None, True)))
+
+
 def main() -> None:
     import jax
 
@@ -3404,7 +3635,8 @@ def main() -> None:
                bench_serve_autoscale, bench_scenario_matrix,
                bench_sim_replay, bench_router_failover,
                bench_coord_brownout, bench_corruption_quarantine,
-               bench_serve_prefix_batching, bench_serve_disagg]
+               bench_serve_prefix_batching, bench_serve_disagg,
+               bench_kv_tier]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

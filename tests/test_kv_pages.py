@@ -215,3 +215,153 @@ class TestServeChurnEndToEnd:
             want = np.asarray(ref[0])[0, len(c.prompt):len(c.prompt) + n]
             np.testing.assert_array_equal(c.tokens, want,
                                           err_msg=f"request {c.rid}")
+
+
+class TestTieredChurnProperty:
+    """ISSUE 16 tiered KV memory: the churn fuzz extended with the
+    spill / re-admit / pull ops.  BlockPool + PrefixCache + HostTier
+    run 300 random steps of admit (with tier re-admission of spilled
+    chain links), grow, free, pull-mode install, cache/tier eviction
+    pressure, and weights-version bumps — with ``pool.check()`` AND
+    ``tier.check()`` (including the tiered∩HBM-resident disjointness
+    rule) after every single op, byte fidelity asserted on every
+    re-admitted block, and a full drain at the end: pool back to fully
+    free, tier empty."""
+
+    BS = 16
+
+    @staticmethod
+    def _layers_for(h):
+        """Deterministic synthetic page bytes for chain hash ``h`` —
+        the fuzz's stand-in for a device gather.  Re-admits compare
+        against this, so any byte corruption in the tier is caught."""
+        base = np.full((16, 8), (int(h) % 251) / 7.0, np.float32)
+        return [{"k": base, "v": base + 1.0},
+                {"k": base + 2.0, "v": base + 3.0}]
+
+    def test_tiered_churn_spill_readmit_pull_drains(self):
+        from tpudist import obs
+        from tpudist.models.kv_pages import PrefixCache, chain_hashes
+        from tpudist.models.kv_tier import HostTier
+
+        BS = self.BS
+        rng = np.random.default_rng(0x7133D)
+        pool = BlockPool(24, BS, 4, 12 * BS)
+        cache = PrefixCache(pool, capacity_blocks=8)
+        per_entry = 4 * 16 * 8 * 4          # _layers_for: 4 arrays
+        tier = HostTier(10 * per_entry)     # room for 10 spilled blocks
+        ver = {"v": 0}
+        cache.spill_hook = (
+            lambda h, blk, parent: tier.put(
+                h, self._layers_for(h), parent=parent,
+                version=ver["v"]))
+
+        def counter(name):
+            return obs.snapshot()["counters"].get(
+                name, {}).get("value", 0)
+
+        spills0 = counter("serve/tier_spills")
+        readmits0 = counter("serve/tier_readmits")
+
+        # a small prompt universe so prefixes recur and chains overlap
+        bases = [rng.integers(1, 60, size=n * BS).astype(np.int32)
+                 for n in (1, 2, 3, 3)]
+        live: dict[int, int] = {}
+
+        def check_all():
+            pool.check()
+            tier.check(cache._entries.keys())
+
+        def readmit(chain, blocks):
+            """Extend an HBM prefix hit into the tier: alloc a cached
+            block, take the spilled bytes (byte-checked), install."""
+            j = len(blocks)
+            while j < len(chain) and tier.has(chain[j],
+                                              version=ver["v"]):
+                blk = pool.alloc_cached_block()
+                if blk is None:
+                    break
+                layers = tier.take(chain[j], version=ver["v"])
+                assert layers is not None
+                want = self._layers_for(chain[j])
+                for got, w in zip(layers, want):
+                    np.testing.assert_array_equal(got["k"], w["k"])
+                    np.testing.assert_array_equal(got["v"], w["v"])
+                cache.install(chain[j], blk,
+                              chain[j - 1] if j else None)
+                blocks.append(blk)
+                j += 1
+            return blocks
+
+        for step in range(300):
+            op = rng.random()
+            free_slots = [s for s in range(4) if s not in live]
+            if op < 0.40 and free_slots:
+                # admit: HBM prefix hit extended through the tier
+                slot = int(rng.choice(free_slots))
+                base = bases[int(rng.integers(len(bases)))]
+                tail = rng.integers(1, 60, size=int(
+                    rng.integers(0, BS + 5))).astype(np.int32)
+                prompt = np.concatenate([base, tail])
+                L = int(prompt.size)
+                max_new = int(rng.integers(1, 2 * BS))
+                chain = chain_hashes(prompt.tolist(), BS)
+                blocks = readmit(chain, cache.match(prompt))
+                n_sh = len(blocks)
+                cow = int(n_sh * BS >= L)
+                if pool.can_admit(L, max_new, shared=n_sh, cow=cow):
+                    pool.admit(slot, L, max_new, shared=blocks)
+                    if cow:
+                        pool.cow_write(slot, n_sh - 1)
+                    cache.register(prompt, pool._slot_blocks[slot])
+                    for h in chain:
+                        tier.discard(h)   # registered => HBM-resident
+                    live[slot] = L
+                # else: the re-admitted blocks stay cached-idle —
+                # exactly what a failed admission leaves behind
+            elif op < 0.55 and live:
+                slot = int(rng.choice(list(live)))
+                pool.grow(slot, int(rng.integers(1, BS)))
+            elif op < 0.75 and live:
+                slot = int(rng.choice(list(live)))
+                pool.free_slot(slot)
+                del live[slot]
+            elif op < 0.85:
+                # pull-mode install: a peer's exported leading run
+                # lands as local cached-idle blocks (first-wins walk,
+                # like ServeLoop.install_prefix)
+                base = bases[int(rng.integers(len(bases)))]
+                chain = chain_hashes(base.tolist(), BS)
+                n = int(rng.integers(1, len(chain) + 1))
+                for j in range(n):
+                    if chain[j] in cache._entries:
+                        continue
+                    blk = pool.alloc_cached_block()
+                    if blk is None:
+                        break
+                    cache.install(chain[j], blk,
+                                  chain[j - 1] if j else None)
+                    tier.discard(chain[j])
+            elif op < 0.95:
+                cache.evict_one()       # spills into the tier
+            elif op < 0.98:
+                tier.evict_one()        # tier budget pressure
+            else:
+                # weights bump: stamped tier entries become stale and
+                # must never re-admit (has() reads absent, take()
+                # drops) — the swap-invalidation belt, fuzzed
+                ver["v"] += 1
+            check_all()
+
+        # the fuzz must actually have exercised the tier
+        assert counter("serve/tier_spills") - spills0 > 0
+        assert counter("serve/tier_readmits") - readmits0 > 0
+
+        for slot in list(live):
+            pool.free_slot(slot)
+        cache.flush()
+        tier.flush()
+        check_all()
+        assert pool.free_blocks == pool.num_blocks
+        assert pool.used_blocks == 0
+        assert len(tier) == 0

@@ -25,6 +25,9 @@ DOCS = {
                                        "shape": [1, 8, 4]},
                                  "v": {"b64": "AAAA", "dtype": "float32",
                                        "shape": [1, 8, 4]}}]},
+    "pullreq": {"key": "00000007", "prompt": [3, 1, 4]},
+    "pulldone": {"key": "00000007", "ref": "ns/kv/pull-00000007",
+                 "owner": "r0"},
 }
 
 

@@ -505,6 +505,22 @@ class BlockPool:
             self._free.append(blk)
         self._publish()
 
+    def alloc_cached_block(self) -> int | None:
+        """A plain-free block, taken and PINNED as cached-idle (refcount
+        stays 0) — the landing page for a host-tier re-admit or a
+        pull-mode install, whose bytes arrive by scatter rather than by
+        prefill.  Deliberately never triggers the eviction hook: paging
+        one cached block in must not page another cached block out
+        (tier thrash), so when only reclaimable-cached capacity is left
+        the caller skips the install and re-prefills instead.  Returns
+        ``None`` in that case."""
+        if not self._free:
+            return None
+        blk = self._free.pop()
+        self._pinned.add(blk)
+        self._publish()
+        return blk
+
 
 class PrefixCache:
     """Host-side map from rolling prefix-hash chains to pool blocks.
@@ -538,6 +554,16 @@ class PrefixCache:
         self.pool = pool
         self.capacity_blocks = capacity_blocks
         self._entries: OrderedDict[int, int] = OrderedDict()
+        # chain-parent links (hash -> previous chain hash, None for a
+        # prompt's first block): the spill hook forwards them so the
+        # host tier can evict by chain suffix
+        self._parent: dict[int, int | None] = {}
+        # set by the serve loop when a host tier exists: called as
+        # ``spill_hook(hash, block, parent)`` just before an evicted
+        # block's pin drops (the block is refcount-0, so its page bytes
+        # are stable — the hook's one chance to copy them to host RAM)
+        self.spill_hook: Callable[[int, int, int | None], None] | None \
+            = None
         pool._evict_hook = self._evict_for_pool
         self._obs_hits = obs.counter("serve/prefix_hits", unit="requests")
         self._obs_hit_tokens = obs.counter(
@@ -599,11 +625,33 @@ class PrefixCache:
                     and len(self._entries) >= self.capacity_blocks):
                 break
             self._entries[h] = slot_blocks[j]
+            self._parent[h] = hashes[j - 1] if j else None
             self.pool.cache_pin(slot_blocks[j])
             added += 1
         self._obs_cached.set(len(self._entries))
         self.pool._publish()
         return added
+
+    def install(self, h: int, blk: int, parent: int | None) -> None:
+        """Index an externally-filled cached-idle block under ``h`` —
+        the landing half of a host-tier re-admit or a pull-mode
+        install.  ``blk`` must come from
+        :meth:`BlockPool.alloc_cached_block` (already pinned, refcount
+        0) with the page bytes scattered in by the caller; from here on
+        the entry is indistinguishable from one :meth:`register` made.
+        First-wins like registration: a hash already resident keeps its
+        block and the caller must not have allocated for it."""
+        if h in self._entries:
+            raise RuntimeError(
+                f"install of already-resident prefix hash {h}")
+        if blk not in self.pool._pinned or self.pool._refcount[blk]:
+            raise RuntimeError(
+                f"install target block {blk} is not cached-idle "
+                "(use alloc_cached_block)")
+        self._entries[h] = blk
+        self._parent[h] = parent
+        self._obs_cached.set(len(self._entries))
+        self.pool._publish()
 
     def evict_one(self) -> bool:
         """Drop the least-recently-used entry whose block no live slot
@@ -611,6 +659,12 @@ class PrefixCache:
         for h, blk in self._entries.items():  # OrderedDict: LRU first
             if not self.pool._refcount[blk]:
                 del self._entries[h]
+                parent = self._parent.pop(h, None)
+                if self.spill_hook is not None:
+                    # the block is refcount-0 and still pinned: its
+                    # page bytes are stable, so the hook can copy them
+                    # to the host tier before the pin (and page) drop
+                    self.spill_hook(h, blk, parent)
                 self.pool.cache_unpin(blk)
                 self._obs_evictions.inc()
                 self._obs_cached.set(len(self._entries))
@@ -625,8 +679,10 @@ class PrefixCache:
         """Drop every entry — cached KV is invalid the moment weights
         hot-swap.  Blocks still referenced by live slots (there are none
         at the drain-gated swap point, but be safe) just lose their pin
-        and are freed by their slot's finalize."""
+        and are freed by their slot's finalize.  Deliberately does NOT
+        spill: flush means the bytes are invalid, not cold."""
         for h, blk in list(self._entries.items()):
             del self._entries[h]
             self.pool.cache_unpin(blk)
+        self._parent.clear()
         self._obs_cached.set(0)

@@ -57,6 +57,7 @@ from tpudist.models.generate import (
     serving_layout,
 )
 from tpudist.models.kv_pages import BlockPool, PrefixCache, chain_hashes
+from tpudist.models.kv_tier import HostTier, tier_budget_from_env
 from tpudist.models.speculative import (
     AdaptiveDraftPolicy,
     _accept_and_next,
@@ -118,6 +119,12 @@ class Request:
     # fails verification) means ordinary admission — the re-prefill
     # fallback that keeps a lost handoff exact.
     kv_handoff: Any = None
+    # fleet-global prefix cache (pull mode): an opaque KVTransport ref
+    # to a peer-exported prefix payload.  The replica worker resolves
+    # it and installs the pages as cached-idle blocks BEFORE admission,
+    # so the admission below hits locally; a missing/corrupt/stale ref
+    # installs nothing and the ordinary prefill is the exact fallback.
+    prefix_ref: str | None = None
 
 
 @dataclasses.dataclass
@@ -467,6 +474,21 @@ class ServeLoop:
             PrefixCache(self.pool)
             if prefix_sharing and self.chunked and self.pool is not None
             else None)
+        # the weights version the loop's CURRENT params correspond to;
+        # stamps tier entries and pull-mode exports so KV computed
+        # under one version can never be adopted under another (the
+        # swap-point flush is the front door, the stamp the backstop)
+        self.weights_version = 0
+        # host-RAM spill tier (tier 2 of the KV hierarchy): prefix-
+        # cache evictions land here instead of vanishing, keyed by the
+        # same chain hashes — see tpudist.models.kv_tier.  Budgeted by
+        # TPUDIST_KV_HOST_TIER_BYTES (0 disables).
+        self._tier: HostTier | None = None
+        if self._prefix_cache is not None:
+            budget = tier_budget_from_env()
+            if budget > 0:
+                self._tier = HostTier(budget)
+                self._prefix_cache.spill_hook = self._spill_block
         # recently admitted request prefix hashes (wire-opaque ints from
         # Request.prefix_hash), LRU-bounded — the replica's published
         # affinity summary (see prefix_summary)
@@ -651,6 +673,13 @@ class ServeLoop:
             # max_blocks_per_slot bounds.
             self._adopt_dev = jax.jit(self._adopt_dev_impl,
                                       donate_argnums=(0, 1, 2, 3, 4))
+            # tiered-KV install: scatter re-admitted (host-tier or
+            # pull-mode) blocks into pool pages — the page-write half
+            # of adoption with NO lane stamps, because the blocks land
+            # as cached-idle prefix entries rather than a live slot.
+            # Compiled per distinct block count, like _adopt_dev.
+            self._install_dev = jax.jit(self._install_dev_impl,
+                                        donate_argnums=(0,))
         # disaggregation accounting: adoptions took the migrated-KV
         # path; fallbacks re-prefilled because the payload was missing
         # or failed verification (both exact by construction — the
@@ -1008,6 +1037,35 @@ class ServeLoop:
         first_buf = first_buf.at[slot].set(first)
         return cache, tok, active, remaining, first_buf
 
+    def _install_dev_impl(self, cache, kv, pages):
+        """Scatter re-admitted KV blocks into pool pages ``pages`` —
+        the page-write half of :meth:`_adopt_dev_impl` only: no page
+        table, no cache index, no lane stamps.  The blocks become
+        cached-idle prefix-cache entries (pinned, refcount 0); the
+        admission that matches them aliases them in via the ordinary
+        ``share`` path, which is what makes a tier re-admit or a peer
+        pull byte-identical to having kept the pages in HBM all
+        along."""
+        i = 0
+
+        def walk(node):
+            nonlocal i
+            if not isinstance(node, dict):
+                return node
+            if "paged_key" in node:
+                k, v = kv[i]
+                i += 1
+                out = dict(node)
+                out["paged_key"] = node["paged_key"].at[pages].set(
+                    k.astype(node["paged_key"].dtype))
+                out["paged_value"] = (
+                    node["paged_value"].at[pages].set(
+                        v.astype(node["paged_value"].dtype)))
+                return out
+            return {key: walk(val) for key, val in node.items()}
+
+        return walk(cache)
+
     def _admit_dev_spec_impl(self, params, draft_params, cache, d_cache,
                              tok, active, remaining, first_buf,
                              prompt_padded, true_len, slot, max_new, pages,
@@ -1287,8 +1345,16 @@ class ServeLoop:
         first position prefill must actually compute; a FULL-prompt hit
         still recomputes position ``L - 1`` (the first output logit has
         to come from somewhere) and that write lands in the last shared
-        block — the ``cow`` split."""
+        block — the ``cow`` split.
+
+        With a host tier, the chain walk CONTINUES past the HBM-resident
+        run: spilled blocks extending the match are re-admitted (host ->
+        HBM scatter into freshly pinned cached-idle pages) and aliased
+        exactly like blocks that never left."""
         blocks = self._prefix_cache.match(prompt)
+        if self._tier is not None and len(self._tier):
+            chain = chain_hashes(prompt, self.kv_block_size)
+            blocks = blocks + self._readmit_tiered(chain, len(blocks))
         if not blocks:
             return [], 0, False
         matched = len(blocks) * self.kv_block_size
@@ -1305,11 +1371,227 @@ class ServeLoop:
 
     def flush_prefix_cache(self) -> None:
         """Drop every cached prefix (idle blocks return to the free
-        list).  Called automatically at weight hot-swaps; benches call
-        it before asserting a fully drained pool."""
+        list) AND every host-tier entry.  Called automatically at
+        weight hot-swaps — spilled KV is exactly as stale as resident
+        KV — and by benches before asserting fully drained pool and
+        tier."""
         if self._prefix_cache is not None:
             self._prefix_cache.flush()
+        if self._tier is not None:
+            self._tier.flush()
         self._affinity_recent.clear()
+
+    # -- tiered KV memory (see tpudist.models.kv_tier) ---------------------
+
+    def _spill_block(self, h: int, blk: int, parent: int | None) -> None:
+        """PrefixCache spill hook: copy an evicted idle block's page
+        bytes to the host tier before its pin (and page) drop.  The
+        block is refcount-0 and still pinned here, so the bytes are
+        stable; the ``np.asarray`` gather syncs the device — an
+        eviction is already a capacity-pressure event, so the stall
+        buys keeping a prefix instead of losing it."""
+        layers = [{"k": np.asarray(node["paged_key"][blk]),
+                   "v": np.asarray(node["paged_value"][blk])}
+                  for node in self._paged_nodes(self.cache)]
+        self._tier.put(h, layers, parent=parent,
+                       version=self.weights_version)
+
+    def _readmit_tiered(self, chain: list[int], start: int) -> list[int]:
+        """Re-admit the longest run of tiered blocks extending a local
+        chain match at index ``start``: take each entry (version-
+        checked), land it in a freshly pinned cached-idle page, and
+        index it back into the prefix cache.  Allocation never evicts —
+        paging one cached block in must not page another out — so when
+        only reclaimable-cached capacity is left the walk stops and the
+        suffix re-prefills.  Returns the installed pool blocks, in
+        chain order."""
+        taken: list[tuple[int, int | None, int, list]] = []
+        j = start
+        while j < len(chain):
+            if not self._tier.has(chain[j], version=self.weights_version):
+                break
+            blk = self.pool.alloc_cached_block()
+            if blk is None:
+                break
+            layers = self._tier.take(chain[j],
+                                     version=self.weights_version)
+            if layers is None:   # unreachable after has(); stay safe
+                self.pool.cache_unpin(blk)
+                break
+            taken.append((chain[j], chain[j - 1] if j else None,
+                          blk, layers))
+            j += 1
+        self._scatter_install(taken)
+        return [t[2] for t in taken]
+
+    def _scatter_install(self,
+                         taken: list[tuple[int, int | None, int, list]]
+                         ) -> int:
+        """One ``_install_dev`` dispatch landing ``taken``'s block
+        bytes (``(hash, parent, pool_block, layers)`` each) into their
+        pages, then the cache-index installs — host-ordered AFTER the
+        scatter, so any later match's gather reads the written pages
+        (the same ordering argument as register-after-insert)."""
+        if not taken:
+            return 0
+        nodes = self._paged_nodes(self.cache)
+        kv = tuple(
+            (jnp.asarray(np.stack([np.asarray(t[3][li]["k"])
+                                   for t in taken])),
+             jnp.asarray(np.stack([np.asarray(t[3][li]["v"])
+                                   for t in taken])))
+            for li in range(len(nodes)))
+        pages = jnp.asarray(
+            np.asarray([t[2] for t in taken], np.int32))
+        self.cache = self._install_dev(self.cache, kv, pages)
+        for h, parent, blk, _ in taken:
+            self._prefix_cache.install(h, blk, parent)
+        return len(taken)
+
+    def prefix_residency(self, limit: int = 256) -> dict:
+        """Resident prefix chain hashes for the fleet directory:
+        ``{"chains": [...], "tiered": [...]}`` — HBM prefix-cache
+        entries plus host-tier entries (``tiered`` is the subset that
+        lives in the tier), most-recently-used last, bounded."""
+        if self._prefix_cache is None:
+            return {"chains": [], "tiered": []}
+        hbm = list(self._prefix_cache._entries)
+        tiered = self._tier.hashes() if self._tier is not None else []
+        chains = (hbm + tiered)[-int(limit):]
+        tset = set(tiered)
+        return {"chains": chains,
+                "tiered": [h for h in chains if h in tset]}
+
+    def export_prefix(self, chain: Sequence[int]) -> dict | None:
+        """Pull-mode owner half: serialize the longest leading run of
+        ``chain`` resident here — HBM prefix-cache pages gathered from
+        the device, host-tier entries read in place (no removal: the
+        export is a COPY, local hits keep working) — as a migration-
+        style payload a peer installs via :meth:`install_prefix`.
+        ``None`` when the leading link is not resident (the directory
+        was stale; the requester just re-prefills)."""
+        if self._prefix_cache is None or self.pool is None:
+            return None
+        chain = [int(h) for h in chain]
+        hbm_blocks: list[int] = []
+        for h in chain:
+            blk = self._prefix_cache._entries.get(h)
+            if blk is None:
+                break
+            hbm_blocks.append(blk)
+        tier_layers: list[list] = []
+        if self._tier is not None:
+            while len(hbm_blocks) + len(tier_layers) < len(chain):
+                layers = self._tier.peek_layers(
+                    chain[len(hbm_blocks) + len(tier_layers)],
+                    version=self.weights_version)
+                if layers is None:
+                    break
+                tier_layers.append(layers)
+        n = len(hbm_blocks) + len(tier_layers)
+        if not n:
+            return None
+        nodes = self._paged_nodes(self.cache)
+        pages = np.asarray(hbm_blocks, np.int32)
+        layers_out = []
+        for li, node in enumerate(nodes):
+            ks, vs = [], []
+            if hbm_blocks:
+                ks.append(np.asarray(node["paged_key"][pages]))
+                vs.append(np.asarray(node["paged_value"][pages]))
+            for tl in tier_layers:
+                ks.append(np.asarray(tl[li]["k"])[None])
+                vs.append(np.asarray(tl[li]["v"])[None])
+            layers_out.append({"k": np.concatenate(ks, axis=0),
+                               "v": np.concatenate(vs, axis=0)})
+        return {
+            "key": None,      # stamped by the worker at publish
+            "rid": None,
+            "prompt": [],     # pull payloads carry no fallback prompt:
+                              # the REQUESTER holds the real request
+            "chain": chain[:n],
+            "block_size": int(self.kv_block_size),
+            "version": int(self.weights_version),
+            "published_at": time.time(),
+            "layers": layers_out,
+        }
+
+    def install_prefix(self, prompt, payload: dict) -> int:
+        """Pull-mode requester half: verify a peer-exported prefix
+        payload against ``prompt``'s OWN chain (recomputed locally —
+        the peer is never trusted), the loop's block size, and the
+        CURRENT weights version, then land its blocks as cached-idle
+        prefix entries so the admission that follows hits locally and
+        prefills only the suffix.  Any gate failing installs nothing
+        and returns 0 — the ordinary prefill is the byte-identical
+        fallback.  Returns the number of blocks installed."""
+        if self._prefix_cache is None or self.pool is None:
+            return 0
+        try:
+            bs = int(payload["block_size"])
+            version = int(payload.get("version", -1))
+            chain = [int(h) for h in payload["chain"]]
+            layers = payload["layers"]
+        except (KeyError, TypeError, ValueError):
+            return 0
+        nodes = self._paged_nodes(self.cache)
+        prompt = np.asarray(prompt, np.int32)
+        want = chain_hashes(prompt, self.kv_block_size)
+        n = len(chain)
+        if (bs != self.kv_block_size
+                or version != self.weights_version
+                or not n or n > len(want) or chain != want[:n]
+                or not isinstance(layers, (list, tuple))
+                or len(layers) != len(nodes)):
+            return 0
+        arrs = []
+        for l in layers:
+            try:
+                k = np.asarray(l["k"])
+                v = np.asarray(l["v"])
+            except (KeyError, TypeError, ValueError):
+                return 0
+            if (k.ndim != 3 or k.shape[0] != n or k.shape[1] != bs
+                    or v.shape != k.shape):
+                return 0
+            arrs.append((k, v))
+        taken: list[tuple[int, int | None, int, list]] = []
+        try:
+            for j in range(n):
+                if chain[j] in self._prefix_cache._entries:
+                    continue   # local copy wins (first-wins install)
+                blk = self.pool.alloc_cached_block()
+                if blk is None:
+                    break
+                taken.append((chain[j],
+                              want[j - 1] if j else None, blk,
+                              [{"k": arrs[li][0][j], "v": arrs[li][1][j]}
+                               for li in range(len(nodes))]))
+            installed = self._scatter_install(taken)
+            if self._tier is not None:
+                # a pulled link that was ALSO spilled locally is now
+                # HBM-resident: drop the tier copy (disjointness rule)
+                for h, _, _, _ in taken:
+                    self._tier.discard(h)
+            return installed
+        except Exception:
+            # a half-taken install must not leak pinned pages: undo the
+            # allocations that never reached the cache index
+            for _, _, blk, _ in taken:
+                if blk not in self._prefix_cache._entries.values():
+                    self.pool.cache_unpin(blk)
+            raise
+
+    def tier_drained(self) -> bool | None:
+        """Tier invariants + emptiness — the exit-report / bench drain
+        gate (``None`` when no tier exists).  Runs the cross-structure
+        check: no hash simultaneously tiered and HBM-resident."""
+        if self._tier is None:
+            return None
+        resident = (self._prefix_cache._entries.keys()
+                    if self._prefix_cache is not None else ())
+        self._tier.check(resident)
+        return len(self._tier) == 0
 
     def _admit(self, slot: int, req: Request) -> dict:
         """Admit ``req`` into ``slot`` WITHOUT a host sync: the prefill
@@ -1971,6 +2253,16 @@ class ServeLoop:
                     self._prefix_cache.register(
                         pf["padded"][0, :pf["L"]],
                         self.pool._slot_blocks[slot])
+                    if self._tier is not None and len(self._tier):
+                        # every full block of this prompt is now
+                        # HBM-resident (first-wins or fresh): drop any
+                        # surviving tier copy — e.g. a re-admit that
+                        # stopped at pool exhaustion left deep links
+                        # spilled, and the full prefill just recomputed
+                        # them.  Tiered/resident must stay disjoint.
+                        for h in chain_hashes(pf["padded"][0, :pf["L"]],
+                                              self.kv_block_size):
+                            self._tier.discard(h)
                 tev("prefill_done", st["req"], slot=slot, seq=seq,
                     prompt_len=pf["L"])
                 if self.role == "prefill":
@@ -2054,11 +2346,18 @@ class ServeLoop:
                     self._obs_swaps.inc()
                     if swap["version"] is not None:
                         self._obs_weights_version.set(int(swap["version"]))
+                        # the version stamp every subsequent tier spill
+                        # and pull-mode export carries: KV computed
+                        # before this line can never pass the
+                        # version gate after it
+                        self.weights_version = int(swap["version"])
                     # cached prefix KV was computed under the OLD
                     # weights — serving it to a post-swap admission
                     # would break exactness.  The loop is drained here,
                     # so every refcount is zero and the flush returns
-                    # every cached block to the free list.
+                    # every cached block to the free list (and empties
+                    # the host tier, whose spilled KV is exactly as
+                    # stale).
                     self.flush_prefix_cache()
             obs.recorder.record("serve_swap", seq=seq,
                                 version=swap["version"],

@@ -96,7 +96,8 @@ class AutoscaleConfig:
                  step: int = 1,
                  max_metric_age_s: float = 5.0,
                  max_burn_rate: float | None = None,
-                 min_kv_free_frac: float | None = None) -> None:
+                 min_kv_free_frac: float | None = None,
+                 min_tier_headroom_frac: float | None = None) -> None:
         if min_replicas < 0 or max_replicas < max(min_replicas, 1):
             raise ValueError(
                 f"need 0 <= min_replicas <= max_replicas (>=1), got "
@@ -148,6 +149,22 @@ class AutoscaleConfig:
                              f"{min_kv_free_frac}")
         self.min_kv_free_frac = (None if min_kv_free_frac is None
                                  else float(min_kv_free_frac))
+        # tiered-KV pressure up-signal: when the fleet's host spill
+        # tiers run out of headroom (merged serve/tier_bytes vs
+        # serve/tier_budget_bytes), the next evictions DISCARD warm
+        # prefixes instead of spilling them — re-prefill load is about
+        # to arrive even though queues still look fine.  A poll with
+        # fleet tier headroom (1 - bytes/budget) below this counts as a
+        # breach.  None disables the signal (and fleets with the tier
+        # disabled publish no budget, which also disables it).
+        if (min_tier_headroom_frac is not None
+                and not 0.0 < min_tier_headroom_frac < 1.0):
+            raise ValueError(
+                f"min_tier_headroom_frac must be in (0, 1), got "
+                f"{min_tier_headroom_frac}")
+        self.min_tier_headroom_frac = (
+            None if min_tier_headroom_frac is None
+            else float(min_tier_headroom_frac))
 
     @classmethod
     def from_env(cls, environ=None, **overrides) -> "AutoscaleConfig":
@@ -169,7 +186,9 @@ class AutoscaleConfig:
                 ("STEP", "step", int),
                 ("MAX_METRIC_AGE_S", "max_metric_age_s", float),
                 ("MAX_BURN_RATE", "max_burn_rate", float),
-                ("MIN_KV_FREE_FRAC", "min_kv_free_frac", float)):
+                ("MIN_KV_FREE_FRAC", "min_kv_free_frac", float),
+                ("MIN_TIER_HEADROOM_FRAC", "min_tier_headroom_frac",
+                 float)):
             v = _env(env, name)
             if v is not None:
                 kw[key] = cast(v)
@@ -358,6 +377,13 @@ class Autoscaler:
         kv_free_frac = (free / (free + used)
                         if free is not None and used is not None
                         and free + used > 0 else None)
+        tier_bytes = (merged["gauges"].get("serve/tier_bytes")
+                      or {}).get("value")
+        tier_budget = (merged["gauges"].get("serve/tier_budget_bytes")
+                       or {}).get("value")
+        tier_headroom_frac = (1.0 - tier_bytes / tier_budget
+                              if tier_bytes is not None
+                              and tier_budget else None)
         # burn rate: worst across the fleet's published slo/burn_rate_*
         # gauges (per_worker max — summing rates across replicas would
         # overstate) and the local tracker's shortest window (a rank-0
@@ -376,6 +402,7 @@ class Autoscaler:
                 "quarantined": quarantined, "wait_q": wait_q,
                 "queue_depth": depth, "kv_blocks_free": free,
                 "kv_free_frac": kv_free_frac,
+                "tier_headroom_frac": tier_headroom_frac,
                 "burn_rate": burn, "snaps": snaps}
 
     def _pending_joiners(self, live: set[str]) -> list:
@@ -490,7 +517,16 @@ class Autoscaler:
         starved = (self.cfg.min_kv_free_frac is not None
                    and view["kv_free_frac"] is not None
                    and view["kv_free_frac"] < self.cfg.min_kv_free_frac)
-        if view["wait_q"] > self.cfg.target_wait_s or burning or starved:
+        # tiered-KV pressure: spill tiers nearly full means warm
+        # prefixes are about to be DISCARDED, not spilled — the
+        # re-prefill load arrives before queue wait shows it
+        tier_pressed = (
+            self.cfg.min_tier_headroom_frac is not None
+            and view["tier_headroom_frac"] is not None
+            and view["tier_headroom_frac"]
+            < self.cfg.min_tier_headroom_frac)
+        if (view["wait_q"] > self.cfg.target_wait_s or burning
+                or starved or tier_pressed):
             self._breach += 1
             self._idle = 0
         elif (view["wait_q"] < self.cfg.low_wait_s
@@ -511,6 +547,10 @@ class Autoscaler:
             why = ("kv_free_frac=%.2f < %.2f" % (
                        view["kv_free_frac"], self.cfg.min_kv_free_frac)
                    if starved else
+                   "tier_headroom=%.2f < %.2f" % (
+                       view["tier_headroom_frac"],
+                       self.cfg.min_tier_headroom_frac)
+                   if tier_pressed else
                    "wait p%d=%.3fs > target %.3fs" % (
                        int(self.cfg.quantile * 100), view["wait_q"],
                        self.cfg.target_wait_s))

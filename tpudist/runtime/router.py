@@ -175,6 +175,8 @@ from tpudist.obs.health import HealthMonitor
 from tpudist.obs.registry import hist_quantile
 from tpudist.runtime import faults, wire
 from tpudist.runtime.coord import CoordClient, ElasticMonitor
+from tpudist.runtime.prefix_directory import (PrefixDirectory,
+                                              summary_ttl_from_env)
 from tpudist.runtime.quarantine import (GoldenProbe, QuarantineConfig,
                                         QuarantineManager)
 from tpudist.utils.logging import get_logger
@@ -199,7 +201,8 @@ JOURNAL_SCHEMA = "tpudist.journal/1"
 # tpudist.runtime.wire for the crc32c framing and the legacy
 # unframed-JSON fallback every decoder keeps) ------------------------------
 
-def _request_doc(key: str, req, handoff_ref: str | None = None) -> dict:
+def _request_doc(key: str, req, handoff_ref: str | None = None,
+                 prefix_ref: str | None = None) -> dict:
     doc = {
         "key": key,
         "prompt": np.asarray(req.prompt).astype(int).tolist(),
@@ -213,6 +216,12 @@ def _request_doc(key: str, req, handoff_ref: str | None = None) -> dict:
     # re-prefills from the prompt above when the fetch misses
     if handoff_ref is not None:
         doc["handoff_ref"] = str(handoff_ref)
+    # pull-mode global prefix cache: the ref of a peer-exported prefix
+    # payload — the replica fetches and installs the shared pages
+    # BEFORE admission, so the prefill covers only the suffix (a miss
+    # installs nothing and the full prefill runs: exact either way)
+    if prefix_ref is not None:
+        doc["prefix_ref"] = str(prefix_ref)
     # distributed tracing: the trace context rides the wire so the
     # replica's lifecycle events join the router's under one trace id
     # (and SURVIVE a redispatch — the router re-sends the same context)
@@ -229,9 +238,11 @@ def _request_doc(key: str, req, handoff_ref: str | None = None) -> dict:
 
 
 def _encode_request(key: str, req,
-                    handoff_ref: str | None = None) -> bytes:
+                    handoff_ref: str | None = None,
+                    prefix_ref: str | None = None) -> bytes:
     return wire.encode_record(
-        "request", _request_doc(key, req, handoff_ref=handoff_ref))
+        "request", _request_doc(key, req, handoff_ref=handoff_ref,
+                                prefix_ref=prefix_ref))
 
 
 def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
@@ -248,12 +259,14 @@ def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
     try:
         phash = d.get("prefix_hash")
         ref = d.get("handoff_ref")
+        pref = d.get("prefix_ref")
         return Request(prompt=np.asarray(d["prompt"], np.int32),
                        max_new_tokens=int(d["max_new_tokens"]),
                        rid=d["key"], deadline_s=d.get("deadline_s"),
                        priority=int(d.get("priority", 0)),
                        trace=TraceContext.from_wire(d.get("trace")),
                        prefix_hash=None if phash is None else int(phash),
+                       prefix_ref=None if pref is None else str(pref),
                        # a ref-only stub: the worker resolves it into
                        # the real payload (or None) before admission
                        kv_handoff=(None if ref is None
@@ -352,9 +365,13 @@ class ReplicaWorker:
         # the outage, and greedy determinism re-produces it).
         self._done_buf: list[tuple[str, bytes]] = []
         self._done_buf_cap = 4096
-        # last published prefix-affinity summary; republished only on
-        # change so an idle replica costs the coord store nothing
-        self._prefix_pub: tuple[int, ...] | None = None
+        # last published prefix-affinity summary; republished on change
+        # OR half-TTL age (the summary carries a wall-clock stamp the
+        # router's staleness bound reads, so an unchanged-but-alive
+        # summary must keep renewing itself)
+        self._prefix_pub: tuple | None = None
+        self._prefix_pub_t = 0.0
+        self._prefix_ttl_s = summary_ttl_from_env()
         self._weights_version = 0
         self._roll: dict | None = None   # the in-progress swap-chain turn
         self._obs_version = obs.gauge("serve/weights_version",
@@ -385,6 +402,12 @@ class ReplicaWorker:
                 self.loop.params = jax.tree.map(jnp.asarray, tree)
                 self._weights_version = int(
                     (meta or {}).get("version", step))
+                if hasattr(self.loop, "weights_version"):
+                    # the loop's KV version stamp must track the served
+                    # weights from the first token: tier entries and
+                    # pull payloads minted under this version are only
+                    # adoptable by peers on the SAME version
+                    self.loop.weights_version = self._weights_version
                 log.info("replica %s: restored weights version %d from %s",
                          replica_id, self._weights_version, snapshot_dir)
         self._obs_version.set(self._weights_version)
@@ -437,6 +460,10 @@ class ReplicaWorker:
         and resume advertising for admissions."""
         self._weights_version = int(version)
         self._roll = None
+        # the swap flushed the prefix cache AND tier: force a fresh
+        # summary publish so the fleet directory unlearns this
+        # replica's pre-swap residency immediately
+        self._prefix_pub = None
         self._obs_version.set(self._weights_version)
         self._obs_swapping.set(0)
         try:
@@ -543,6 +570,7 @@ class ReplicaWorker:
         try:
             self._flush_done_buffer()
             self._publish_prefix()
+            self._serve_pulls()
             if (self.client.get(f"{self.ns}/stop") is not None
                     or self.client.get(
                         f"{self.ns}/stop/{self.replica_id}") is not None):
@@ -585,10 +613,91 @@ class ReplicaWorker:
                 if req.trace is not None:
                     self._traces[str(req.rid)] = req.trace
                 req = self._resolve_handoff(req)
+                req = self._resolve_prefix_pull(req)
                 out.append(req)
         except ConnectionError:
             return []
         return out
+
+    def _serve_pulls(self) -> None:
+        """Owner half of the pull-mode global prefix cache: answer
+        ``{ns}/pullreq/{rid}/{key}`` requests by exporting the longest
+        resident run of the carried prompt's chain (HBM gather or
+        host-tier read — the export is a COPY, local residency is
+        untouched), publishing it over the KV transport, and committing
+        a ``{ns}/pulldone/{key}`` record with the payload ref (or
+        ``None`` on a miss — the router reverts the request to an
+        ordinary prefill).  Every failure mode degrades to ref=None or
+        to the router's pull timeout; a pull can slow a request but
+        never lose one."""
+        from tpudist.models.kv_pages import chain_hashes
+
+        prefix = f"{self.ns}/pullreq/{self.replica_id}/"
+        for key in sorted(self.client.keys(prefix)):
+            raw = self.client.get(key)
+            self.client.delete(key)
+            if raw is None:
+                continue
+            k = key[len(prefix):]
+            ref = None
+            try:
+                doc = wire.decode_record(raw, expect="pullreq",
+                                         namespace=self.ns, key=k,
+                                         replica=self.replica_id)
+                prompt = [int(t) for t in doc.get("prompt", ())]
+                fn = getattr(self.loop, "export_prefix", None)
+                bs = getattr(self.loop, "kv_block_size", 0) or 0
+                if fn is not None and bs and prompt:
+                    payload = fn(chain_hashes(prompt, bs))
+                    if payload is not None:
+                        payload = dict(payload)
+                        payload["key"] = k
+                        payload["rid"] = k
+                        ref, _ = self.kv_transport.publish(
+                            f"pull-{k}", payload)
+                        obs.counter("serve/prefix_exports",
+                                    unit="payloads").inc()
+            except ConnectionError:
+                raise   # the outer source poll's brownout handling
+            except Exception as e:  # noqa: BLE001 - advisory path
+                log.warning("replica %s: prefix export for %s failed "
+                            "(%s); answering ref=None", self.replica_id,
+                            k, e)
+                ref = None
+            self.client.set(
+                f"{self.ns}/pulldone/{k}",
+                wire.encode_record("pulldone", {
+                    "key": k, "ref": ref, "owner": self.replica_id}))
+
+    def _resolve_prefix_pull(self, req):
+        """Requester half: fetch a dispatched ``prefix_ref`` payload
+        and install the peer's pages as local cached-idle prefix blocks
+        BEFORE admission, so the admission that follows hits locally
+        and prefills only the suffix.  Any miss, corruption, or gate
+        failure installs nothing — the ordinary full prefill is the
+        byte-identical fallback — and this never raises."""
+        ref = getattr(req, "prefix_ref", None)
+        if not ref:
+            return req
+        fn = getattr(self.loop, "install_prefix", None)
+        installed = 0
+        if fn is not None:
+            try:
+                payload = self.kv_transport.fetch(ref)
+                if payload is not None:
+                    installed = int(fn(req.prompt, payload))
+            except Exception as e:  # noqa: BLE001 - advisory path
+                log.warning("replica %s: prefix install for %s failed "
+                            "(%s); falling back to full prefill",
+                            self.replica_id, req.rid, e)
+        if installed:
+            obs.counter("serve/prefix_pull_blocks", unit="blocks").inc(
+                installed)
+        else:
+            obs.counter("serve/prefix_pull_fallbacks", unit="reqs").inc()
+            log.info("replica %s: prefix pull for %s yielded no blocks;"
+                     " re-prefilling", self.replica_id, req.rid)
+        return dataclasses.replace(req, prefix_ref=None)
 
     def _resolve_handoff(self, req):
         """Swap a decode-stage request's ref stub for the real
@@ -609,23 +718,41 @@ class ReplicaWorker:
         return dataclasses.replace(req, kv_handoff=payload)
 
     def _publish_prefix(self) -> None:
-        """Advertise the loop's recently admitted prefix hashes at
-        ``{ns}/prefix/{rid}`` (checksummed frame, kind="prefix") so the
-        router can steer matching requests here.  Purely advisory:
-        stale or missing summaries only cost cache hits, never
-        correctness, so a publish failure is swallowed."""
+        """Advertise the loop's prefix residency at ``{ns}/prefix/{rid}``
+        (checksummed frame, kind="prefix"): the recently admitted opaque
+        affinity hashes (PR 14's steer), plus — for the fleet-global
+        prefix cache — the CHAIN hashes resident in HBM and the host
+        tier, the KV block size, the weights version the bytes were
+        computed under, and a wall-clock stamp the router's staleness
+        bound reads.  Purely advisory: stale or missing summaries only
+        cost cache hits, never correctness, so a publish failure is
+        swallowed.  Republished on change or at half-TTL age."""
         fn = getattr(self.loop, "prefix_summary", None)
         summ = tuple(int(h) for h in fn()) if fn is not None else ()
-        if summ == self._prefix_pub:
+        rfn = getattr(self.loop, "prefix_residency", None)
+        res = rfn() if rfn is not None else {"chains": [], "tiered": []}
+        now = time.time()
+        memo = (summ, tuple(res["chains"]), tuple(res["tiered"]),
+                self._weights_version)
+        if (memo == self._prefix_pub
+                and now - self._prefix_pub_t < self._prefix_ttl_s / 2):
             return
         try:
             self.client.set(
                 f"{self.ns}/prefix/{self.replica_id}",
                 wire.encode_record("prefix", {
-                    "replica": self.replica_id, "hashes": list(summ)}))
+                    "replica": self.replica_id,
+                    "hashes": list(summ),
+                    "chains": [int(h) for h in res["chains"]],
+                    "tiered": [int(h) for h in res["tiered"]],
+                    "block_size": getattr(self.loop, "kv_block_size",
+                                          None) or None,
+                    "version": self._weights_version,
+                    "at": now}))
         except ConnectionError:
             return   # advisory: retry on the next poll
-        self._prefix_pub = summ
+        self._prefix_pub = memo
+        self._prefix_pub_t = now
 
     def _sink(self, comp) -> None:
         """Commit one completion.  This write is the commit point of the
@@ -704,6 +831,12 @@ class ReplicaWorker:
         pool.check()
         return pool.free_blocks == pool.num_blocks
 
+    def tier_drained(self) -> bool | None:
+        """Host-tier invariants + emptiness for the exit report
+        (``None`` when the loop has no tier)."""
+        fn = getattr(self.loop, "tier_drained", None)
+        return fn() if fn is not None else None
+
     def serve(self) -> None:
         self.register()
         # registered but not yet heartbeating: the joiner-death window
@@ -721,12 +854,22 @@ class ReplicaWorker:
             clean = True
         finally:
             try:
+                # release the warm prefix cache + host tier before the
+                # drain checks: exit-report drained means the WHOLE KV
+                # hierarchy unwound, not just the live slots
+                flush = getattr(self.loop, "flush_prefix_cache", None)
+                if flush is not None:
+                    flush()
+            except Exception:
+                pass
+            try:
                 self.client.set(
                     f"{self.ns}/exit/{self.replica_id}",
                     wire.encode_record("heartbeat", {
                         "replica": self.replica_id,
                         "served": self._served,
                         "pool_drained": self.pool_drained(),
+                        "tier_drained": self.tier_drained(),
                         "weights_version": self._weights_version,
                         "clean": clean}))
             except Exception:
@@ -779,6 +922,9 @@ class Router:
                  journal: bool = True,
                  compact_every: int = 50,
                  outage_grace_s: float = 5.0,
+                 pull_min_blocks: int = 2,
+                 pull_timeout_s: float = 5.0,
+                 prefix_ttl_s: float | None = None,
                  quarantine: bool = True,
                  golden_probe: GoldenProbe | None = None,
                  quarantine_config: QuarantineConfig | None = None,
@@ -818,6 +964,17 @@ class Router:
         # replicas are suppressed another `outage_grace_s` so leases
         # that lapsed server-side during the outage can re-establish
         self.outage_grace_s = float(outage_grace_s)
+        # pull-mode global prefix cache: a prefill-stage request whose
+        # longest peer coverage beats `pull_min_blocks` full KV blocks
+        # — and whose covering peer is NOT dispatchable — parks in a
+        # "pull" stage while the owner exports its pages over the KV
+        # transport; `pull_timeout_s` (or the owner's death) reverts it
+        # to an ordinary prefill.  A pull can delay a request, never
+        # lose one.
+        self.pull_min_blocks = int(pull_min_blocks)
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.prefix_dir = PrefixDirectory(client, namespace=namespace,
+                                          ttl_s=prefix_ttl_s, wall=wall)
         self._journal_docs: dict[str, dict] = {}
         self._polls = 0
         self._coord_down_since: float | None = None
@@ -881,6 +1038,13 @@ class Router:
             stage: obs.gauge(f"router/stage_depth~stage={stage}",
                              unit="reqs")
             for stage in ("prefill", "decode")}
+        # fleet-global prefix cache: pull-mode exports initiated, and
+        # pulls that came back empty / timed out / lost their owner
+        # (the request re-prefills — slower, still exact)
+        self._obs_prefix_pulls = obs.counter("router/prefix_pulls",
+                                             unit="reqs")
+        self._obs_pull_fallbacks = obs.counter(
+            "router/prefix_pull_fallbacks", unit="reqs")
         # data-plane integrity: payloads that failed checksum/schema
         # verification at a router decode site, and corrupt-segment
         # verdicts replicas reported in-band.  Both feed the quarantine
@@ -1035,25 +1199,14 @@ class Router:
 
     def _prefix_map(self, candidates: Sequence[str]) -> dict[str, set[int]]:
         """One read of every candidate's published prefix-affinity
-        summary (``{ns}/prefix/{rid}``), once per poll.  Corrupt or
-        missing summaries degrade to no-affinity — the hash steer is
-        advisory, the least-loaded tie-break still places the request."""
-        out: dict[str, set[int]] = {}
-        for rid in candidates:
-            try:
-                raw = self.client.get(f"{self.ns}/prefix/{rid}")
-            except ConnectionError:
-                break
-            if raw is None:
-                continue
-            try:
-                doc = wire.decode_record(raw, expect="prefix",
-                                         namespace=self.ns, key=rid,
-                                         replica=rid)
-                out[rid] = {int(h) for h in doc.get("hashes", [])}
-            except (wire.WireError, ValueError, TypeError):
-                continue
-        return out
+        summary, once per poll, through the fleet directory (which
+        applies the ``TPUDIST_PREFIX_SUMMARY_TTL_S`` staleness bound —
+        a dead-but-registered replica's last publish must not keep
+        attracting affinity traffic).  Corrupt, missing, or stale
+        summaries degrade to no-affinity — the hash steer is advisory,
+        the least-loaded tie-break still places the request."""
+        self.prefix_dir.refresh(candidates)
+        return self.prefix_dir.affinity(candidates)
 
     def _pick(self, candidates: Sequence[str], loads: dict[str, dict],
               assigned: dict[str, int],
@@ -1087,7 +1240,11 @@ class Router:
     def _sweep_dead(self, rid: str, regs: dict[str, dict]) -> None:
         """Remove a dead replica's coordination residue so restarted
         ids and fresh health rounds start clean."""
-        for key in self.client.keys(f"{self.ns}/inbox/{rid}/"):
+        for key in (list(self.client.keys(f"{self.ns}/inbox/{rid}/"))
+                    # pending pull requests addressed to the dead
+                    # owner: nobody will answer them (the waiting
+                    # entries revert to prefill on their pull timeout)
+                    + list(self.client.keys(f"{self.ns}/pullreq/{rid}/"))):
             try:
                 self.client.delete(key)
             except ConnectionError:
@@ -1182,6 +1339,22 @@ class Router:
             return
         doc["stage"] = "decode"
         doc["handoff_ref"] = e.get("handoff_ref")
+        doc["assigned"] = None
+        doc["attempts"] = int(e["attempts"])
+        self._journal_write(k)
+
+    def _journal_pull(self, k: str, e: dict) -> None:
+        """Journal a pull-stage transition (initiation: stage="pull";
+        resolution: stage back to "prefill" with the payload ref, or
+        without one on a fallback).  A router crash mid-pull recovers
+        the entry as an ordinary prefill — the pull was an
+        optimization, the request's exactly-once contract never
+        depended on it."""
+        doc = self._journal_docs.get(k)
+        if doc is None:
+            return
+        doc["stage"] = e.get("stage", "prefill")
+        doc["prefix_ref"] = e.get("prefix_ref")
         doc["assigned"] = None
         doc["attempts"] = int(e["attempts"])
         self._journal_write(k)
@@ -1338,6 +1511,13 @@ class Router:
                 _decode_request(json.dumps(doc["req"]).encode()),
                 rid=rid)
             tc = TraceContext.mint(k)
+            stage = doc.get("stage", "prefill")
+            if stage == "pull":
+                # a pull was in flight when the router died: the pull
+                # was an OPTIMIZATION — recover the request as an
+                # ordinary prefill (the orphaned pullreq/pulldone keys
+                # are residue a later poll sweeps)
+                stage = "prefill"
             entries[k] = {"req": req,
                           "assigned": doc.get("assigned"),
                           "attempts": int(doc.get("attempts", 0)),
@@ -1345,8 +1525,9 @@ class Router:
                           # stage=decode + the payload ref, so the
                           # replacement router dispatches straight to
                           # the decode pool (ref missing -> re-prefill)
-                          "stage": doc.get("stage", "prefill"),
+                          "stage": stage,
                           "handoff_ref": doc.get("handoff_ref"),
+                          "prefix_ref": doc.get("prefix_ref"),
                           "trace": tc, "at": 0.0, "arrived": True}
             obs.events.record("recover_adopt", trace=tc.trace_id,
                               key=k, rid=rid,
@@ -1388,15 +1569,16 @@ class Router:
             remaining.discard(key)
             self._obs_completions.inc()
             # payload lifecycle belongs to the ROUTER (the request's
-            # owner): the KV-migration payload dies with the request's
-            # terminal, whatever the terminal was — an exporter death
-            # cannot leak it
-            ref = (entries.get(key) or {}).get("handoff_ref")
-            if ref:
-                try:
-                    self.client.delete(ref)
-                except ConnectionError:
-                    pass
+            # owner): KV-migration and prefix-pull payloads die with
+            # the request's terminal, whatever the terminal was — an
+            # exporter death cannot leak them
+            e = entries.get(key) or {}
+            for ref in (e.get("handoff_ref"), e.get("prefix_ref")):
+                if ref:
+                    try:
+                        self.client.delete(ref)
+                    except ConnectionError:
+                        pass
             if on_complete is not None:
                 on_complete(key, comp)
 
@@ -1646,6 +1828,76 @@ class Router:
                              replica=payload.get("replica"),
                              tokens=int(np.asarray(comp.tokens).size))
 
+        # 1.5) pull-mode global prefix cache: consume owner export
+        # answers, expire stalled pulls.  Resolution either way flips
+        # the entry back to prefill stage so dispatch places it — with
+        # the payload ref when the export landed, without one (full
+        # re-prefill, still exact) on any miss/timeout/owner-death.
+        pd_prefix = f"{self.ns}/pulldone/"
+        for key in self.client.keys(pd_prefix):
+            k = key[len(pd_prefix):]
+            raw = self.client.get(key)
+            if raw is None:
+                continue
+            try:
+                payload = wire.decode_record(raw, expect="pulldone",
+                                             namespace=self.ns, key=k)
+                ref = payload.get("ref")
+            except wire.WireError:
+                ref = None
+            e = entries.get(k)
+            if e is None or k in done or e.get("stage") != "pull":
+                # residue: the request resolved some other way (timeout,
+                # terminal, recovery) before the owner answered — the
+                # published payload dies here, never leaks
+                self.client.delete(key)
+                if ref:
+                    try:
+                        self.client.delete(str(ref))
+                    except ConnectionError:
+                        pass
+                continue
+            e["stage"] = "prefill"
+            e["prefix_ref"] = str(ref) if ref else None
+            e["pull_deadline"] = None
+            self._journal_pull(k, e)
+            self.client.delete(key)
+            progressed = True
+            if not ref:
+                self._obs_pull_fallbacks.inc()
+            trace = e.get("trace")
+            if trace is not None:
+                obs.events.record("pull_done", trace=trace.trace_id,
+                                  owner=e.get("pull_owner"),
+                                  ref=e.get("prefix_ref"))
+        for k, e in entries.items():
+            if k in done or e.get("stage") != "pull":
+                continue
+            owner = e.get("pull_owner")
+            deadline = e.get("pull_deadline") or 0.0
+            if owner in live and now_mono <= deadline:
+                continue
+            # stalled pull: the owner died, drained, or is just slow —
+            # stop waiting and dispatch as an ordinary prefill (the
+            # late answer, if any, is swept as residue above)
+            e["stage"] = "prefill"
+            e["prefix_ref"] = None
+            e["pull_deadline"] = None
+            self._journal_pull(k, e)
+            self._obs_pull_fallbacks.inc()
+            progressed = True
+            try:
+                self.client.delete(f"{self.ns}/pullreq/{owner}/{k}")
+            except ConnectionError:
+                pass
+            log.info("router: pull for %s from %s %s; falling back to "
+                     "re-prefill", k, owner,
+                     "timed out" if owner in live else "lost its owner")
+            trace = e.get("trace")
+            if trace is not None:
+                obs.events.record("pull_fallback", trace=trace.trace_id,
+                                  owner=owner)
+
         # 2) death detection + drain/redispatch
         verdict_lost: set[str] = set()
         if self._health is not None:
@@ -1785,7 +2037,9 @@ class Router:
         depth = {"prefill": 0, "decode": 0}
         for k2, e2 in entries.items():
             if k2 not in done and e2.get("arrived", True):
-                depth[e2.get("stage", "prefill")] += 1
+                s2 = e2.get("stage", "prefill")
+                # a pulling request is still pre-prefill work
+                depth["prefill" if s2 == "pull" else s2] += 1
         for stage, g in self._obs_stage_depth.items():
             g.set(depth[stage])
         if candidates:
@@ -1795,9 +2049,13 @@ class Router:
                     assigned_counts[e["assigned"]] = (
                         assigned_counts.get(e["assigned"], 0) + 1)
             wall = self._wall()
-            # prefix affinity summaries: one coord read per candidate
-            # per poll, shared by every dispatch decision below
-            prefix_map = self._prefix_map(candidates)
+            # prefix residency summaries: one coord read per replica
+            # per poll, shared by every dispatch decision below.  The
+            # refresh covers ALL live replicas, not just candidates — a
+            # draining or backed-off replica cannot take the request,
+            # but its resident pages can still be PULLED from it.
+            self.prefix_dir.refresh(sorted(live | set(candidates)))
+            prefix_map = self.prefix_dir.affinity(candidates)
             # the SLO predictor: the best queue-wait any candidate
             # advertises at the configured percentile — if even that
             # replica would (probably) blow a request's deadline, no
@@ -1835,14 +2093,57 @@ class Router:
                     progressed = True
                     continue
                 stage = e.get("stage", "prefill")
-                rid = self._pick(
-                    stage_cands[stage], loads, assigned_counts,
-                    # prefix affinity only steers PREFILL placement:
-                    # a decode-stage admission adopts migrated private
-                    # pages and never reads the prefix cache
-                    prefix_hash=(getattr(req, "prefix_hash", None)
-                                 if stage == "prefill" else None),
-                    prefix_map=prefix_map)
+                if stage == "pull":
+                    continue   # parked on the owner's export (step 1.5)
+                owner, cov = (None, 0)
+                if (stage == "prefill" and len(self.prefix_dir)
+                        and not e.get("pull_tried")
+                        and e.get("prefix_ref") is None):
+                    owner, cov = self.prefix_dir.best_owner(
+                        req.prompt, live=live)
+                if (owner is not None and cov >= self.pull_min_blocks
+                        and owner in stage_cands["prefill"]):
+                    # the covering replica can take the request itself:
+                    # content-based affinity placement beats any pull
+                    # (the pages are already where the request lands)
+                    rid = owner
+                elif (owner is not None
+                        and cov >= self.pull_min_blocks):
+                    # covering replica NOT dispatchable (draining,
+                    # wrong role, backed off, quarantined): park the
+                    # request in the pull stage and ask the owner to
+                    # export its pages — journal FIRST, so a crash
+                    # mid-initiation recovers an ordinary prefill
+                    e["pull_tried"] = True
+                    e["stage"] = "pull"
+                    e["pull_owner"] = owner
+                    e["pull_deadline"] = (self._clock()
+                                          + self.pull_timeout_s)
+                    self._journal_pull(k, e)
+                    self.client.set(
+                        f"{self.ns}/pullreq/{owner}/{k}",
+                        wire.encode_record("pullreq", {
+                            "key": k,
+                            "prompt": np.asarray(req.prompt)
+                            .astype(int).tolist()}))
+                    self._obs_prefix_pulls.inc()
+                    progressed = True
+                    trace = e.get("trace")
+                    if trace is not None:
+                        obs.events.record(
+                            "pull_start", trace=trace.trace_id,
+                            owner=owner, blocks=cov)
+                    continue
+                else:
+                    rid = self._pick(
+                        stage_cands[stage], loads, assigned_counts,
+                        # prefix affinity only steers PREFILL
+                        # placement: a decode-stage admission adopts
+                        # migrated private pages and never reads the
+                        # prefix cache
+                        prefix_hash=(getattr(req, "prefix_hash", None)
+                                     if stage == "prefill" else None),
+                        prefix_map=prefix_map)
                 if rid is None:
                     # this stage's pool is empty right now; the OTHER
                     # stage may still have capacity — keep scanning
@@ -1868,7 +2169,8 @@ class Router:
                 self.client.set(
                     f"{self.ns}/inbox/{rid}/{k}",
                     _encode_request(k, send,
-                                    handoff_ref=e.get("handoff_ref")))
+                                    handoff_ref=e.get("handoff_ref"),
+                                    prefix_ref=e.get("prefix_ref")))
                 e["assigned"] = rid
                 # inbox FIRST, then journal: a crash in between leaves
                 # the record open-unassigned -> recovery redispatches

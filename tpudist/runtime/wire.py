@@ -57,6 +57,10 @@ WIRE_KINDS = {
     "kv_migration": 6,  # prefill replica -> decode replica KV handoff
     #                     (ordered pages + lengths + prefix-hash chain;
     #                     see tpudist.runtime.disagg)
+    "pullreq": 7,      # router -> owner replica: export a prefix run
+    #                    from HBM/host tier for a peer (pull-mode KV)
+    "pulldone": 8,     # owner replica -> router: export finished, the
+    #                    payload's transport ref (or null on a miss)
 }
 _TAG_TO_KIND = {tag: kind for kind, tag in WIRE_KINDS.items()}
 

@@ -202,6 +202,11 @@ _FLEET_DEFAULTS: dict[str, Any] = {
     # per-replica KV capacity the sim's decode occupancy model publishes
     # through serve/kv_blocks_{used,free} (0 disables the gauges)
     "kv_blocks_total": 0,
+    # tiered-KV model (ISSUE 16): per-replica "HBM" prefix-chain
+    # capacity in 16-token blocks (0 disables the chain model entirely)
+    # and the host-tier capacity its LRU spills land in
+    "prefix_cache_blocks": 0,
+    "tier_blocks": 0,
 }
 
 
@@ -236,6 +241,12 @@ class Envelope:
     # reports one (a workload with no stamped hashes is exempt, not
     # failing at 0.0)
     min_prefix_hit_rate: float | None = None
+    # tiered-KV gate (ISSUE 16): the fleet-wide BLOCK-level reuse rate
+    # (local HBM + host-tier re-admit + peer pull, over all admitted
+    # chain blocks) a cold-heavy shared-prefix workload must sustain —
+    # checked only when the row reports one (the chain model off is
+    # exempt, not failing at 0.0)
+    min_global_hit_rate: float | None = None
     # disaggregated-serving gates (ISSUE 15): the TTFT ceiling the
     # prefill pool must hold under the mixed-length workload, and the
     # per-pool scale-up floors that prove the two control loops sized
@@ -319,6 +330,12 @@ class Envelope:
             if phr < self.min_prefix_hit_rate:
                 bad.append(f"prefix_hit_rate={phr:.4g} < min "
                            f"{self.min_prefix_hit_rate}")
+        if (self.min_global_hit_rate is not None
+                and row.get("global_hit_rate") is not None):
+            ghr = num("global_hit_rate")
+            if ghr < self.min_global_hit_rate:
+                bad.append(f"global_hit_rate={ghr:.4g} < min "
+                           f"{self.min_global_hit_rate}")
         if self.max_p99_ttft_s is not None:
             ttft = num("p99_ttft_s")
             if ttft > self.max_p99_ttft_s:
@@ -511,6 +528,37 @@ BUILTIN: dict[str, dict] = {
             # should hit (three tenants, two replicas — ≥ 0.5 is a
             # loose floor well below the steady-state rate)
             "min_prefix_hit_rate": 0.5,
+            "decisions": {"completed": {"min": 200}},
+        },
+    },
+    "cold_prefix_tenants": {
+        "name": "cold_prefix_tenants",
+        "duration_s": 30.0,
+        "arrival": {"kind": "constant", "rate": 10.0},
+        # eight tenants, each with a 4-block (64-token) system prefix:
+        # the fleet-wide prefix working set is 32 blocks, but each
+        # replica's "HBM" chain capacity holds only 12 — no single
+        # replica can keep every tenant resident, which is exactly the
+        # shape the host tier exists for.  LRU churn spills cold
+        # tenants' chains into the tier; their next request re-admits
+        # from host RAM instead of re-prefilling
+        "tenants": [
+            {"name": f"t{i}", "weight": 1.0, "prefix_tokens": 64,
+             "priority": 0} for i in range(8)
+        ],
+        "seed": 22,
+        "fleet": {"replicas": 2,
+                  "prefix_cache_blocks": 12,
+                  "tier_blocks": 64},
+        "envelope": {
+            "max_lost": 0,
+            "max_p99_queue_wait_s": 1.0,
+            # the tiered-KV gate: nearly every admitted chain block
+            # after each tenant's cold first admission must be reused
+            # (local HBM, tier re-admit, or peer pull) — without the
+            # tier the 32-block working set thrashes 12-block HBM and
+            # this floor is unreachable
+            "min_global_hit_rate": 0.8,
             "decisions": {"completed": {"min": 200}},
         },
     },

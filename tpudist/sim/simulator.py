@@ -37,6 +37,7 @@ import time
 import numpy as np
 
 from tpudist import obs
+from tpudist.models.kv_pages import chain_hashes
 from tpudist.obs.registry import values_to_hist
 from tpudist.runtime import faults, wire
 from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
@@ -104,7 +105,9 @@ class SimReplica:
                  warmup_s: float = 0.0,
                  publish_interval_s: float = 0.25,
                  wait_window_s: float = 15.0,
-                 kv_blocks_total: int = 0) -> None:
+                 kv_blocks_total: int = 0,
+                 prefix_cache_blocks: int = 0,
+                 tier_blocks: int = 0) -> None:
         self.fabric = fabric
         self.clock = clock
         self.rank = int(rank)
@@ -156,9 +159,29 @@ class SimReplica:
         # replica's prefix cache.  Published at {ns}/prefix/{rid} so the
         # ROUTER's affinity steer runs the same code path offline.
         self._affinity: dict[int, None] = {}
-        self._prefix_pub: tuple[int, ...] | None = None
+        self._prefix_pub: tuple | None = None
+        self._prefix_refresh_at = 0.0
         self.prefix_requests = 0
         self.prefix_hits = 0
+        # tiered-KV cost model (ISSUE 16): a bounded "HBM" set of
+        # resident prefix-chain block hashes (PR 14 rolling chain at the
+        # sim's fixed block size 16) plus a bounded host-tier set that
+        # catches LRU spills — the SimReplica mirror of BlockPool's
+        # prefix cache over HostTier.  Coverage of an admitted prompt's
+        # leading blocks skips that span's prefill cost, which is what
+        # makes local/tier/pull hit rates and the TTFT win measurable
+        # offline.  0 capacity disables the model (and the chains half
+        # of the prefix publish), keeping pre-tier scenarios byte-stable.
+        self.prefix_cache_blocks = int(prefix_cache_blocks)
+        self.tier_blocks = int(tier_blocks)
+        self._hbm_chains: dict[int, None] = {}   # LRU, insertion order
+        self._tier_chains: dict[int, None] = {}  # LRU, insertion order
+        self.chain_blocks_total = 0
+        self.chain_blocks_local = 0
+        self.chain_blocks_tier = 0
+        self.chain_blocks_pull = 0
+        self.tier_spills = 0
+        self.pull_exports = 0
         # registration precedes the first heartbeat, exactly like a real
         # joiner mid-warmup (the router's join grace covers this window)
         import json
@@ -204,18 +227,89 @@ class SimReplica:
 
     # -- service model -----------------------------------------------------
 
-    def _prefill_s_of(self, req) -> float:
+    def _prefill_s_of(self, req, covered_tokens: int = 0) -> float:
         prompt = int(np.asarray(req.prompt).size)
-        return self.prefill_s + prompt * self.prefill_per_token_s
+        billable = max(0, prompt - int(covered_tokens))
+        return self.prefill_s + billable * self.prefill_per_token_s
 
-    def _service_s(self, req) -> float:
+    def _service_s(self, req, covered_tokens: int = 0) -> float:
         if self.role == "prefill":
-            return self._prefill_s_of(req)
+            return self._prefill_s_of(req, covered_tokens)
         if getattr(req, "kv_handoff", None) is not None:
             # adopted pages: the prompt pass already ran upstream
             return int(req.max_new_tokens) * self.spt
-        return (self._prefill_s_of(req)
+        return (self._prefill_s_of(req, covered_tokens)
                 + int(req.max_new_tokens) * self.spt)
+
+    # -- tiered prefix-chain model (ISSUE 16) -------------------------------
+
+    def _hbm_insert(self, h: int) -> None:
+        """MRU-insert one chain hash into the bounded "HBM" set; LRU
+        overflow spills into the tier set (the sim's host-RAM spill),
+        whose own overflow drops the oldest entry outright."""
+        self._hbm_chains.pop(h, None)
+        self._hbm_chains[h] = None
+        while len(self._hbm_chains) > self.prefix_cache_blocks:
+            old = next(iter(self._hbm_chains))
+            self._hbm_chains.pop(old)
+            if self.tier_blocks > 0:
+                self.tier_spills += 1
+                self._tier_chains.pop(old, None)
+                self._tier_chains[old] = None
+                while len(self._tier_chains) > self.tier_blocks:
+                    self._tier_chains.pop(next(iter(self._tier_chains)))
+
+    def _pulled_chain(self, req) -> set[int]:
+        """The chain hashes a router-initiated peer pull delivered with
+        this request (``prefix_ref`` points at the owner's synthetic
+        export payload) — the sim analogue of ``install_prefix``."""
+        ref = getattr(req, "prefix_ref", None)
+        if ref is None or self.prefix_cache_blocks <= 0:
+            return set()
+        import json
+        try:
+            raw = self.fabric.get(str(ref))
+        except ConnectionError:
+            return set()
+        if raw is None:
+            return set()
+        try:
+            doc = json.loads(raw.decode())
+            return {int(h) for h in doc.get("chain", [])}
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return set()
+
+    def _admit_chains(self, req) -> int:
+        """Account an admission against the chain caches and return the
+        covered leading tokens (whole blocks whose KV the replica did
+        not have to recompute: HBM hit, tier re-admit, or peer pull).
+        Admitting then caches the prompt's full chain locally, exactly
+        like a real prefill populating the prefix cache."""
+        if (self.prefix_cache_blocks <= 0
+                or getattr(req, "kv_handoff", None) is not None):
+            return 0
+        chain = chain_hashes(
+            [int(t) for t in np.asarray(req.prompt).tolist()], 16)
+        if not chain:
+            return 0
+        pulled = self._pulled_chain(req)
+        covered = 0
+        for h in chain:
+            if h in self._hbm_chains:
+                self.chain_blocks_local += 1
+            elif h in self._tier_chains:
+                # tier re-admit: the page comes back from host RAM
+                self._tier_chains.pop(h)
+                self.chain_blocks_tier += 1
+            elif h in pulled:
+                self.chain_blocks_pull += 1
+            else:
+                break
+            covered += 1
+        self.chain_blocks_total += len(chain)
+        for h in chain:
+            self._hbm_insert(h)
+        return covered * 16
 
     def _kv_blocks_of(self, req) -> int:
         prompt = int(np.asarray(req.prompt).size)
@@ -298,18 +392,87 @@ class SimReplica:
                             json.dumps(snap).encode())
         except ConnectionError:
             pass   # latest-wins snapshots: the next publish catches up
-        summ = tuple(self._affinity)
-        if summ != self._prefix_pub:
-            try:
-                self.fabric.set(
-                    f"{self.ns}/prefix/{self.rid}",
-                    wire.encode_record("prefix", {
-                        "replica": self.rid,
-                        "hashes": list(summ)[-64:]}))
-                self._prefix_pub = summ
-            except ConnectionError:
-                pass
+        if self.prefix_cache_blocks > 0:
+            # tiered summary: resident chains (HBM + tier), the sim's
+            # fixed block size, weights version, and a wall stamp in the
+            # VIRTUAL wall domain — the router's PrefixDirectory runs on
+            # the injected VirtualClock.wall, so its TTL measures these
+            # stamps in sim-seconds.  Age-based republish keeps a
+            # steady-state summary from going stale mid-scenario.
+            summ = (tuple(self._affinity), tuple(self._hbm_chains),
+                    tuple(self._tier_chains))
+            if (summ != self._prefix_pub
+                    or now >= self._prefix_refresh_at):
+                try:
+                    self.fabric.set(
+                        f"{self.ns}/prefix/{self.rid}",
+                        wire.encode_record("prefix", {
+                            "replica": self.rid,
+                            "hashes": list(summ[0])[-64:],
+                            "chains": list(self._hbm_chains),
+                            "tiered": list(self._tier_chains),
+                            "block_size": 16,
+                            "version": 0,
+                            "at": self.clock.wall()}))
+                    self._prefix_pub = summ
+                    self._prefix_refresh_at = now + 5.0
+                except ConnectionError:
+                    pass
+        else:
+            summ = tuple(self._affinity)
+            if summ != self._prefix_pub:
+                try:
+                    self.fabric.set(
+                        f"{self.ns}/prefix/{self.rid}",
+                        wire.encode_record("prefix", {
+                            "replica": self.rid,
+                            "hashes": list(summ)[-64:]}))
+                    self._prefix_pub = summ
+                except ConnectionError:
+                    pass
         self._next_pub = now + self.publish_interval_s
+
+    def _serve_pulls(self) -> None:
+        """Answer the router's pull-mode KV export requests
+        (``{ns}/pullreq/{rid}/``): compute the leading chain run this
+        replica actually holds (HBM or tier), publish a synthetic
+        payload carrying those hashes, and commit the pulldone record —
+        the SimReplica mirror of ``ReplicaWorker._serve_pulls`` over
+        ``ServeLoop.export_prefix``.  A run it does not hold commits
+        ``ref=None`` (the requester re-prefills, byte-identically)."""
+        import json
+        for key in sorted(self.fabric.keys(
+                f"{self.ns}/pullreq/{self.rid}/")):
+            raw = self.fabric.get(key)
+            self.fabric.delete(key)
+            if raw is None:
+                continue
+            try:
+                doc = wire.decode_record(raw, expect="pullreq",
+                                         namespace=self.ns, key=key,
+                                         replica=self.rid)
+            except wire.WireError:
+                continue
+            k = str(doc.get("key"))
+            chain = chain_hashes(
+                [int(t) for t in doc.get("prompt", [])], 16)
+            run: list[int] = []
+            for h in chain:
+                if h in self._hbm_chains or h in self._tier_chains:
+                    run.append(h)
+                else:
+                    break
+            ref = None
+            if run:
+                ref = f"{self.ns}/kv/pull-{k}"
+                self.fabric.set(ref, json.dumps(
+                    {"chain": run, "block_size": 16,
+                     "version": 0}).encode())
+                self.pull_exports += 1
+            self.fabric.set(
+                f"{self.ns}/pulldone/{k}",
+                wire.encode_record("pulldone", {
+                    "key": k, "ref": ref, "owner": self.rid}))
 
     def step(self) -> None:
         """Advance the replica to the clock's current instant: go live
@@ -353,6 +516,8 @@ class SimReplica:
                 if raw is None:
                     continue
                 self._queue.append((_decode_request(raw), now))
+
+            self._serve_pulls()
         except ConnectionError:
             inbox = f"{self.ns}/inbox/{self.rid}/"
 
@@ -360,7 +525,7 @@ class SimReplica:
         # per step when service times are shorter than the quantum
         while True:
             if self._cur is not None:
-                req, enq_t, start, finish_at = self._cur
+                req, enq_t, start, finish_at, covered = self._cur
                 if now < finish_at:
                     break
                 if self.role == "prefill":
@@ -376,7 +541,8 @@ class SimReplica:
                         # unified service: the first token landed when
                         # this replica's own prompt pass finished
                         self.all_ttfts.append(
-                            start + self._prefill_s_of(req) - enq_t)
+                            start + self._prefill_s_of(req, covered)
+                            - enq_t)
                     self._commit(req, "length",
                                  list(range(int(req.max_new_tokens))))
                 self._cur = None
@@ -401,12 +567,14 @@ class SimReplica:
                 self._affinity[int(phash)] = None
                 while len(self._affinity) > 128:
                     self._affinity.pop(next(iter(self._affinity)))
+            covered = self._admit_chains(req)
             if req.trace is not None:
                 obs.events.record("admit", trace=req.trace.trace_id,
                                   replica=self.rid,
                                   queue_wait_s=round(wait, 6),
                                   prefix_hit=hit)
-            self._cur = (req, enq_t, now, now + self._service_s(req))
+            self._cur = (req, enq_t, now,
+                         now + self._service_s(req, covered), covered)
 
         if now >= self._next_pub:
             self._publish()
@@ -555,7 +723,10 @@ class FleetSim:
                       else warmup_s),
             publish_interval_s=float(fleet["publish_interval_s"]),
             wait_window_s=float(fleet["wait_window_s"]),
-            kv_blocks_total=int(fleet.get("kv_blocks_total") or 0))
+            kv_blocks_total=int(fleet.get("kv_blocks_total") or 0),
+            prefix_cache_blocks=int(
+                fleet.get("prefix_cache_blocks") or 0),
+            tier_blocks=int(fleet.get("tier_blocks") or 0))
         if warmup_s == 0.0:
             r.step()   # live (and publishing) before the first poll
         self.replicas.append(r)
@@ -662,6 +833,20 @@ class FleetSim:
         hits = sum(r.prefix_hits for r in self.replicas)
         return round(hits / req_n, 4)
 
+    def _global_hit_rate(self) -> float | None:
+        """Fleet-wide BLOCK-level KV reuse under the tiered model:
+        prompt chain blocks whose pages were already somewhere the
+        fleet could reuse them (local HBM, host tier re-admit, or a
+        peer pull) over all chain blocks admitted.  ``None`` when no
+        replica ran the chain model (``prefix_cache_blocks`` unset) —
+        same vacuous-bound discipline as ``prefix_hit_rate``."""
+        total = sum(r.chain_blocks_total for r in self.replicas)
+        if total == 0:
+            return None
+        covered = sum(r.chain_blocks_local + r.chain_blocks_tier
+                      + r.chain_blocks_pull for r in self.replicas)
+        return round(covered / total, 4)
+
     def _summarize(self, reqs, comps, base: dict, wall_s: float) -> dict:
         spec = self.spec
         reasons: dict[str, int] = {}
@@ -746,6 +931,20 @@ class FleetSim:
             "prefix_hit_rate": self._prefix_hit_rate(),
             "prefix_affinity_dispatches": delta.get(
                 "router/prefix_affinity", 0.0),
+            # tiered-KV accounting (ISSUE 16): block-level reuse across
+            # the whole fleet memory hierarchy, its local/tier/pull
+            # split, and the pull-mode traffic the router initiated
+            "global_hit_rate": self._global_hit_rate(),
+            "tier_hit_blocks": sum(r.chain_blocks_tier
+                                   for r in self.replicas),
+            "pull_hit_blocks": sum(r.chain_blocks_pull
+                                   for r in self.replicas),
+            "tier_spills": sum(r.tier_spills for r in self.replicas),
+            "prefix_pulls": delta.get("router/prefix_pulls", 0.0),
+            "prefix_pull_fallbacks": delta.get(
+                "router/prefix_pull_fallbacks", 0.0),
+            "prefix_stale_skips": delta.get(
+                "router/prefix_stale_skips", 0.0),
         }
         for reason in ("completed", "shed", "rejected", "failed",
                        "timeout"):
