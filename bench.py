@@ -41,10 +41,22 @@ _EMITTED: list[dict] = []  # every metric line, re-printed in the recap
 # which bench — so a BENCH_*.json artifact is self-describing when it
 # is compared across runs.  Schema 2 = schema 1 + these four keys;
 # schema 3 adds `injected` (ISSUE 13): the fault plan's nonzero
-# injection tallies, so chaos rows carry their own cause.
-_BENCH_SCHEMA = 3
+# injection tallies, so chaos rows carry their own cause.  Schema 4
+# adds `alert_rules_hash` (ISSUE 17): the content hash of the shipped
+# default alert-rule set, so a row that says "these alerts fired" also
+# says which rule definitions it fired under.
+_BENCH_SCHEMA = 4
 _GIT_SHA: str | None | bool = False   # False = not resolved yet
 _CURRENT_BENCH: str | None = None
+_RULES_HASH: str | None = None
+
+
+def _alert_rules_hash() -> str:
+    global _RULES_HASH
+    if _RULES_HASH is None:
+        from tpudist.obs.alerts import default_rules, rules_hash
+        _RULES_HASH = rules_hash(default_rules())
+    return _RULES_HASH
 
 
 def _git_sha() -> str | None:
@@ -83,7 +95,8 @@ def _emit(metric, value, unit, vs_baseline=None, **extra) -> None:
     injected = {k: v for k, v in _faults.plan().injected.items() if v}
     prov = {"bench_schema": _BENCH_SCHEMA, "git_sha": _git_sha(),
             "seed": _bench_seed(), "bench": _CURRENT_BENCH,
-            "injected": injected}
+            "injected": injected,
+            "alert_rules_hash": _alert_rules_hash()}
     extra.update((k, v) for k, v in prov.items() if k not in extra)
     line = jsonl_line(metric, value, unit, vs_baseline, **extra)
     _EMITTED.append(json.loads(line))
@@ -2595,6 +2608,63 @@ def bench_scenario_matrix(on_tpu: bool) -> None:
               **{k: v for k, v in row.items() if k != "completed_ok"})
 
 
+def bench_serve_alerts(on_tpu: bool) -> None:
+    """Alert-plane regression row (ISSUE 17): the headline scenarios
+    run through the offline simulator with the REAL scrape -> TSDB ->
+    rule-evaluation path on the virtual clock, and the recorded live
+    fixture replays through the alert-driven autoscaler.  The row
+    carries: the per-scenario fired sets, the steady-state
+    false-positive count (must be 0), whether every scenario fired
+    EXACTLY its envelope's must-fire set, and whether the fixture
+    replay reproduced the recorded scale-up decision sequence now that
+    the breach signals route through the AlertManager."""
+    import os
+
+    from tpudist.sim.scenario import builtin
+    from tpudist.sim.simulator import FleetSim
+
+    scenarios = ("steady_state", "coord_brownout",
+                 "replica_death_storm", "cold_prefix_tenants")
+    fired: dict[str, list[str]] = {}
+    must_fire_ok = True
+    for name in scenarios:
+        spec = builtin(name)
+        row = FleetSim(spec).run()
+        fired[name] = row["alerts_fired"]
+        want = sorted(spec.envelope.alerts.get("must_fire") or [])
+        if row["alerts_fired"] != want or not row["envelope_ok"]:
+            must_fire_ok = False
+    steady_false_positives = len(fired["steady_state"])
+
+    # the autoscaler-consumer gate: the recorded live run must replay
+    # to the same decisions with breach detection routed through the
+    # alert interface (None = fixture not checked in; CI asserts True)
+    decision_match = None
+    fixture = os.path.join(os.path.dirname(__file__), "tests", "data",
+                           "sim_replay_fixture.json")
+    if os.path.exists(fixture):
+        with open(fixture) as f:
+            fx = json.load(f)
+        sim = FleetSim.from_trace(fx["events"],
+                                  autoscale=fx["autoscale"], replicas=1)
+        sim.run()
+        live_ups = sum(1 for a in fx["action_seq"] if a["kind"] == "up")
+        sim_actions = sim.scaler.action_seq()
+        sim_ups = sum(1 for a in sim_actions if a["kind"] == "up")
+        target = fx["autoscale"]["target_wait_s"]
+        live_rel = _first_up_rel(fx["decision_log"], fx["action_seq"],
+                                 target)
+        sim_rel = _first_up_rel(sim.scaler.decision_log, sim_actions,
+                                target)
+        decision_match = bool(
+            sim_ups == live_ups and live_rel is not None
+            and sim_rel is not None and abs(live_rel - sim_rel) <= 1)
+
+    _emit("serve_alerts", int(must_fire_ok), "ok", None,
+          fired=fired, steady_false_positives=steady_false_positives,
+          must_fire_ok=must_fire_ok, decision_match=decision_match)
+
+
 def _first_up_rel(decision_log, action_seq, target_wait_s):
     """Polls between the first breach observation and the first
     scale-up — the hysteresis distance both execution paths must agree
@@ -3636,7 +3706,7 @@ def main() -> None:
                bench_sim_replay, bench_router_failover,
                bench_coord_brownout, bench_corruption_quarantine,
                bench_serve_prefix_batching, bench_serve_disagg,
-               bench_kv_tier]
+               bench_kv_tier, bench_serve_alerts]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

@@ -351,7 +351,9 @@ def _passing_row(name: str) -> dict:
             "corrupted_terminals": 0,
             "burn_rate_300s": 0.0,
             "decisions_completed": 500,
-            "decisions_failed": 0, "envelope_ok": True,
+            "decisions_failed": 0,
+            "alerts_fired": sorted(env.alerts.get("must_fire") or []),
+            "envelope_ok": True,
             "violations": []}
 
 

@@ -24,6 +24,16 @@ The subsystem every layer reports through (see docs/OBSERVABILITY.md):
   SLO burn-rate accounting (:class:`SLOTracker`).
 * :mod:`tpudist.obs.xla` — XLA compile/memory/cost telemetry: compile
   counts and durations, per-device HBM gauges, live MFU.
+* :mod:`tpudist.obs.tsdb` — bounded in-memory time-series store scraped
+  from the registry/merged snapshots on a cadence, with
+  rate/delta/quantile_over_time queries (:class:`TSDB`,
+  :class:`FleetScraper`).
+* :mod:`tpudist.obs.alerts` — declarative alert rules (query +
+  predicate + hold) with a pending->firing->resolved lifecycle; the
+  sim's scenario matrix regression-tests the shipped defaults.
+* :mod:`tpudist.obs.console` — ``python -m tpudist.obs.console``: live
+  terminal dashboard (topology, sparklines, firing alerts, recent
+  trace terminals); ``--once`` renders a single frame for CI.
 
 Module-level conveniences bind to one process-global registry, tracer and
 flight recorder, so library code can just ``from tpudist import obs;
@@ -41,6 +51,14 @@ from tpudist.obs.aggregate import (
     collect,
     collect_and_merge,
     merge_snapshots,
+)
+from tpudist.obs.alerts import (
+    AlertManager,
+    AlertRule,
+    autoscale_rules,
+    default_rules,
+    load_rules,
+    rules_hash,
 )
 from tpudist.obs.events import (
     EventPublisher,
@@ -70,6 +88,7 @@ from tpudist.obs.registry import (
     summarize,
 )
 from tpudist.obs.spans import SpanTracer, atomic_write_json
+from tpudist.obs.tsdb import TSDB, FleetScraper
 from tpudist.obs.xla import (
     install_compile_telemetry,
     mfu,
@@ -80,8 +99,11 @@ from tpudist.obs.xla import (
 )
 
 __all__ = [
+    "AlertManager",
+    "AlertRule",
     "Counter",
     "EventPublisher",
+    "FleetScraper",
     "FlightRecorder",
     "Gauge",
     "HealthMonitor",
@@ -94,12 +116,15 @@ __all__ = [
     "RequestEventLog",
     "SLOTracker",
     "SpanTracer",
+    "TSDB",
     "TraceContext",
     "atomic_write_json",
+    "autoscale_rules",
     "collect",
     "collect_and_merge",
     "collect_events",
     "counter",
+    "default_rules",
     "events",
     "gauge",
     "group_timelines",
@@ -108,6 +133,7 @@ __all__ = [
     "install_compile_telemetry",
     "is_complete",
     "jsonl_line",
+    "load_rules",
     "merge_events",
     "merge_snapshots",
     "mfu",
@@ -116,6 +142,7 @@ __all__ = [
     "peak_tflops",
     "recorder",
     "registry",
+    "rules_hash",
     "slo",
     "snapshot",
     "snapshot_to_jsonl",

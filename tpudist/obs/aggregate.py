@@ -107,7 +107,8 @@ class MetricsPublisher:
 
 
 def collect(client, namespace: str = DEFAULT_NAMESPACE,
-            max_age_s: float | None = None) -> dict[int, dict]:
+            max_age_s: float | None = None,
+            members: "set[int] | None" = None) -> dict[int, dict]:
     """Fetch every published snapshot: {rank: snapshot}.  Keys listed but
     deleted between list and get (a departing worker) are skipped.
 
@@ -117,11 +118,26 @@ def collect(client, namespace: str = DEFAULT_NAMESPACE,
     died in a previous elastic round leaves its last snapshot in the KV
     store forever, and merging it would silently distort the cluster
     view.  The health plane collects WITHOUT a cutoff and classifies the
-    stale ranks instead."""
+    stale ranks instead.
+
+    ``members`` is the stronger, membership-based cutoff: when given
+    (ranks currently registered in ``{ns}/replica/*``), snapshots from
+    any OTHER rank are dropped regardless of age.  A publisher that
+    departs mid-histogram-window otherwise leaves its last generation
+    pinned in merged quantiles until ``max_age_s`` — up to an entire
+    collection window of a dead replica's queue waits steering the
+    autoscaler.  ``None`` means "no membership information", not "no
+    members": collection stays age-based only."""
     out: dict[int, dict] = {}
     prefix = namespace + "/"
     now = time.time()
     for key in client.keys(prefix):
+        if members is not None:
+            try:
+                if int(key[len(prefix):]) not in members:
+                    continue
+            except ValueError:
+                continue
         raw = client.get(key)
         if raw is None:
             continue

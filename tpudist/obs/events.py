@@ -426,15 +426,32 @@ class SLOTracker:
         if self._good is not None:
             (self._good if good else self._bad).inc()
             self._cls_counters[(cls, bool(good))].inc()
-        for w, rate in self.burn_rates().items():
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        """Gauges mirror :meth:`burn_rates`, except a window with zero
+        traffic reads ABSENT (``Gauge.clear``), not 0.0: a time-series
+        consumer averaging/alerting over the gauge must not dilute real
+        burn with idle-window zeros.  The method API keeps returning
+        0.0 (no evidence is not a breach for control loops)."""
+        for w in self.windows:
             g = self._gauges.get(w)
             if g is not None:
-                g.set(rate)
+                good, bad = self.counts(w)
+                if good + bad:
+                    g.set((bad / (good + bad)) / self._budget)
+                else:
+                    g.clear()
         for c in SLO_CLASSES:
-            for w, rate in self.burn_rates(cls=c).items():
+            for w in self.windows:
                 g = self._cls_gauges.get((w, c))
-                if g is not None:
-                    g.set(rate)
+                if g is None:
+                    continue
+                good, bad = self.counts(w, cls=c)
+                if good + bad:
+                    g.set((bad / (good + bad)) / self._budget)
+                else:
+                    g.clear()
 
     def counts(self, window_s: float,
                cls: str | None = None) -> tuple[int, int]:
@@ -463,3 +480,7 @@ class SLOTracker:
     def clear(self) -> None:
         with self._lock:
             self._obs.clear()
+        # burn gauges go back to absent too — a fresh run (each sim
+        # scenario clears the tracker) must not scrape the previous
+        # run's final burn rate as if it were current
+        self._refresh_gauges()

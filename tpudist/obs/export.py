@@ -20,7 +20,7 @@ import json
 import re
 import threading
 
-from tpudist.obs.registry import summarize
+from tpudist.obs.registry import split_labels, summarize
 
 __all__ = ["jsonl_line", "snapshot_to_jsonl", "to_prometheus",
            "MetricsServer"]
@@ -65,20 +65,9 @@ def _prom_name(name: str) -> str:
     return "_" + out if out[:1].isdigit() else out
 
 
-def _split_labels(name: str) -> tuple[str, dict[str, str]]:
-    """Registry names may carry labels as ``~key=value`` suffixes
-    (e.g. ``slo/good~class=priority`` from the per-class SLO split);
-    the flat registry stays label-free while Prometheus consumers get
-    real label sets.  Returns (base name, {label: value})."""
-    base, *parts = name.split("~")
-    labels: dict[str, str] = {}
-    for p in parts:
-        k, _, v = p.partition("=")
-        if k and v:
-            labels[k] = v
-        else:
-            base += "~" + p   # not a label suffix; keep it in the name
-    return base, labels
+# label parsing lives with the registry now (the TSDB and the name
+# validator share it); kept as an alias for older imports.
+_split_labels = split_labels
 
 
 def _prom_num(v) -> str:
@@ -112,10 +101,18 @@ def to_prometheus(snapshot: dict) -> str:
             h = h.replace("\\", "\\\\").replace("\n", "\\n")
             out.append(f"# HELP {pname} {h}")
 
+    def label_value(v: str) -> str:
+        # exposition-format escapes for label values: backslash, the
+        # double quote, and newline (anything else passes through —
+        # '/' and '=' are legal inside a quoted label value)
+        return (v.replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def label_str(labels: dict[str, str]) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        inner = ",".join(f'{k}="{label_value(v)}"'
+                         for k, v in sorted(labels.items()))
         return "{" + inner + "}"
 
     def scalar_lines(pname: str, labels: dict, m: dict) -> None:
@@ -145,25 +142,30 @@ def to_prometheus(snapshot: dict) -> str:
         type_line(pname, "gauge", m)
         scalar_lines(pname, labels, m)
     for name, h in snapshot.get("histograms", {}).items():
-        pname = _prom_name(name)
-        help_line(pname, h)
-        out.append(f"# TYPE {pname} histogram")
+        base, labels = split_labels(name)
+        pname = _prom_name(base)
+        if pname not in typed:
+            typed.add(pname)
+            help_line(pname, h)
+            out.append(f"# TYPE {pname} histogram")
         growth = h["growth"]
         cum = h.get("zero", 0)
         for idx in sorted(int(i) for i in h["buckets"]):
             cum += h["buckets"][str(idx)]
-            out.append(
-                f'{pname}_bucket{{le="{_prom_num(growth ** (idx + 1))}"}} '
-                f"{cum}")
-        out.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
-        out.append(f"{pname}_sum {_prom_num(h['sum'])}")
-        out.append(f"{pname}_count {h['count']}")
+            le = label_str({**labels, "le": _prom_num(growth ** (idx + 1))})
+            out.append(f"{pname}_bucket{le} {cum}")
+        out.append(
+            f'{pname}_bucket{label_str({**labels, "le": "+Inf"})} '
+            f'{h["count"]}')
+        out.append(f"{pname}_sum{label_str(labels)} {_prom_num(h['sum'])}")
+        out.append(f"{pname}_count{label_str(labels)} {h['count']}")
     return "\n".join(out) + "\n"
 
 
 # -- HTTP /metrics ----------------------------------------------------------
 
-_KNOWN_PATHS = ("/metrics", "/metrics.json", "/healthz")
+_KNOWN_PATHS = ("/metrics", "/metrics.json", "/healthz", "/alerts",
+                "/tsdb")
 
 
 class MetricsServer:
@@ -178,18 +180,28 @@ class MetricsServer:
     not yet known), 503 once it is degraded — the role the reference's
     Docker HEALTHCHECK plays, but cluster-aware.  Unknown paths get a
     real 404 with a JSON body listing the endpoints.  Runs in a daemon
-    thread; :meth:`close` shuts it down."""
+    thread; :meth:`close` shuts it down.
+
+    With ``alerts`` (an :class:`tpudist.obs.alerts.AlertManager`) the
+    server additionally exposes ``/alerts`` — active/resolved alerts +
+    the loaded rule set and its hash; with ``tsdb`` (a
+    :class:`tpudist.obs.tsdb.TSDB`) it exposes ``/tsdb`` — per-series
+    points and store stats (``?match=substr`` filters series,
+    ``?window_s=60`` bounds the lookback)."""
 
     def __init__(self, registry=None, snapshot_fn=None, host: str = "",
-                 port: int = 0, health_fn=None) -> None:
+                 port: int = 0, health_fn=None, alerts=None,
+                 tsdb=None) -> None:
         if (registry is None) == (snapshot_fn is None):
             raise ValueError("pass exactly one of registry / snapshot_fn")
         snap = snapshot_fn or registry.snapshot
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlsplit
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - http.server API
-                path = self.path.split("?")[0]
+                split = urlsplit(self.path)
+                path = split.path
                 status = 200
                 if path == "/metrics":
                     body = to_prometheus(snap()).encode("utf-8")
@@ -203,6 +215,17 @@ class MetricsServer:
                     status = 503 if verdict.get("status") == "degraded" \
                         else 200
                     body = json.dumps(verdict).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/alerts" and alerts is not None:
+                    body = json.dumps(alerts.to_doc()).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/tsdb" and tsdb is not None:
+                    q = parse_qs(split.query)
+                    window = q.get("window_s", [None])[0]
+                    doc = tsdb.to_doc(
+                        match=q.get("match", [None])[0],
+                        window_s=float(window) if window else None)
+                    body = json.dumps(doc).encode("utf-8")
                     ctype = "application/json"
                 else:
                     status = 404

@@ -38,11 +38,72 @@ __all__ = [
     "Histogram",
     "MetricRegistry",
     "hist_quantile",
+    "split_labels",
     "summarize",
+    "validate_metric_name",
     "values_to_hist",
 ]
 
 _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+# characters a label key/value may not contain: "~" would re-split on
+# the wire, "=" in a value would mis-parse the pair, and quote/backslash
+# /newline would need escaping in the Prometheus exposition format.
+_LABEL_BANNED = ("~", "=", '"', "\\", "\n")
+
+
+def split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """Split ``base~key=value~k2=v2`` into (base, labels).
+
+    Lenient by design — this is the READ path used by exporters and the
+    TSDB on names that may predate validation: a ``~`` part without
+    ``=`` is folded back into the base name instead of being dropped.
+    The WRITE path (:func:`validate_metric_name`, enforced by
+    :class:`MetricRegistry`) rejects such names outright, so new
+    metrics round-trip exactly."""
+    if "~" not in name:
+        return name, {}
+    base, *parts = name.split("~")
+    labels: dict[str, str] = {}
+    for part in parts:
+        key, sep, value = part.partition("=")
+        if sep and key:
+            labels[key] = value
+        else:
+            base = f"{base}~{part}"     # not a k=v tag; keep it literal
+    return base, labels
+
+
+def validate_metric_name(name: str) -> None:
+    """Reject metric names whose ``~key=value`` suffixes would not
+    round-trip through the snapshot wire format and the Prometheus
+    exporter: every ``~`` part must be ``key=value``, keys must be
+    identifier-ish, and values may not contain ``~ = " \\`` or
+    newlines (a value like ``a=b`` or ``x~y`` would silently mis-split
+    on read — reject at registration instead)."""
+    if not name:
+        raise ValueError("metric name must be non-empty")
+    if "~" not in name:
+        return
+    base, *parts = name.split("~")
+    if not base:
+        raise ValueError(f"metric {name!r}: empty base name before '~'")
+    for part in parts:
+        key, sep, value = part.partition("=")
+        if not sep or not key:
+            raise ValueError(
+                f"metric {name!r}: label part {part!r} is not key=value "
+                f"(a '~' in a metric name starts a label tag)")
+        if not key.replace("_", "").isalnum():
+            raise ValueError(
+                f"metric {name!r}: label key {key!r} must be "
+                f"alphanumeric/underscore")
+        bad = [c for c in _LABEL_BANNED if c in value]
+        if bad:
+            raise ValueError(
+                f"metric {name!r}: label value {value!r} contains "
+                f"{bad!r} which cannot round-trip the wire format "
+                f"(escape or drop these characters at the call site)")
 
 
 def _is_plain(v) -> bool:
@@ -126,6 +187,14 @@ class Gauge:
     def value(self) -> float | None:
         self._fold(_sync_pending({"v": self._take_pending()})["v"])
         return self._value
+
+    def clear(self) -> None:
+        """Back to absent: the next snapshot reports ``value: null``
+        (aggregation skips it, the TSDB records nothing).  Lets a
+        conditional signal — e.g. an SLO burn rate with zero traffic in
+        its window — read as "no data" instead of a literal 0.0."""
+        self._pending = []
+        self._value = None
 
     def _snap(self) -> dict:
         snap = {"value": self._value, "unit": self.unit}
@@ -343,6 +412,7 @@ class MetricRegistry:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
+                validate_metric_name(name)
                 m = self._metrics[name] = cls(name, **kwargs)
             elif type(m) is not cls:
                 raise ValueError(

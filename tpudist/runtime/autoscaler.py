@@ -55,7 +55,9 @@ from typing import Callable, Sequence
 
 from tpudist import obs
 from tpudist.obs.aggregate import collect, merge_snapshots
+from tpudist.obs.alerts import AlertManager, autoscale_rules
 from tpudist.obs.registry import hist_quantile
+from tpudist.obs.tsdb import TSDB
 from tpudist.runtime import faults
 from tpudist.runtime.coord import CoordClient
 from tpudist.runtime.router import DEFAULT_NAMESPACE, scale_fleet
@@ -295,6 +297,17 @@ class Autoscaler:
             f"autoscale/burn_rate{tag}", unit="x",
             help="SLO burn rate the scaling decision saw (max of fleet "
                  "gauges and the local tracker's shortest window)")
+        # breach predicates live in a declarative rule set evaluated
+        # over a private per-poll TSDB (tpudist.obs.alerts): each poll
+        # records what it observed as autoscale/* series and reads
+        # which rules fire instead of re-implementing thresholds
+        # inline.  Absent signals (no KV/tier gauges published) are
+        # recorded as NaN — present but matching no predicate — so
+        # "signal missing" can never read as a stale previous value.
+        self._tsdb = TSDB(retention_s=600.0, resolution_s=0.001,
+                          downsample_after_s=60.0, clock=self._clock)
+        self.alerts = AlertManager(self._tsdb, autoscale_rules(self.cfg),
+                                   clock=self._clock)
 
     def _default_spawner(self, n: int) -> list:
         args = list(self.replica_args)
@@ -350,9 +363,25 @@ class Autoscaler:
         live = self.live()
         draining = self.draining()
         quarantined = self.quarantined()
+        regs = self._registrations()
+        # membership cutoff: only ranks still registered in
+        # {ns}/replica/* contribute snapshots.  A departed publisher's
+        # final sliding-window histogram otherwise stays pinned in the
+        # merged quantiles until max_age_s — a dead replica's queue
+        # waits steering live scaling decisions.  No registrations at
+        # all means no membership information (a bare metrics-only
+        # fleet): fall back to the age cutoff alone.
+        members: set[int] | None = None
+        if regs:
+            members = set()
+            for info in regs.values():
+                try:
+                    members.add(int(info.get("rank")))
+                except (TypeError, ValueError):
+                    continue
         snaps = collect(self.client, f"{self.ns}/metrics",
-                        max_age_s=self.cfg.max_metric_age_s)
-        regs = self._registrations() if self.pool is not None else {}
+                        max_age_s=self.cfg.max_metric_age_s,
+                        members=members)
         mine = self._pool_rids(regs)
         if mine is not None:
             live &= mine
@@ -510,23 +539,35 @@ class Autoscaler:
         now = self._clock()
         action = None
 
-        burning = (self.cfg.max_burn_rate is not None
-                   and view["burn_rate"] > self.cfg.max_burn_rate)
+        # breach detection reads FIRED ALERTS, not inline thresholds:
+        # the poll records its observations into the private TSDB and
+        # the rule set from autoscale_rules(cfg) — the same engine the
+        # fleet operator rules run on — says which pressures hold.
+        # Missing signals are NaN samples (match no predicate), so the
+        # decision is identical to the historical inline comparisons.
+        nan = float("nan")
+        self._tsdb.record("autoscale/wait_q", view["wait_q"], t=now)
+        self._tsdb.record("autoscale/burn_rate", view["burn_rate"], t=now)
+        self._tsdb.record(
+            "autoscale/kv_free_frac",
+            view["kv_free_frac"] if view["kv_free_frac"] is not None
+            else nan, t=now)
+        self._tsdb.record(
+            "autoscale/tier_headroom_frac",
+            view["tier_headroom_frac"]
+            if view["tier_headroom_frac"] is not None else nan, t=now)
+        self.alerts.evaluate(now)
+        fired = {a["rule"] for a in self.alerts.firing()}
+        burning = "AutoscaleBurnRate" in fired
         # decode-pool pressure: resident KV, not queue wait — scale up
         # BEFORE admissions stall on pages
-        starved = (self.cfg.min_kv_free_frac is not None
-                   and view["kv_free_frac"] is not None
-                   and view["kv_free_frac"] < self.cfg.min_kv_free_frac)
+        starved = "AutoscaleKVStarved" in fired
         # tiered-KV pressure: spill tiers nearly full means warm
         # prefixes are about to be DISCARDED, not spilled — the
         # re-prefill load arrives before queue wait shows it
-        tier_pressed = (
-            self.cfg.min_tier_headroom_frac is not None
-            and view["tier_headroom_frac"] is not None
-            and view["tier_headroom_frac"]
-            < self.cfg.min_tier_headroom_frac)
-        if (view["wait_q"] > self.cfg.target_wait_s or burning
-                or starved or tier_pressed):
+        tier_pressed = "AutoscaleTierPressure" in fired
+        if "AutoscaleQueueWait" in fired or burning \
+                or starved or tier_pressed:
             self._breach += 1
             self._idle = 0
         elif (view["wait_q"] < self.cfg.low_wait_s

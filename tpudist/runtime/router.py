@@ -928,12 +928,19 @@ class Router:
                  quarantine: bool = True,
                  golden_probe: GoldenProbe | None = None,
                  quarantine_config: QuarantineConfig | None = None,
+                 alerts=None,
                  clock=time.monotonic,
                  wall=time.time,
                  sleeper=time.sleep) -> None:
         self.client = client
         self.ns = namespace
         self.poll_s = float(poll_s)
+        # optional AlertManager (tpudist.obs.alerts): when wired, the
+        # router reads fleet-level degradation through the declarative
+        # alert interface — a firing FleetDegraded rule arms the same
+        # admission clamp a replica-advertised degraded flag does —
+        # instead of growing another bespoke threshold probe
+        self.alerts = alerts
         # injectable time sources: the offline fleet simulator
         # (tpudist.sim) runs this SAME event loop against a virtual
         # clock whose sleeper advances simulated replicas instead of
@@ -2019,9 +2026,15 @@ class Router:
                       and not loads.get(rid, {}).get("swapping")]
         # fleet-wide overload state: any candidate replica in degraded
         # mode puts the ROUTER in degraded mode too — new best-effort
-        # dispatches get their budgets clamped at the wire
-        degraded = any(loads.get(rid, {}).get("degraded")
-                       for rid in candidates)
+        # dispatches get their budgets clamped at the wire.  A wired
+        # alert plane contributes through the same switch: a firing
+        # FleetDegraded rule (merged serve/degraded > 0 in the TSDB)
+        # arms the clamp even when the advertising replica is not a
+        # current candidate.
+        degraded = (any(loads.get(rid, {}).get("degraded")
+                        for rid in candidates)
+                    or (self.alerts is not None
+                        and self.alerts.is_firing("FleetDegraded")))
         self._obs_degraded.set(1.0 if degraded else 0.0)
         # two-stage scheduling: fresh (prefill-stage) requests go to
         # prefill/both replicas, handoff (decode-stage) requests to

@@ -207,6 +207,11 @@ _FLEET_DEFAULTS: dict[str, Any] = {
     # and the host-tier capacity its LRU spills land in
     "prefix_cache_blocks": 0,
     "tier_blocks": 0,
+    # observability plane (ISSUE 17): cadence of the real
+    # scrape->TSDB->alert-rules path every sim runs on the virtual
+    # clock (the default rule set from tpudist.obs.alerts; the
+    # scenario's envelope.alerts pins which rules must/must not fire)
+    "alert_scrape_s": 1.0,
 }
 
 
@@ -255,6 +260,13 @@ class Envelope:
     min_scale_ups_prefill: int = 0
     min_scale_ups_decode: int = 0
     decisions: dict = field(default_factory=dict)
+    # alert-envelope (ISSUE 17): which alert RULES the run's real
+    # scrape->TSDB->evaluate path must (and must not) have fired, read
+    # from the row's ``alerts_fired`` list.  ``{"must_fire":
+    # ["CoordOutage"], "must_not_fire": "*"}`` — the "*" wildcard means
+    # any fired rule outside must_fire is a violation (the
+    # zero-false-positive gate steady_state runs under).
+    alerts: dict = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: dict) -> "Envelope":
@@ -264,6 +276,12 @@ class Envelope:
         for reason, bound in dec.items():
             _check_keys(f"envelope.decisions[{reason!r}]", bound,
                         {"min", "max"})
+        al = d.get("alerts", {})
+        _check_keys("envelope.alerts", al, {"must_fire", "must_not_fire"})
+        mnf = al.get("must_not_fire", [])
+        _require(mnf == "*" or isinstance(mnf, (list, tuple)),
+                 "envelope.alerts.must_not_fire must be a rule list "
+                 "or the wildcard \"*\"")
         return cls(**d)
 
     def check(self, row: dict) -> list[str]:
@@ -353,6 +371,29 @@ class Envelope:
                 bad.append(f"decisions_{reason}={v:g} < min {lo}")
             if hi is not None and v > hi:
                 bad.append(f"decisions_{reason}={v:g} > max {hi}")
+        if self.alerts:
+            fired = row.get("alerts_fired")
+            if fired is None:
+                bad.append("alerts envelope set but the row carries no "
+                           "alerts_fired (alert plane did not run)")
+            else:
+                fired = set(fired)
+                must = list(self.alerts.get("must_fire", []))
+                for rule in must:
+                    if rule not in fired:
+                        bad.append(f"alert {rule} did not fire "
+                                   f"(fired: {sorted(fired) or 'none'})")
+                must_not = self.alerts.get("must_not_fire", [])
+                if must_not == "*":
+                    extra = fired - set(must)
+                    if extra:
+                        bad.append("unexpected alerts fired: "
+                                   f"{sorted(extra)}")
+                else:
+                    for rule in must_not:
+                        if rule in fired:
+                            bad.append(f"alert {rule} fired but is in "
+                                       f"must_not_fire")
         return bad
 
 
@@ -472,6 +513,9 @@ BUILTIN: dict[str, dict] = {
             "max_p99_queue_wait_s": 0.5,
             "max_scale_ups": 0,      # steady load must not flap the fleet
             "decisions": {"completed": {"min": 150}},
+            # the alert plane's zero-false-positive gate: a healthy
+            # steady fleet must fire NOTHING (ISSUE 17)
+            "alerts": {"must_fire": [], "must_not_fire": "*"},
         },
     },
     "diurnal_ramp": {
@@ -487,6 +531,10 @@ BUILTIN: dict[str, dict] = {
             "min_drains": 1,         # ... and the trough must return it
             "max_recovery_s": 90.0,
             "decisions": {"failed": {"max": 0}},
+            # the peak saturates one replica before the scale-up lands,
+            # so queue-wait MUST page — and nothing else may
+            "alerts": {"must_fire": ["QueueWaitHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "flash_crowd": {
@@ -502,6 +550,8 @@ BUILTIN: dict[str, dict] = {
             "min_scale_ups": 1,
             "max_recovery_s": 60.0,  # breach episode must end
             "decisions": {"failed": {"max": 0}},
+            "alerts": {"must_fire": ["QueueWaitHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "shared_prefix_tenants": {
@@ -529,6 +579,7 @@ BUILTIN: dict[str, dict] = {
             # loose floor well below the steady-state rate)
             "min_prefix_hit_rate": 0.5,
             "decisions": {"completed": {"min": 200}},
+            "alerts": {"must_fire": [], "must_not_fire": "*"},
         },
     },
     "cold_prefix_tenants": {
@@ -541,7 +592,10 @@ BUILTIN: dict[str, dict] = {
         # replica can keep every tenant resident, which is exactly the
         # shape the host tier exists for.  LRU churn spills cold
         # tenants' chains into the tier; their next request re-admits
-        # from host RAM instead of re-prefilling
+        # from host RAM instead of re-prefilling.  The tier budget is
+        # deliberately TIGHT (4 blocks vs the steady ~5-block spill
+        # residency): the tier still delivers the hit-rate floor, but
+        # runs pinned at capacity — TierHeadroomLow must page (ISSUE 17)
         "tenants": [
             {"name": f"t{i}", "weight": 1.0, "prefix_tokens": 64,
              "priority": 0} for i in range(8)
@@ -549,7 +603,7 @@ BUILTIN: dict[str, dict] = {
         "seed": 22,
         "fleet": {"replicas": 2,
                   "prefix_cache_blocks": 12,
-                  "tier_blocks": 64},
+                  "tier_blocks": 4},
         "envelope": {
             "max_lost": 0,
             "max_p99_queue_wait_s": 1.0,
@@ -560,6 +614,8 @@ BUILTIN: dict[str, dict] = {
             # this floor is unreachable
             "min_global_hit_rate": 0.8,
             "decisions": {"completed": {"min": 200}},
+            "alerts": {"must_fire": ["TierHeadroomLow"],
+                       "must_not_fire": "*"},
         },
     },
     "long_tail_prompts": {
@@ -574,6 +630,7 @@ BUILTIN: dict[str, dict] = {
             "max_lost": 0,
             "max_p99_queue_wait_s": 2.0,  # tail prompts queue behind
             "decisions": {"failed": {"max": 0}},
+            "alerts": {"must_fire": [], "must_not_fire": "*"},
         },
     },
     "deadline_storm": {
@@ -593,6 +650,10 @@ BUILTIN: dict[str, dict] = {
             # reason-to-decide regression
             "decisions": {"failed": {"max": 0},
                           "completed": {"min": 150}},
+            # the spike sheds tight deadlines (SLO burn) while the queue
+            # backs up behind it — BOTH pages, and nothing fleet-fatal
+            "alerts": {"must_fire": ["QueueWaitHigh", "SLOBurnHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "replica_death_storm": {
@@ -620,6 +681,11 @@ BUILTIN: dict[str, dict] = {
             "max_recovery_s": 45.0,
             "max_burn_rate_300s": 40.0,
             "decisions": {"failed": {"max": 0}},
+            # two kills -> router/replica_deaths moves -> ReplicaLost
+            # pages; the survivor saturates -> QueueWaitHigh.  A coord
+            # outage here would be a false positive: the store is UP
+            "alerts": {"must_fire": ["ReplicaLost", "QueueWaitHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "router_failover": {
@@ -639,6 +705,10 @@ BUILTIN: dict[str, dict] = {
             "min_router_recoveries": 1,
             "decisions": {"failed": {"max": 0},
                           "completed": {"min": 250}},
+            # a router crash is NOT a replica death and NOT a coord
+            # outage — only the spike's queue wait may page
+            "alerts": {"must_fire": ["QueueWaitHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "silent_corruption": {
@@ -666,6 +736,8 @@ BUILTIN: dict[str, dict] = {
             "max_corrupted_terminals": 0,
             "max_replica_deaths": 0,
             "decisions": {"failed": {"max": 0}},
+            "alerts": {"must_fire": ["QuarantineActive"],
+                       "must_not_fire": "*"},
         },
     },
     "disagg_mixed_prompts": {
@@ -701,6 +773,8 @@ BUILTIN: dict[str, dict] = {
             "min_scale_ups_prefill": 1,
             "min_scale_ups_decode": 1,
             "decisions": {"failed": {"max": 0}},
+            "alerts": {"must_fire": ["QueueWaitHigh"],
+                       "must_not_fire": "*"},
         },
     },
     "coord_brownout": {
@@ -718,6 +792,12 @@ BUILTIN: dict[str, dict] = {
             "max_replica_deaths": 0,
             "max_burn_rate_300s": 25.0,
             "decisions": {"failed": {"max": 0}},
+            # the headline case from ISSUE 17: the scraper's collect()
+            # round-trips fail during the brownout -> fleet/coord_up
+            # drops -> CoordOutage pages.  ReplicaLost must NOT fire:
+            # stale is not dead
+            "alerts": {"must_fire": ["CoordOutage"],
+                       "must_not_fire": "*"},
         },
     },
 }

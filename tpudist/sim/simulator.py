@@ -38,7 +38,9 @@ import numpy as np
 
 from tpudist import obs
 from tpudist.models.kv_pages import chain_hashes
+from tpudist.obs.alerts import AlertManager, default_rules
 from tpudist.obs.registry import values_to_hist
+from tpudist.obs.tsdb import TSDB, FleetScraper
 from tpudist.runtime import faults, wire
 from tpudist.runtime.autoscaler import AutoscaleConfig, Autoscaler
 from tpudist.runtime.router import (
@@ -384,6 +386,17 @@ class SimReplica:
             snap["gauges"]["serve/kv_blocks_used"] = {"value": float(used)}
             snap["gauges"]["serve/kv_blocks_free"] = {
                 "value": float(self.kv_blocks_total - used)}
+        if self.tier_blocks > 0:
+            # mirror the live TieredKV gauges (tpudist/models/kv_tier.py)
+            # so the fleet scraper's tier-headroom derivation — and the
+            # TierHeadroomLow alert rule — runs on the sim's spill tier
+            # exactly as on a real one.  A sim "block" is 16 tokens; the
+            # byte scale is arbitrary but consistent across both gauges.
+            block_bytes = 16 * 1024
+            snap["gauges"]["serve/tier_bytes"] = {
+                "value": float(len(self._tier_chains) * block_bytes)}
+            snap["gauges"]["serve/tier_budget_bytes"] = {
+                "value": float(self.tier_blocks * block_bytes)}
         if self._waits:
             snap["histograms"]["serve/queue_wait_s"] = values_to_hist(
                 [w for _, w in self._waits], unit="s")
@@ -637,6 +650,22 @@ class FleetSim:
         else:
             for _ in range(int(fleet["replicas"])):
                 self._spawn_one(warmup_s=0.0)
+        # the alert plane (ISSUE 17): a real TSDB + FleetScraper + the
+        # SHIPPED default alert rules, all on the virtual clock and the
+        # same fabric the router polls.  Scenario envelopes pin which
+        # rules fire per scenario, so the default thresholds become a
+        # regression surface instead of folklore.
+        self.tsdb = TSDB(retention_s=600.0, resolution_s=0.5,
+                         downsample_after_s=120.0,
+                         clock=self.vc.monotonic)
+        self.alerts = AlertManager(self.tsdb, default_rules(),
+                                   clock=self.vc.monotonic)
+        self.scraper = FleetScraper(
+            self.tsdb, client=self.fabric, namespace=self.ns,
+            registry=obs.registry, alerts=self.alerts,
+            interval_s=float(fleet["alert_scrape_s"]),
+            clock=self.vc.monotonic)
+        self._scrape_next = self.scraper.interval_s
         self.router = self._make_router()
         self.scaler: Autoscaler | None = None
         self.scalers: list[Autoscaler] = []
@@ -700,6 +729,7 @@ class FleetSim:
             poll_s=float(self.spec.fleet["router_poll_s"]),
             use_health=False,
             golden_probe=golden, quarantine_config=qcfg,
+            alerts=self.alerts,
             clock=self.vc.monotonic, wall=self.vc.wall,
             sleeper=self._advance)
 
@@ -758,6 +788,9 @@ class FleetSim:
                 if self.vc.monotonic() >= self._scaler_next[i]:
                     s.poll()
                     self._scaler_next[i] += s.cfg.poll_s
+            if self.vc.monotonic() >= self._scrape_next:
+                self.scraper.tick(self.vc.monotonic())
+                self._scrape_next += self.scraper.interval_s
 
     def _fire_fault(self, ev: dict) -> None:
         target = next((r for r in self.replicas
@@ -950,6 +983,12 @@ class FleetSim:
                        "timeout"):
             row[f"decisions_{reason}"] = delta.get(
                 f"router/decisions/{reason}", 0.0)
+        # alert accounting (ISSUE 17): every rule that reached firing at
+        # any point in the run, plus the hash of the rule set it fired
+        # under — the envelope's must_fire/must_not_fire checks read
+        # these, and bench rows carry the hash for provenance
+        row["alerts_fired"] = sorted(self.alerts.fired_names)
+        row["alert_rules_hash"] = self.alerts.rules_hash
         violations = spec.envelope.check(row)
         row["envelope_ok"] = not violations
         row["violations"] = violations
