@@ -1849,6 +1849,60 @@ def bench_host_allreduce(on_tpu: bool) -> None:
                       bytes_posted_per_rank=max(o[3] for o in outs),
                       bitwise_match=len(blobs) == 1)
 
+    # -- hierarchical host x ICI sweep: the cross-host byte bound ---------
+    # Simulated hosts are contiguous rank groups (host = rank // local).
+    # The claim under measurement is the tentpole's: the cross-host leg
+    # moves 2(H-1)/H x size bytes PER HOST (summing fetched cross-ring
+    # bytes over that host's representative ranks) — a function of the
+    # HOST count, not the chip count — and compression multiplies that
+    # wire by ~0.5 (bf16) or ~2 x topk_frac (int32 index + f32 value per
+    # survivor).  compress_ratio is measured against the dense hier row
+    # at the same (world, hosts), so codec overhead can't hide.
+    hier_data = rng.standard_normal(32 * 1024).astype(np.float32)  # 128 KiB
+    topk_frac = 0.25
+    rid = 400
+    for world, hosts in ((8, 2), (16, 4), (32, 8)):
+        dense_cross_per_host = None
+        for compress in ("none", "bf16", "topk"):
+            cfg = CollectiveConfig(algorithm="hier", compress=compress,
+                                   hosts=hosts, bucket_bytes=256 << 10,
+                                   topk_frac=topk_frac)
+            rid += 1
+            this_rid = rid
+
+            def fn(rank, client):
+                coll = HostCollectives(
+                    client, rank, world, round_id=this_rid,
+                    timeout_s=120.0, config=cfg)
+                tree = {"g": hier_data * (rank % 3 + 1)}
+                coll.allreduce_sum(tree)  # warm connections/threads
+                coll.bytes_posted = coll.bytes_fetched = 0
+                coll.bytes_posted_cross = coll.bytes_fetched_cross = 0
+                t0 = time.perf_counter()
+                out = coll.allreduce_sum(tree)
+                dt = time.perf_counter() - t0
+                cross = coll.bytes_fetched_cross
+                coll.close()
+                return out["g"].tobytes(), dt, cross
+
+            outs = run_world(world, fn)
+            local = world // hosts
+            per_host = max(
+                sum(outs[h * local + j][2] for j in range(local))
+                for h in range(hosts))
+            if compress == "none":
+                dense_cross_per_host = per_host
+            blobs = {o[0] for o in outs}
+            _emit("host_allreduce",
+                  round(max(o[1] for o in outs), 5), "s", None,
+                  algo=f"hier_{compress}", world=world, hosts=hosts,
+                  tree="hier", size_bytes=int(hier_data.nbytes),
+                  cross_host_bytes_per_host=per_host,
+                  compress_ratio=round(
+                      per_host / max(dense_cross_per_host, 1), 4),
+                  topk_frac=topk_frac if compress == "topk" else None,
+                  bitwise_match=len(blobs) == 1)
+
     # -- async overlap: microbatch accumulation vs the sync loop ----------
     world, microbatches = 2, 6
     grad = rng.standard_normal(256 * 1024).astype(np.float32)
@@ -1902,6 +1956,63 @@ def bench_host_allreduce(on_tpu: bool) -> None:
     _emit("host_allreduce_overlap", round(async_wait, 5), "s",
           round(async_wait / max(sync_wait, 1e-9), 3),
           world=world, microbatches=microbatches,
+          sync_wait_s=round(sync_wait, 5),
+          state_equal=all(o[2] for o in outs))
+
+    # -- bucketed backward-order overlap vs reduce-at-the-end ------------
+    # The backward walk hands one layer's gradient over at a time
+    # (output layer first); buckets fire their allreduce as soon as the
+    # last member lands, so the remaining layers' compute rides the
+    # earlier buckets' wire time.  The sync reference waits for the
+    # whole walk, then blocks in one allreduce of the full dict —
+    # identical arithmetic, so the accumulated state must match bitwise.
+    layers, steps = 8, 2
+    bleaf = rng.standard_normal(64 * 1024).astype(np.float32)  # 256 KiB
+    names = [f"l{i}" for i in range(layers)]
+
+    def fn_bucketed(rank, client):
+        coll = HostCollectives(
+            client, rank, world, round_id=320, timeout_s=60.0,
+            config=CollectiveConfig(algorithm="ring", compress="none",
+                                    bucket_bytes=256 << 10))
+        leaves = {n: bleaf * (rank + i + 1) for i, n in enumerate(names)}
+        coll.allreduce_sum(leaves)  # warm
+        sync_wait = 0.0
+        total_sync = None
+        for _ in range(steps):
+            for _n in names:
+                host_compute()  # per-layer backward stand-in
+            t0 = time.perf_counter()
+            out = coll.allreduce_sum(leaves)
+            sync_wait += time.perf_counter() - t0
+            total_sync = (out if total_sync is None else
+                          {n: total_sync[n] + out[n] for n in names})
+        sync_obj = OverlappedGradSync(coll, bucket_bytes=512 << 10)
+        bucketed_wait = 0.0
+        total_bucketed = None
+        for _ in range(steps):
+            for n in reversed(names):  # backward order: output layer first
+                host_compute()
+                t0 = time.perf_counter()
+                sync_obj.grad_ready(n, leaves[n])
+                bucketed_wait += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out = sync_obj.reduce()
+            bucketed_wait += time.perf_counter() - t0
+            total_bucketed = (out if total_bucketed is None else
+                              {n: total_bucketed[n] + out[n] for n in names})
+        equal = all(total_sync[n].tobytes() == total_bucketed[n].tobytes()
+                    for n in names)
+        coll.close()
+        return sync_wait, bucketed_wait, equal
+
+    outs = run_world(world, fn_bucketed)
+    sync_wait = max(o[0] for o in outs)
+    bucketed_wait = max(o[1] for o in outs)
+    _emit("host_allreduce_bucketed", round(bucketed_wait, 5), "s",
+          round(bucketed_wait / max(sync_wait, 1e-9), 3),
+          world=world, layers=layers, steps=steps,
+          bucket_bytes=512 << 10,
           sync_wait_s=round(sync_wait, 5),
           state_equal=all(o[2] for o in outs))
     server.stop()

@@ -181,6 +181,49 @@ def test_kill9_ring_path_with_overlap(tmp_path):
     assert d0["loss"] == d1["loss"]
 
 
+def test_kill9_bucketed_backward_order_topk(tmp_path):
+    """The shrink lifecycle on the bucketed backward-order path with
+    top-k error-feedback compression: per-layer gradients stream through
+    OverlappedGradSync buckets (reverse leaf order, the backward-hook
+    order), each bucket's allreduce firing as soon as its last member
+    lands, over the ring with topk+EF on the wire.  The elastic
+    invariants must hold unchanged — same resume point, same lr rescale
+    — and survivor checksums must stay BITWISE identical: the plan-order
+    bucket submission keeps op ids rank-agreed, and EF residuals are
+    per-instance so the post-shrink round starts them from zero on every
+    survivor symmetrically."""
+    rc = launch(
+        [sys.executable, WORKER], nprocs=3, min_nprocs=2,
+        elastic_inprocess=True,
+        env={"WORKER_OUT_DIR": str(tmp_path),
+             "WORKER_KILL_SPAWN_ID": "2",
+             "WORKER_KILL_AT_STEP": "13",
+             "WORKER_BUCKETED": "4096",
+             "TPUDIST_COLL_ALGO": "ring",
+             "TPUDIST_COLL_COMPRESS": "topk",
+             "TPUDIST_COLL_TOPK_FRAC": "0.25",
+             "TPUDIST_COLL_BUCKET_BYTES": "1024"},
+    )
+    assert rc == 0
+
+    victim = _events(tmp_path, 2)
+    assert victim[-1] == {"event": "suicide", "step": 13}
+
+    for sid in (0, 1):
+        ev = _events(tmp_path, sid)
+        rounds = [e for e in ev if e["event"] == "round"]
+        assert rounds[0]["world"] == 3 and rounds[-1]["world"] == 2
+        assert rounds[-1]["resume_batch"] == 10
+        done = [e for e in ev if e["event"] == "done"]
+        assert done[-1]["steps"] == 30 and done[-1]["world"] == 2
+        assert done[-1]["lr"] == pytest.approx(0.1 * 2 / 3)
+
+    d0 = _events(tmp_path, 0)[-1]
+    d1 = _events(tmp_path, 1)[-1]
+    assert d0["checksum"] == d1["checksum"]
+    assert d0["loss"] == d1["loss"]
+
+
 def test_full_gang_loss_resumes_from_durable_commit(tmp_path):
     """ALL workers die (kill -9) mid-training — no survivor holds the state
     in memory, so the in-memory broadcast path cannot recover it.  The

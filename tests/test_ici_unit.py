@@ -8,6 +8,7 @@ import jax
 
 from tpudist.runtime.ici import (
     IciCollectives,
+    IciIntraHost,
     host_snapshot,
     is_collective_failure,
 )
@@ -132,6 +133,56 @@ class TestIciCollectivesSingleProcess:
         hs = coll.allreduce_sum_async(grads)
         np.testing.assert_allclose(
             hs.wait()["w"], sync["w"] * jax.process_count())
+
+    def test_rs_bounds_cover_and_partition(self):
+        coll = IciCollectives(self._mesh())
+        for n in (0, 1, 5, 64, 97):
+            bounds = coll.rs_bounds(n)
+            assert len(bounds) == coll.num_processes
+            assert bounds[0][0] == 0 and bounds[-1][1] == n
+            for (a, b), (c, d) in zip(bounds, bounds[1:]):
+                assert b == c and a <= b and c <= d
+
+    def test_reduce_scatter_returns_own_shard_of_sum(self):
+        # single process: the "sum" over processes is the input itself,
+        # so the shard must equal the process's rs_bounds slice verbatim
+        coll = IciCollectives(self._mesh())
+        vec = np.arange(23, dtype=np.float32) * 0.5 - 3.0
+        lo, hi = coll.rs_bounds(23)[jax.process_index()]
+        shard = coll.reduce_scatter(vec)
+        np.testing.assert_array_equal(shard, vec[lo:hi])
+        assert shard.dtype == np.float32
+        assert coll.last_hlo is not None
+
+    def test_all_gather_roundtrips_reduce_scatter(self):
+        coll = IciCollectives(self._mesh())
+        for n in (1, 23, 64):
+            vec = np.linspace(-2.0, 2.0, n, dtype=np.float32)
+            full = coll.all_gather(coll.reduce_scatter(vec), n)
+            np.testing.assert_array_equal(full, vec)
+
+    def test_reduce_scatter_empty_vector(self):
+        coll = IciCollectives(self._mesh())
+        shard = coll.reduce_scatter(np.zeros(0, np.float32))
+        assert shard.size == 0
+        assert coll.all_gather(shard, 0).size == 0
+
+    def test_all_gather_rejects_wrong_shard_size(self):
+        coll = IciCollectives(self._mesh())
+        with pytest.raises(ValueError, match="shard"):
+            coll.all_gather(np.zeros(999, np.float32), 23)
+
+    def test_intra_host_adapter_contract(self):
+        # the shape HostCollectives._hier consumes: local_world/index,
+        # bounds matching rs_bounds, and the rs->ag identity
+        coll = IciCollectives(self._mesh())
+        plane = IciIntraHost(coll)
+        assert plane.local_world == coll.num_processes
+        assert plane.local_index == jax.process_index()
+        assert plane.bounds(23) == coll.rs_bounds(23)
+        vec = np.arange(23, dtype=np.float32)
+        full = plane.all_gather(plane.reduce_scatter(vec), 23)
+        np.testing.assert_array_equal(full, vec)
 
     def test_async_handles_overlap_in_flight(self):
         # several submissions may be in flight at once; waits in any order

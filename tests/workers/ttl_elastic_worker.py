@@ -28,7 +28,10 @@ import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
 from tpudist.elastic.state import ElasticState, HostDataState  # noqa: E402
-from tpudist.elastic.worker import run_elastic_worker  # noqa: E402
+from tpudist.elastic.worker import (  # noqa: E402
+    OverlappedGradSync,
+    run_elastic_worker,
+)
 from tpudist.models import MLP  # noqa: E402
 from tpudist.ops.losses import cross_entropy  # noqa: E402
 from tpudist.train.state import TrainState  # noqa: E402
@@ -62,6 +65,11 @@ DATA_PLANE = os.environ.get("WORKER_DATA_PLANE", "host")
 # while the wire time elapses — same collective, same result, so every
 # checksum assertion of the sync tests must keep holding
 OVERLAP = os.environ.get("WORKER_OVERLAP", "") not in ("", "0", "false")
+# bucketed backward-order overlap: stream per-layer grads (reverse leaf
+# order, the backward-hook order) through OverlappedGradSync buckets that
+# fire their allreduce as soon as the last member lands; the value is the
+# bucket size in bytes
+BUCKETED = int(os.environ.get("WORKER_BUCKETED", "0") or "0")
 
 
 def emit(event: str, **fields) -> None:
@@ -117,6 +125,10 @@ def main() -> int:
     def train_fn(state: ElasticState, ctx) -> None:
         emit("round", round=ctx.round, rank=ctx.rank, world=ctx.world_size,
              resume_batch=state.host.batch)
+        # fresh sync per round: the step-1 plan is recorded against THIS
+        # round's membership and collectives instance
+        bucketed = (OverlappedGradSync(ctx.collectives, bucket_bytes=BUCKETED)
+                    if BUCKETED else None)
         shard = GLOBAL_BATCH // ctx.world_size
         last_loss = float("nan")
         hlo_emitted = False
@@ -130,7 +142,22 @@ def main() -> int:
             # one fused allreduce syncs grads AND the scalar loss (the
             # XLA-fusion analog on the control plane: one payload)
             payload = (grads, np.asarray(float(loss), np.float32))
-            if OVERLAP:
+            if bucketed is not None:
+                # stream leaves in REVERSE flatten order — the order a
+                # backward pass emits them (output layer first); each
+                # bucket's allreduce fires mid-"backward", overlapping
+                # the remaining grad_ready calls
+                flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+                for path, leaf in reversed(flat):
+                    bucketed.grad_ready(jax.tree_util.keystr(path), leaf)
+                bucketed.grad_ready(
+                    "loss", np.asarray(float(loss), np.float32))
+                out = bucketed.reduce(mean=True)
+                grads = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [out[jax.tree_util.keystr(p)] for p, _ in flat])
+                gloss = out["loss"]
+            elif OVERLAP:
                 # async submit; the next step's batch generation (host
                 # work) rides the allreduce's wire time.  wait() returns
                 # the identical tree the sync call would — errors

@@ -104,25 +104,141 @@ class OverlappedGradSync:
     allreduce outputs — no stale-gradient pipelining, no reordering.
     Falls back to synchronous allreduce when the plane has no async API
     (:class:`~tpudist.runtime.ici.IciCollectives` before PR 4, custom
-    planes)."""
+    planes).
 
-    def __init__(self, collectives: Any) -> None:
+    **Bucketed backward-order mode** (``bucket_bytes`` set): instead of
+    pushing whole trees per microbatch, the train loop streams named
+    gradients in the order the backward pass produces them::
+
+        sync = OverlappedGradSync(ctx.collectives, bucket_bytes=1 << 20)
+        for name, g in backward_order_grads():   # hooks, reverse topo
+            sync.grad_ready(name, g)             # may fire a bucket
+        total = sync.reduce(mean=True)           # dict name -> array
+
+    Step 1 records arrival order and greedily packs consecutive names
+    into buckets of ``>= bucket_bytes``; :meth:`reduce` freezes that
+    plan.  From step 2 on, a bucket's allreduce is submitted the moment
+    its LAST member gradient lands — communication of early (deep)
+    layers overlaps the rest of the backward pass.  Buckets are always
+    SUBMITTED in plan order (a ready bucket waits for its predecessors),
+    so every rank issues the same collectives in the same sequence even
+    under arrival jitter — the op-id agreement `HostCollectives`
+    requires.  The recorded order itself must match across ranks, which
+    SPMD replicas guarantee (same model, same backward).  Each name
+    belongs to exactly one bucket and cross-bucket results are merely
+    merged (no arithmetic), so the result is bitwise what per-name
+    synchronous allreduces would return."""
+
+    def __init__(self, collectives: Any,
+                 bucket_bytes: int | None = None) -> None:
         self._coll = collectives
         self._async = getattr(collectives, "allreduce_sum_async", None)
         self._handles: list[tuple[Handle | Any, bool]] = []
+        if bucket_bytes is not None and bucket_bytes < 1:
+            raise ValueError(f"bucket_bytes must be >= 1: {bucket_bytes}")
+        self._bucket_bytes = bucket_bytes
+        self._plan: list[list[str]] | None = None   # frozen after step 1
+        self._member: dict[str, int] = {}           # name -> bucket index
+        self._order: list[str] = []                 # step-1 arrival order
+        self._pending: dict[str, np.ndarray] = {}   # landed, not yet fired
+        self._counts: dict[str, int] = {}           # local adds per name
+        self._inflight: list[tuple[int, Any, bool]] = []  # (bi, h, is_async)
+        self._next_fire = 0                         # plan-order cursor
+        self._open_bytes = 0                        # step-1 greedy packing
 
     def push(self, tree: Any) -> None:
         """Submit one microbatch's gradient tree for summing across ranks."""
+        if self._pending or self._inflight or self._order \
+                or self._plan is not None:
+            raise ValueError(
+                "push() cannot be mixed with grad_ready() on one "
+                "OverlappedGradSync: the instance is in bucketed mode")
         if self._async is not None:
             self._handles.append((self._async(tree), True))
         else:
             self._handles.append((self._coll.allreduce_sum(tree), False))
 
+    # -- bucketed backward-order mode ---------------------------------------
+
+    def grad_ready(self, name: str, value: Any) -> None:
+        """Hand over one named gradient in backward order; fires the
+        owning bucket's allreduce once every member has landed (and all
+        earlier-plan buckets have fired).  A repeat ``grad_ready`` for a
+        name still pending accumulates locally (gradient accumulation
+        across microbatches); repeats after the bucket fired are an
+        error — stream each name's final contribution before its bucket
+        closes."""
+        if self._bucket_bytes is None:
+            raise ValueError(
+                "bucketed mode needs OverlappedGradSync(..., bucket_bytes=)")
+        if self._handles:
+            raise ValueError(
+                "grad_ready() cannot be mixed with push() in one step")
+        value = np.asarray(value)
+        if name in self._pending:
+            self._pending[name] = self._pending[name] + value
+            self._counts[name] += 1
+            return
+        if self._plan is not None and name not in self._member:
+            raise ValueError(
+                f"unknown gradient {name!r}: not in the step-1 plan "
+                f"({sorted(self._member)})")
+        if self._plan is None and name in self._member:
+            raise ValueError(
+                f"gradient {name!r} already fired this step; stream each "
+                f"name once per step while its bucket is open")
+        self._pending[name] = value
+        self._counts[name] = 1
+        if self._plan is None:
+            self._order.append(name)
+            self._member[name] = -1  # recorded; bucket assigned at freeze
+            self._open_bytes += value.nbytes
+            if self._open_bytes >= self._bucket_bytes:
+                self._fire(list(self._order[len(self._order)
+                                            - self._open_count():]))
+        else:
+            self._fire_ready()
+
+    def _open_count(self) -> int:
+        """Names recorded but not yet fired (the open step-1 bucket)."""
+        return len(self._pending)
+
+    def _fire(self, names: list[str]) -> None:
+        """Submit one bucket's allreduce (step-1 path: bucket = the open
+        run of names)."""
+        tree = {n: self._pending.pop(n) for n in names}
+        bi = self._next_fire
+        self._next_fire += 1
+        for n in names:
+            self._member[n] = bi
+        if self._plan is None:
+            self._open_bytes = 0
+        if self._async is not None:
+            self._inflight.append((bi, self._async(tree), True))
+        else:
+            self._inflight.append((bi, self._coll.allreduce_sum(tree), False))
+
+    def _fire_ready(self) -> None:
+        """Step >= 2: submit every plan-order-consecutive bucket whose
+        members have all landed — plan order, not arrival order, so the
+        collective sequence is rank-agreed."""
+        assert self._plan is not None
+        while self._next_fire < len(self._plan):
+            names = self._plan[self._next_fire]
+            if not all(n in self._pending for n in names):
+                return
+            self._fire(names)
+
     def reduce(self, mean: bool = False) -> Any:
         """Wait for every pushed allreduce (in push order) and return the
         elementwise sum; ``mean=True`` divides by ``pushes × world``.
-        Worker-thread errors (``PeerLost`` / ``WorldChanged``) re-raise
-        here, exactly where the synchronous path would have raised."""
+        In bucketed mode: fire any trailing bucket, wait all in-flight
+        buckets, and return ``{name: array}`` (``mean=True`` divides each
+        by ``local_adds × world``).  Worker-thread errors (``PeerLost`` /
+        ``WorldChanged``) re-raise here, exactly where the synchronous
+        path would have raised."""
+        if self._order or self._inflight or self._pending:
+            return self._reduce_bucketed(mean)
         if not self._handles:
             raise ValueError("reduce() with no pushed gradients")
         handles, self._handles = self._handles, []
@@ -135,6 +251,33 @@ class OverlappedGradSync:
             scale = len(handles) * getattr(self._coll, "world", 1)
             total = jax.tree.map(lambda x: x / scale, total)
         return total
+
+    def _reduce_bucketed(self, mean: bool) -> dict[str, np.ndarray]:
+        if self._plan is None:
+            # freeze the step-1 plan: fired prefixes + the open remainder
+            if self._pending:
+                self._fire([n for n in self._order if n in self._pending])
+            plan: list[list[str]] = [[] for _ in range(self._next_fire)]
+            for n in self._order:
+                plan[self._member[n]].append(n)
+            self._plan = plan
+        elif self._pending or self._next_fire < len(self._plan):
+            missing = [n for names in self._plan[self._next_fire:]
+                       for n in names if n not in self._pending]
+            raise ValueError(
+                f"reduce() before every gradient landed; missing: {missing}")
+        inflight, self._inflight = self._inflight, []
+        out: dict[str, np.ndarray] = {}
+        for _bi, h, is_async in inflight:
+            out.update(h.wait() if is_async else h)
+        counts, self._counts = self._counts, {}
+        self._order = []
+        self._next_fire = 0
+        self._open_bytes = 0
+        if mean:
+            world = getattr(self._coll, "world", 1)
+            out = {n: v / (counts[n] * world) for n, v in out.items()}
+        return out
 
 
 def _next_round(client: CoordClient, round_id: int) -> int:

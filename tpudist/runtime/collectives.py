@@ -38,6 +38,23 @@ reference gets for free from ``hvd.DistributedOptimizer``,
   (default) or fp16 with float32 accumulation at every hop
   (``coll/compress_ratio`` reports the saving); every other dtype rides
   raw;
+* **hierarchical topology** (``algorithm="hier"``) — ranks are grouped
+  into ``hosts`` contiguous host groups; each bucket is reduce-scattered
+  WITHIN the host (over the injected ICI plane, or store-simulated in
+  lossless accum-dtype bytes), then each shard runs the chunked ring
+  across ONE representative rank per host, then the finished shards
+  all-gather back within the host.  Cross-host wire bytes drop from
+  ``2·(world−1)/world × size`` per RANK to ``2·(H−1)/H × size`` per
+  HOST (H = host count) — the bytes the slow DCN link actually carries;
+* **top-k sparsification** (``compress="topk"``) — every lossy message
+  keeps only the ``topk_frac`` largest-magnitude elements (int32 index +
+  f32 value per survivor, so wire ≈ ``2·frac ×`` dense), re-sparsified
+  at every ring hop; what an encode drops is accumulated in a per-bucket
+  ERROR-FEEDBACK residual owned by :class:`HostCollectives` and folded
+  into this rank's next contribution, so dropped mass is delayed, never
+  lost.  Residuals die with the instance — one instance per rendezvous
+  round means a membership change drops them rather than replaying them
+  into a differently-shaped world;
 * **async overlap** — :meth:`HostCollectives.allreduce_sum_async` returns
   a :class:`Handle` and runs post/fetch/reduce on a background worker, so
   the caller's next microbatch overlaps the previous one's wire time
@@ -50,13 +67,21 @@ rank per hop in fixed ring order, and the finished chunk's *encoded bytes*
 are what every rank decodes — no rank re-does a reduction another rank
 already did.  Flat: every rank reduces in rank order over the *posted*
 (wire-encoded) payloads, including its own, so compression rounding is
-identical everywhere.  The two algorithms may differ from each other in
-ULPs (different addition order); replicas never differ from each other.
+identical everywhere.  Hier: intra-host reduction runs in fixed
+local-rank order, each cross-host shard ring is ring-fixed, and the
+intra all-gather re-posts the (identical) decoded shard bytes raw.  The
+algorithms may differ from each other in ULPs (different addition
+order), and topk additionally drops mass into residuals — but for every
+algorithm × compression combination, replicas never differ from each
+other.
 
 Wire format: flat posts one fused blob per rank under
 ``{ns}/{round}/{op}/{rank}``; ring posts chunk partials under
 ``{ns}/{round}/{op}/rs/{bucket}/{step}/{rank}`` and finished chunks under
-``{ns}/{round}/{op}/ag/{bucket}/{chunk}``.  Chunk payloads are raw bytes of
+``{ns}/{round}/{op}/ag/{bucket}/{chunk}``; hier adds intra-host posts
+under ``.../hrs/{bucket}/{dest}/{src}`` + ``.../hag/{bucket}/{owner}``
+and per-shard cross-host rings under ``.../xrs/{bucket}.{shard}/...`` +
+``.../xag/{bucket}.{shard}/...``.  Chunk payloads are raw bytes of
 the wire dtype — both sides derive shapes/offsets from the (identical)
 fusion plan, so no per-message header is needed.  Key GC: a participant
 deletes every key it posted for ``op - 2`` when starting ``op`` — by then
@@ -88,21 +113,37 @@ class PeerLost(RuntimeError):
 # configuration
 
 
+ALGORITHMS = ("auto", "flat", "ring", "hier")
+COMPRESSIONS = ("none", "bf16", "fp16", "topk")
+
+
 @dataclasses.dataclass(frozen=True)
 class CollectiveConfig:
     """Knobs for the host allreduce (Horovod's fusion-buffer/compression
     trio, `HOROVOD_FUSION_THRESHOLD` analog).
 
     * ``algorithm`` — ``auto`` (flat for tiny payloads or ``world <= 2``,
-      ring otherwise), or force ``flat`` / ``ring``.
+      ring otherwise), or force ``flat`` / ``ring`` / ``hier``
+      (hierarchical: intra-host reduce-scatter, cross-host ring over one
+      representative rank per host, intra-host all-gather — needs
+      ``hosts`` to divide the world, falls back to ``ring`` otherwise).
     * ``bucket_bytes`` — fused-buffer cap; one ring runs per bucket, so
       smaller buckets start their wire time earlier but cost more store
       round-trips.
-    * ``compress`` — ``bf16`` (default) / ``fp16`` / ``none``; applies to
-      float32 payloads only, accumulation stays float32.
+    * ``compress`` — ``bf16`` (default) / ``fp16`` / ``none`` / ``topk``
+      (top-k magnitude sparsification with error-feedback residuals);
+      applies to float32 payloads only, accumulation stays float32.
+      Under ``hier`` compression applies to the CROSS-HOST wire; the
+      intra-host phases ride lossless accumulation-dtype bytes.
     * ``flat_max_bytes`` — ``auto`` switches to ring above this payload
       size (the flat gather's one-post/one-fetch-per-peer latency beats
       the ring's ``2·world`` round-trips for small trees).
+    * ``topk_frac`` — fraction of each compressed message's elements kept
+      by ``topk`` (wire bytes ≈ ``2·frac`` of dense: int32 index + f32
+      value per survivor).
+    * ``hosts`` — host-group count for ``hier``; ranks are grouped
+      contiguously (host = ``rank // (world/hosts)``), matching how the
+      launcher numbers a gang.
 
     Every field has a ``TPUDIST_COLL_*`` environment override (read by
     :meth:`from_env`, the default for :class:`HostCollectives`), so the
@@ -114,14 +155,35 @@ class CollectiveConfig:
     bucket_bytes: int = 4 << 20
     compress: str = "bf16"
     flat_max_bytes: int = 64 << 10
+    topk_frac: float = 0.25
+    hosts: int = 1
 
     def __post_init__(self) -> None:
-        if self.algorithm not in ("auto", "flat", "ring"):
-            raise ValueError(f"unknown algorithm {self.algorithm!r}")
-        if self.compress not in ("none", "bf16", "fp16"):
-            raise ValueError(f"unknown compress {self.compress!r}")
+        self.validate()
+
+    def validate(self) -> None:
+        """Reject out-of-range knobs with the allowed values in the
+        message — a typo'd ``TPUDIST_COLL_*`` override must fail at
+        config construction, not surface as dispatch-time weirdness."""
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r} "
+                f"(TPUDIST_COLL_ALGO); allowed: {', '.join(ALGORITHMS)}")
+        if self.compress not in COMPRESSIONS:
+            raise ValueError(
+                f"unknown compress {self.compress!r} "
+                f"(TPUDIST_COLL_COMPRESS); allowed: "
+                f"{', '.join(COMPRESSIONS)}")
         if self.bucket_bytes < 64:
             raise ValueError(f"bucket_bytes too small: {self.bucket_bytes}")
+        if not 0.0 < self.topk_frac <= 1.0:
+            raise ValueError(
+                f"topk_frac must be in (0, 1], got {self.topk_frac} "
+                f"(TPUDIST_COLL_TOPK_FRAC)")
+        if self.hosts < 1:
+            raise ValueError(
+                f"hosts must be >= 1, got {self.hosts} "
+                f"(TPUDIST_COLL_HOSTS)")
 
     @classmethod
     def from_env(cls) -> "CollectiveConfig":
@@ -132,6 +194,9 @@ class CollectiveConfig:
             compress=os.environ.get("TPUDIST_COLL_COMPRESS", cls.compress),
             flat_max_bytes=int(os.environ.get("TPUDIST_COLL_FLAT_MAX_BYTES",
                                               cls.flat_max_bytes)),
+            topk_frac=float(os.environ.get("TPUDIST_COLL_TOPK_FRAC",
+                                           cls.topk_frac)),
+            hosts=int(os.environ.get("TPUDIST_COLL_HOSTS", cls.hosts)),
         )
 
 
@@ -148,10 +213,50 @@ def _bf16() -> np.dtype:
 def _wire_dtype(native: np.dtype, compress: str) -> np.dtype:
     """The dtype a group's bytes travel as: float32 compresses to
     bf16/fp16 when asked; everything else (ints, bool, f64, and already-
-    half floats) rides raw."""
-    if native == np.float32 and compress != "none":
+    half floats) rides raw.  ``topk`` payloads are sparse, not a dtype
+    cast — their wire dtype stays the native one and the sparse codec
+    below owns the byte layout."""
+    if native == np.float32 and compress in ("bf16", "fp16"):
         return _bf16() if compress == "bf16" else np.dtype(np.float16)
     return native
+
+
+def _topk_k(n: int, frac: float) -> int:
+    """Survivor count for a ``topk`` message of ``n`` elements: fixed
+    and derived from the (identical) config on every rank, so message
+    sizes need no header and byte accounting is exact."""
+    if n == 0:
+        return 0
+    return max(1, min(n, int(np.ceil(n * frac))))
+
+
+def _encode_topk(arr: np.ndarray, frac: float) -> bytes:
+    """Top-k magnitude sparsification: keep the ``k`` largest-|x|
+    elements, wire format ``k × int32 index (sorted ascending) + k ×
+    f32 value``.  Sorted indices make the encoding a pure function of
+    the input values, so every rank re-encoding the same array posts
+    the same bytes."""
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    n = arr.size
+    k = _topk_k(n, frac)
+    if k >= n:
+        idx = np.arange(n, dtype=np.int32)
+    else:
+        idx = np.sort(np.argpartition(np.abs(arr), n - k)[n - k:]) \
+            .astype(np.int32)
+    return idx.tobytes() + arr[idx].tobytes()
+
+
+def _decode_topk(raw: bytes, n: int) -> np.ndarray:
+    """Densify a topk payload back to length ``n`` (zeros off-support).
+    ``k`` is implied by the payload length — both halves are 4 bytes per
+    survivor."""
+    k = len(raw) // 8
+    out = np.zeros(n, dtype=np.float32)
+    if k:
+        idx = np.frombuffer(raw[:4 * k], dtype=np.int32)
+        out[idx] = np.frombuffer(raw[4 * k:], dtype=np.float32)
+    return out
 
 
 def _accum_dtype(native: np.dtype) -> np.dtype:
@@ -179,10 +284,39 @@ class _Bucket:
     data: np.ndarray      # 1-D accum-dtype slice of the group's fused vector
     wire: np.dtype
     accum: np.dtype
+    # the compression slot: ``frac`` set means this bucket's lossy
+    # messages ride the sparse topk codec instead of a dtype cast;
+    # ``residual`` (when error feedback is live) collects what each of
+    # THIS rank's encodes dropped, at bucket-global offsets
+    frac: float | None = None
+    residual: np.ndarray | None = None
 
     @property
     def wire_nbytes(self) -> int:
+        if self.frac is not None:
+            return 8 * _topk_k(len(self.data), self.frac)
         return len(self.data) * self.wire.itemsize
+
+
+def _bucket_encode(b: _Bucket, arr: np.ndarray, off: int = 0) -> bytes:
+    """Encode one wire message for bucket ``b`` (``arr`` lives at
+    bucket-global offset ``off``).  For topk buckets the encode is where
+    gradient mass is lost, so the error-feedback residual is written
+    HERE: each region a rank encodes gets its drop recorded exactly once
+    per op (ring/hier topology guarantees the regions don't overlap),
+    and the next op re-injects it."""
+    if b.frac is None:
+        return _encode(arr, b.wire)
+    raw = _encode_topk(arr, b.frac)
+    if b.residual is not None and arr.size:
+        b.residual[off:off + arr.size] = arr - _decode_topk(raw, arr.size)
+    return raw
+
+
+def _bucket_decode(b: _Bucket, raw: bytes, n: int) -> np.ndarray:
+    if b.frac is None:
+        return _decode(raw, b.wire, b.accum)
+    return _decode_topk(raw, n)
 
 
 def _fuse(np_leaves: list[np.ndarray],
@@ -208,11 +342,14 @@ def _fuse(np_leaves: list[np.ndarray],
         parts = [np_leaves[i].ravel() for i in idxs]
         fused = (np.concatenate(parts) if len(parts) > 1
                  else parts[0]).astype(accum, copy=False)
+        frac = (cfg.topk_frac if cfg.compress == "topk"
+                and native == np.float32 else None)
         per_bucket = max(1, cfg.bucket_bytes // wire.itemsize)
         group_buckets = [
-            _Bucket(token, fused[lo:lo + per_bucket], wire, accum)
+            _Bucket(token, fused[lo:lo + per_bucket], wire, accum, frac)
             for lo in range(0, len(fused), per_bucket)
-        ] or ([_Bucket(token, fused, wire, accum)] if fused.size == 0 else [])
+        ] or ([_Bucket(token, fused, wire, accum, frac)]
+              if fused.size == 0 else [])
         buckets.extend(b for b in group_buckets if b.data.size)
         plan[token] = (idxs, native)
     return buckets, plan
@@ -396,9 +533,25 @@ class HostCollectives:
       timeout_s: per-collective deadline before :class:`PeerLost`.  The
         deadline is SHARED by every chunk of one collective: a peer dying
         mid-ring surfaces once, after ``timeout_s``, not once per
-        remaining chunk.
+        remaining chunk.  For ``hier`` the SAME deadline covers all
+        three phases — a rank dying between the intra-host and
+        cross-host phases still surfaces within one ``timeout_s``.
       config: algorithm/fusion/compression knobs; defaults to
         :meth:`CollectiveConfig.from_env`.
+      intra: optional intra-host plane for ``algorithm="hier"`` (e.g.
+        :class:`tpudist.runtime.ici.IciIntraHost` wrapping the host's
+        ICI mesh).  Must expose ``local_world`` / ``local_index`` /
+        ``bounds(n)`` / ``reduce_scatter(vec)`` / ``all_gather(shard,
+        n)`` and span exactly this rank's host group (``local_world ==
+        world // config.hosts``).  When absent, the intra phases ride
+        the coord store (the simulated-ICI path the tests and bench
+        drive) — lossless accumulation-dtype bytes either way.
+
+    Error-feedback state (``compress="topk"``) is OWNED by the instance
+    and keyed by bucket: dropped gradient mass re-enters this rank's
+    next contribution.  A membership change means a new instance per
+    round (the elastic worker's structure), so residuals are DROPPED —
+    never replayed into a world they weren't accumulated against.
 
     Threading contract: collectives must be issued from one thread (SPMD
     programs issue them in lockstep anyway).  ``*_async`` submissions are
@@ -417,6 +570,7 @@ class HostCollectives:
         on_wait: Callable[[], None] | None = None,
         timeout_s: float = 60.0,
         config: CollectiveConfig | None = None,
+        intra: Any | None = None,
     ) -> None:
         self.client = client
         self.rank = rank
@@ -427,10 +581,18 @@ class HostCollectives:
         self.timeout_s = timeout_s
         self.config = config if config is not None \
             else CollectiveConfig.from_env()
+        self.intra = intra
         self._op = 0
         self._posted: dict[int, list[str]] = {}  # op -> keys (for GC)
         self.bytes_posted = 0     # per-instance wire accounting (bench/tests
         self.bytes_fetched = 0    # read these; obs counters are global)
+        self.bytes_posted_cross = 0   # hier: cross-host ring bytes only —
+        self.bytes_fetched_cross = 0  # the wire the 2(H-1)/H bound is about
+        self._in_cross = False
+        # topk error feedback: (bucket index, length) -> residual vector;
+        # replaced wholesale each op, so a tree-structure change simply
+        # starts fresh, and instance-per-round drops it on resize
+        self._residuals: dict[tuple[int, int], np.ndarray] = {}
         self._abort = threading.Event()
         self._io: _Prefetcher | None = None        # sync-path prefetcher
         self._async_io: _Prefetcher | None = None  # worker-path prefetcher
@@ -464,6 +626,8 @@ class HostCollectives:
               payload: bytes) -> None:
         obs.counter("coll/bytes_posted", unit="bytes").inc(len(payload))
         self.bytes_posted += len(payload)
+        if self._in_cross:
+            self.bytes_posted_cross += len(payload)
         client.set(key, payload)
         self._posted.setdefault(op, []).append(key)
 
@@ -471,6 +635,8 @@ class HostCollectives:
         obs.counter("coll/bytes_fetched", unit="bytes").inc(len(raw))
         obs.histogram("coll/fetch_wait_s", unit="s").record(waited_s)
         self.bytes_fetched += len(raw)
+        if self._in_cross:
+            self.bytes_fetched_cross += len(raw)
         return raw
 
     def _fetch(self, client: CoordClient, key: str, deadline: float,
@@ -604,27 +770,77 @@ class HostCollectives:
             return jax.tree.unflatten(
                 treedef, [np.array(l, copy=True) for l in np_leaves])
         buckets, plan = _fuse(np_leaves, self.config)
+        if self.config.compress == "topk":
+            self._inject_residuals(buckets)
         wire_bytes = sum(b.wire_nbytes for b in buckets)
-        if wire_bytes:
-            obs.gauge("coll/compress_ratio").set(total_bytes / wire_bytes)
         algo = self.config.algorithm
         if algo == "auto":
             algo = ("flat" if self.world <= 2
                     or total_bytes <= self.config.flat_max_bytes else "ring")
+        if algo == "hier":
+            # viable only when hosts >= 2 contiguous groups of equal size
+            # > 1 exist; the check is a pure function of (world, config),
+            # so every rank falls back to the same plain ring — an
+            # elastic shrink to a non-divisible world must not wedge
+            L = self.world // max(self.config.hosts, 1)
+            if (self.config.hosts < 2 or L < 2
+                    or self.world % self.config.hosts):
+                obs.counter("coll/hier_fallback", unit="calls").inc()
+                algo = "ring"
+        tag = f"~algo={algo}~compress={self.config.compress}"
+        obs.counter("coll/allreduce", unit="calls").inc()
+        obs.counter(f"coll/allreduce{tag}", unit="calls").inc()
+        if wire_bytes:
+            ratio = total_bytes / wire_bytes
+            obs.gauge("coll/compress_ratio").set(ratio)
+            obs.gauge(f"coll/compress_ratio{tag}").set(ratio)
+        p0, f0 = self.bytes_posted, self.bytes_fetched
+        xp0, xf0 = self.bytes_posted_cross, self.bytes_fetched_cross
         op = self._begin_op(client)
         deadline = time.monotonic() + self.timeout_s
         io: _Prefetcher | None = None
-        if algo == "ring":
+        if algo in ("ring", "hier"):
             io = self._prefetcher(async_path)
-        reducer = self._ring if algo == "ring" else self._flat
+        reducer = {"ring": self._ring, "hier": self._hier,
+                   "flat": self._flat}[algo]
         reduced_buckets = reducer(buckets, op, client, io, deadline, on_wait)
         reduced: dict[str, list[np.ndarray]] = {}
         for b, vec in zip(buckets, reduced_buckets):
             reduced.setdefault(b.group, []).append(vec)
         out = _defuse(reduced, plan, np_leaves)
-        obs.histogram("coll/allreduce_s", unit="s").record(
-            time.perf_counter() - t_start)
+        obs.counter(f"coll/bytes_posted{tag}", unit="bytes").inc(
+            self.bytes_posted - p0)
+        obs.counter(f"coll/bytes_fetched{tag}", unit="bytes").inc(
+            self.bytes_fetched - f0)
+        if algo == "hier":
+            obs.counter(f"coll/cross_bytes_posted{tag}", unit="bytes").inc(
+                self.bytes_posted_cross - xp0)
+            obs.counter(f"coll/cross_bytes_fetched{tag}", unit="bytes").inc(
+                self.bytes_fetched_cross - xf0)
+        dt = time.perf_counter() - t_start
+        obs.histogram("coll/allreduce_s", unit="s").record(dt)
+        obs.histogram(f"coll/allreduce_s{tag}", unit="s").record(dt)
         return jax.tree.unflatten(treedef, out)
+
+    def _inject_residuals(self, buckets: list[_Bucket]) -> None:
+        """Error feedback: fold the previous op's dropped mass into this
+        rank's contribution, then arm fresh residual buffers for the
+        drops the coming encodes will record.  Replacing the dict
+        wholesale retires any bucket key the current tree no longer
+        produces (a changed tree structure must not replay stale
+        residuals into unrelated offsets)."""
+        fresh: dict[tuple[int, int], np.ndarray] = {}
+        for i, b in enumerate(buckets):
+            if b.frac is None:
+                continue
+            prev = self._residuals.get((i, b.data.size))
+            if prev is not None:
+                # new array on purpose: b.data may alias the caller's
+                # leaf (single-leaf groups fuse copy-free)
+                b.data = b.data + prev
+            b.residual = np.zeros(b.data.size, dtype=b.accum)
+            fresh[(i, b.data.size)] = b.residual
+        self._residuals = fresh
 
     def _prefetcher(self, async_path: bool) -> _Prefetcher:
         if async_path:
@@ -643,7 +859,7 @@ class HostCollectives:
         fetch per peer (staggered start — see :meth:`_peer_order`).  Best
         for tiny trees where ring round-trips dominate; O(world × size)
         fetch bytes otherwise."""
-        payload = b"".join(_encode(b.data, b.wire) for b in buckets)
+        payload = b"".join(_bucket_encode(b, b.data) for b in buckets)
         self._post(client, op, self._key(op, self.rank), payload)
         raws: dict[int, bytes] = {self.rank: payload}
         for r in self._peer_order():
@@ -658,7 +874,8 @@ class HostCollectives:
             # so compression rounding is identical on every rank and
             # float non-associativity cannot diverge replicas
             for r in range(self.world):
-                contrib = _decode(raws[r][off:off + blen], b.wire, b.accum)
+                contrib = _bucket_decode(b, raws[r][off:off + blen],
+                                         len(b.data))
                 if acc is None:
                     acc = np.array(contrib, copy=True)
                 else:
@@ -671,73 +888,215 @@ class HostCollectives:
     def _ring(self, buckets: list[_Bucket], op: int, client: CoordClient,
               io: _Prefetcher | None, deadline: float,
               on_wait: Callable[[], None] | None) -> list[np.ndarray]:
-        """Chunked ring reduce-scatter + star all-gather (see module
-        docstring).  The prefetcher keeps the NEXT hop's store wait in
-        flight while this hop's chunk is being reduced."""
+        """Chunked ring reduce-scatter + star all-gather over ALL ranks
+        (see module docstring)."""
+        jobs = [(str(bi), b, b.data, 0) for bi, b in enumerate(buckets)]
+        return self._ring_pass(op, client, io, deadline, on_wait, jobs,
+                               members=list(range(self.world)),
+                               pos=self.rank)
+
+    def _ring_pass(self, op: int, client: CoordClient,
+                   io: _Prefetcher | None, deadline: float,
+                   on_wait: Callable[[], None] | None,
+                   jobs: list[tuple[str, _Bucket, np.ndarray, int]],
+                   members: list[int], pos: int,
+                   phase_rs: str = "rs",
+                   phase_ag: str = "ag") -> list[np.ndarray]:
+        """Chunked ring reduce-scatter + star all-gather over the ranks
+        in ``members`` (this rank at position ``pos``) — the engine
+        behind both the flat-world ring and each of hier's per-shard
+        cross-host rings.  ``jobs`` carries ``(key token, bucket, vector,
+        bucket-global base offset)`` per reduction; the base offset is
+        where topk error-feedback drops land in the bucket's residual.
+        The prefetcher keeps the NEXT hop's store wait in flight while
+        this hop's chunk is being reduced."""
         assert io is not None
-        world, rank = self.world, self.rank
-        left = (rank - 1) % world
-        own_final = (rank + 1) % world  # chunk this rank finishes
-        bounds = [_chunk_bounds(len(b.data), world) for b in buckets]
-        # post every bucket's step-0 chunk up front: peers' prefetchers
+        ring = len(members)
+        left = members[(pos - 1) % ring]
+        own_final = (pos + 1) % ring  # ring position this rank finishes
+        bounds = [_chunk_bounds(len(vec), ring) for _, _, vec, _ in jobs]
+        # post every job's step-0 chunk up front: peers' prefetchers
         # find their first hop immediately, and bucket k+1's ring can
         # absorb store latency while bucket k reduces
-        for bi, b in enumerate(buckets):
-            lo, hi = bounds[bi][rank]
-            self._post(client, op, self._ring_key(op, "rs", bi, 0, rank),
-                       _encode(b.data[lo:hi], b.wire))
+        for ji, (tok, b, vec, base) in enumerate(jobs):
+            lo, hi = bounds[ji][pos]
+            self._post(client, op, self._ring_key(op, phase_rs, tok, 0,
+                                                  members[pos]),
+                       _bucket_encode(b, vec[lo:hi], base + lo))
         out: list[np.ndarray] = []
-        for bi, b in enumerate(buckets):
-            io.submit(self._ring_key(op, "rs", bi, 0, left), deadline)
+        for ji, (tok, b, vec, base) in enumerate(jobs):
+            io.submit(self._ring_key(op, phase_rs, tok, 0, left), deadline)
             final_enc: bytes | None = None
             acc: np.ndarray | None = None
-            for s in range(world - 1):
-                if s + 1 < world - 1:
+            for s in range(ring - 1):
+                if s + 1 < ring - 1:
                     # pipeline: next hop's fetch rides the prefetcher
                     # while this hop decodes + reduces
-                    io.submit(self._ring_key(op, "rs", bi, s + 1, left),
+                    io.submit(self._ring_key(op, phase_rs, tok, s + 1, left),
                               deadline)
                 t0 = time.perf_counter()
                 raw = self._take(
-                    io, self._ring_key(op, "rs", bi, s, left), deadline,
+                    io, self._ring_key(op, phase_rs, tok, s, left), deadline,
                     on_wait)
-                c = (rank - 1 - s) % world
-                lo, hi = bounds[bi][c]
+                c = (pos - 1 - s) % ring
+                lo, hi = bounds[ji][c]
                 # fp32 (accum-dtype) add of the decoded partial and this
                 # rank's own chunk; exactly ONE rank performs each hop,
                 # so the per-chunk reduction order is ring-fixed
-                acc = _decode(raw, b.wire, b.accum) + b.data[lo:hi]
-                if s + 1 < world - 1:
+                acc = _bucket_decode(b, raw, hi - lo) + vec[lo:hi]
+                if s + 1 < ring - 1:
                     self._post(
                         client, op,
-                        self._ring_key(op, "rs", bi, s + 1, rank),
-                        _encode(acc, b.wire))
+                        self._ring_key(op, phase_rs, tok, s + 1,
+                                       members[pos]),
+                        _bucket_encode(b, acc, base + lo))
                 else:
-                    final_enc = _encode(acc, b.wire)
+                    final_enc = _bucket_encode(b, acc, base + lo)
                 obs.histogram("coll/ring_chunk_s", unit="s").record(
                     time.perf_counter() - t0)
             # all-gather over the store's star topology: post the finished
             # chunk ONCE; every peer fetches the owner's single post (ring
             # forwarding would re-upload each chunk world-2 more times)
             assert final_enc is not None
-            self._post(client, op, self._ring_key(op, "ag", bi, own_final),
+            self._post(client, op,
+                       self._ring_key(op, phase_ag, tok, own_final),
                        final_enc)
-            order = [(own_final + i) % world for i in range(1, world)]
+            order = [(own_final + i) % ring for i in range(1, ring)]
             for c in order:
-                io.submit(self._ring_key(op, "ag", bi, c), deadline)
+                io.submit(self._ring_key(op, phase_ag, tok, c), deadline)
+            flo, fhi = bounds[ji][own_final]
             pieces: dict[int, np.ndarray] = {
                 # decode own ENCODED bytes, not the raw accumulator: with
-                # compression on, peers decode the posted bf16 — bitwise
-                # agreement requires this rank to do the same
-                own_final: _decode(final_enc, b.wire, b.accum)}
+                # compression on, peers decode the posted wire payload —
+                # bitwise agreement requires this rank to do the same
+                own_final: _bucket_decode(b, final_enc, fhi - flo)}
             for c in order:
-                raw = self._take(io, self._ring_key(op, "ag", bi, c),
+                raw = self._take(io, self._ring_key(op, phase_ag, tok, c),
                                  deadline, on_wait)
-                pieces[c] = _decode(raw, b.wire, b.accum)
+                lo, hi = bounds[ji][c]
+                pieces[c] = _bucket_decode(b, raw, hi - lo)
+            vec_out = np.empty(len(vec), b.accum)
+            for c in range(ring):
+                lo, hi = bounds[ji][c]
+                vec_out[lo:hi] = pieces[c]
+            out.append(vec_out)
+        return out
+
+    def _hier(self, buckets: list[_Bucket], op: int, client: CoordClient,
+              io: _Prefetcher | None, deadline: float,
+              on_wait: Callable[[], None] | None) -> list[np.ndarray]:
+        """Hierarchical allreduce: (1) reduce-scatter each bucket within
+        the host group — over the injected ICI plane when present, else
+        simulated over the store in lossless accum-dtype bytes; (2) for
+        shard ``j``, run the chunked cross-host ring among the H ranks
+        holding shard ``j`` — ONE representative per host, so the
+        cross-host wire carries ``2·(H-1)/H × size`` per HOST instead of
+        per rank, and compression (bf16/topk) applies exactly here;
+        (3) all-gather the finished shards back within the host.
+
+        Determinism: each final shard has exactly one computation path
+        (local-rank-ordered intra reduce, then ring-fixed hops), its
+        ring all-gather bytes are decoded identically by all H holders,
+        and the intra all-gather re-posts those identical arrays as raw
+        bytes — so all ``world`` ranks agree bitwise.  All three phases
+        share one deadline: a rank dying between phases surfaces as
+        :class:`PeerLost` within one ``timeout_s``."""
+        from tpudist.runtime import faults
+
+        H = self.config.hosts
+        L = self.world // H
+        host, j = divmod(self.rank, L)
+        locals_ = [host * L + i for i in range(L)]
+        plane = self.intra
+        if plane is not None and (plane.local_world != L
+                                  or plane.local_index != j):
+            raise ValueError(
+                f"intra plane spans {plane.local_world} ranks at index "
+                f"{plane.local_index}; hier expects host groups of {L} "
+                f"with this rank at local index {j}")
+        # the compiled ICI path carries f32/int32 exactly; wider dtypes
+        # (f64, int64) would narrow silently through XLA, so those buckets
+        # ride the lossless store path even when a plane is present — a
+        # pure function of the bucket dtype, so every replica agrees
+        on_plane = [plane is not None
+                    and b.accum in (np.float32, np.int32)
+                    for b in buckets]
+        sbounds = [plane.bounds(len(b.data)) if on_plane[bi]
+                   else _chunk_bounds(len(b.data), L)
+                   for bi, b in enumerate(buckets)]
+        faults.on_coll_phase("hier_intra", self.rank)
+        # -- phase 1: intra-host reduce-scatter (lossless) ------------------
+        shards: list[np.ndarray] = []
+        for bi, b in enumerate(buckets):
+            if not on_plane[bi]:
+                for i in range(L):
+                    if i == j:
+                        continue
+                    lo, hi = sbounds[bi][i]
+                    self._post(
+                        client, op,
+                        self._ring_key(op, "hrs", bi, locals_[i], self.rank),
+                        np.ascontiguousarray(b.data[lo:hi]).tobytes())
+        for bi, b in enumerate(buckets):
+            if on_plane[bi]:
+                shards.append(np.asarray(plane.reduce_scatter(b.data),
+                                         dtype=b.accum))
+                continue
+            lo, hi = sbounds[bi][j]
+            acc: np.ndarray | None = None
+            # fixed LOCAL-RANK order — the hier leg of the topology-
+            # derived reduction-order contract
+            for i in range(L):
+                if locals_[i] == self.rank:
+                    contrib: np.ndarray = b.data[lo:hi]
+                else:
+                    raw = self._fetch(
+                        client,
+                        self._ring_key(op, "hrs", bi, self.rank,
+                                       locals_[i]),
+                        deadline, on_wait)
+                    contrib = np.frombuffer(raw, dtype=b.accum)
+                acc = (np.array(contrib, copy=True) if acc is None
+                       else acc + contrib)
+            shards.append(acc if acc is not None
+                          else np.empty(0, b.accum))
+        # -- phase 2: cross-host ring, one representative per host ----------
+        faults.on_coll_phase("hier_cross", self.rank)
+        ring_members = [g * L + j for g in range(H)]
+        jobs = [(f"{bi}.{j}", b, svec, sbounds[bi][j][0])
+                for bi, (b, svec) in enumerate(zip(buckets, shards))]
+        self._in_cross = True
+        try:
+            reduced_shards = self._ring_pass(
+                op, client, io, deadline, on_wait, jobs,
+                members=ring_members, pos=host,
+                phase_rs="xrs", phase_ag="xag")
+        finally:
+            self._in_cross = False
+        # -- phase 3: intra-host all-gather of finished shards --------------
+        faults.on_coll_phase("hier_ag", self.rank)
+        for bi, rvec in enumerate(reduced_shards):
+            if not on_plane[bi]:
+                self._post(client, op,
+                           self._ring_key(op, "hag", bi, self.rank),
+                           np.ascontiguousarray(rvec).tobytes())
+        out: list[np.ndarray] = []
+        for bi, (b, rvec) in enumerate(zip(buckets, reduced_shards)):
+            if on_plane[bi]:
+                out.append(np.asarray(plane.all_gather(rvec, len(b.data)),
+                                      dtype=b.accum))
+                continue
             vec = np.empty(len(b.data), b.accum)
-            for c in range(world):
-                lo, hi = bounds[bi][c]
-                vec[lo:hi] = pieces[c]
+            lo, hi = sbounds[bi][j]
+            vec[lo:hi] = rvec
+            for i in range(L):
+                if i == j:
+                    continue
+                raw = self._fetch(
+                    client, self._ring_key(op, "hag", bi, locals_[i]),
+                    deadline, on_wait)
+                lo, hi = sbounds[bi][i]
+                vec[lo:hi] = np.frombuffer(raw, dtype=b.accum)
             out.append(vec)
         return out
 

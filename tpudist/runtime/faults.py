@@ -119,6 +119,17 @@ Environment knobs (all optional):
                                     request exactly-once (re-prefill on a
                                     surviving replica, byte-identical
                                     output)
+``TPUDIST_FAULT_COLL_KILL_PHASE``   SIGKILL self when the hierarchical
+                                    allreduce reaches this phase boundary
+                                    (``hier_intra`` / ``hier_cross`` /
+                                    ``hier_ag``) — a rank dying between the
+                                    intra-host and cross-host phases, which
+                                    survivors must surface as ``PeerLost``
+                                    within ONE shared ``timeout_s``
+``TPUDIST_FAULT_COLL_KILL_RANK``    restrict ``COLL_KILL_PHASE`` to this
+                                    collective rank (default: every rank —
+                                    only useful with the in-process raise
+                                    mode, see ``coll_kill_raise``)
 ``TPUDIST_FAULT_SEED``              RNG seed for the probabilistic knobs
 ==================================  =========================================
 """
@@ -136,7 +147,7 @@ __all__ = ["FaultInjected", "RouterKilled", "FaultPlan", "plan",
            "drop_publish", "on_segment", "on_warmup", "corrupt_canary",
            "autoscale_poll", "on_router_poll", "flip_wire_bits",
            "poison_logits", "corrupt_probe", "drop_handoff",
-           "on_handoff_published"]
+           "on_handoff_published", "on_coll_phase"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -188,6 +199,9 @@ class FaultPlan:
         probe_fail: int | None = None,
         handoff_drop: int | None = None,
         kill_at_handoff: int | None = None,
+        coll_kill_phase: str | None = None,
+        coll_kill_rank: int | None = None,
+        coll_kill_raise: bool = False,
         seed: int = 0,
     ) -> None:
         if not 0.0 <= coord_error_p <= 1.0:
@@ -260,6 +274,16 @@ class FaultPlan:
                 f"kill_at_handoff must be >= 1, got {kill_at_handoff}")
         self.kill_at_handoff = (None if kill_at_handoff is None
                                 else int(kill_at_handoff))
+        _COLL_PHASES = ("hier_intra", "hier_cross", "hier_ag")
+        if coll_kill_phase is not None and coll_kill_phase not in \
+                _COLL_PHASES:
+            raise ValueError(
+                f"coll_kill_phase must be one of {_COLL_PHASES}, got "
+                f"{coll_kill_phase!r}")
+        self.coll_kill_phase = coll_kill_phase
+        self.coll_kill_rank = (None if coll_kill_rank is None
+                               else int(coll_kill_rank))
+        self.coll_kill_raise = bool(coll_kill_raise)
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
@@ -275,7 +299,8 @@ class FaultPlan:
                          "autoscale_delay": 0, "coord_outage": 0,
                          "router_kill": 0, "wire_flip": 0,
                          "nan_logits": 0, "probe_corrupt": 0,
-                         "handoff_drop": 0, "handoff_kill": 0}
+                         "handoff_drop": 0, "handoff_kill": 0,
+                         "coll_kill": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
                            or kill_after_segments is not None
@@ -289,7 +314,8 @@ class FaultPlan:
                            or self.nan_after_tokens is not None
                            or self.probe_fail is not None
                            or self.handoff_drop is not None
-                           or self.kill_at_handoff is not None)
+                           or self.kill_at_handoff is not None
+                           or self.coll_kill_phase is not None)
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -326,6 +352,11 @@ class FaultPlan:
             kill_at_handoff=(
                 None if _env_float(env, "KILL_AT_HANDOFF") is None
                 else int(_env_float(env, "KILL_AT_HANDOFF"))),
+            coll_kill_phase=(env.get(ENV_PREFIX + "COLL_KILL_PHASE")
+                             or None),
+            coll_kill_rank=(
+                None if _env_float(env, "COLL_KILL_RANK") is None
+                else int(_env_float(env, "COLL_KILL_RANK"))),
             seed=int(_env_float(env, "SEED") or 0),
         )
 
@@ -507,6 +538,30 @@ class FaultPlan:
         if n >= self.kill_at_handoff:
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def on_coll_phase(self, phase: str, rank: int | None = None) -> None:
+        """Kill this participant when the hierarchical allreduce crosses
+        the configured phase boundary (``hier_intra`` → before the
+        intra-host reduce-scatter, ``hier_cross`` → after it and before
+        the cross-host ring, ``hier_ag`` → before the intra all-gather).
+        SIGKILL by default — the process vanishes with its intra-phase
+        contribution already consumed, the harshest mid-collective
+        death; with ``coll_kill_raise`` it raises :class:`FaultInjected`
+        instead so an in-process (thread-per-rank) harness can play the
+        dying rank while its survivor threads assert the ``PeerLost``
+        deadline.  ``coll_kill_rank`` scopes the fault to one collective
+        rank — required in thread harnesses, where every rank shares the
+        process-wide plan."""
+        if self.coll_kill_phase is None or phase != self.coll_kill_phase:
+            return
+        if self.coll_kill_rank is not None and rank != self.coll_kill_rank:
+            return
+        with self._lock:
+            self.injected["coll_kill"] += 1
+        if self.coll_kill_raise:
+            raise FaultInjected(
+                f"injected fault: collective rank {rank} killed at {phase}")
+        os.kill(os.getpid(), signal.SIGKILL)
+
     def autoscale_poll(self) -> None:
         """Stall one autoscaler control poll (a wedged control plane —
         the data plane must keep serving, just without scaling)."""
@@ -621,6 +676,12 @@ def on_handoff_published() -> None:
     p = plan()
     if p.active:
         p.on_handoff_published()
+
+
+def on_coll_phase(phase: str, rank: int | None = None) -> None:
+    p = plan()
+    if p.active:
+        p.on_coll_phase(phase, rank)
 
 
 def autoscale_poll() -> None:

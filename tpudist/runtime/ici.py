@@ -549,6 +549,138 @@ class IciCollectives:
 
         return jax.tree.map(lambda x: x * self.num_processes, mean)
 
+    # -- reduce-scatter / all-gather primitives ------------------------------
+    # True primitives (jax.lax.psum_scatter / all_gather inside the same
+    # compiled shard_map shell as the pmean) — NOT all-gather-and-reduce.
+    # They are the intra-host legs of HostCollectives' algorithm="hier"
+    # (see IciIntraHost below): each member process holds one flat
+    # vector; reduce_scatter leaves it with its shard of the sum, the
+    # cross-host ring reduces that shard over the store, and all_gather
+    # rebuilds the full vector from the finished shards.
+
+    def rs_bounds(self, n: int) -> list[tuple[int, int]]:
+        """Per-PROCESS shard boundaries used by :meth:`reduce_scatter` /
+        :meth:`all_gather` for a vector of ``n`` elements: equal padded
+        shards of ``ceil(n/world_devices) × local_rows`` elements
+        (psum_scatter tiles per DEVICE, so the per-process shard must be
+        a whole number of device tiles), clamped to ``[0, n)``.
+        Identical on every member — the hier dispatcher uses these as
+        the shard map."""
+        q = -(-n // self.world) if n else 0
+        per = q * self.local_rows
+        return [(min(n, p * per), min(n, (p + 1) * per))
+                for p in range(self.num_processes)]
+
+    def _rs_executable(self, kind: str, shape: tuple, dtype: Any) -> Any:
+        import jax
+
+        key = (kind, shape, str(dtype))
+        exe = self._execs.get(key)
+        if exe is None:
+            from tpudist.obs.xla import compile_watch
+
+            spec = jax.sharding.PartitionSpec(self.axis)
+            if kind == "rs":
+                def body(x):  # per device (1, npad) -> (1, q)
+                    return jax.lax.psum_scatter(
+                        x, self.axis, scatter_dimension=1, tiled=True)
+
+                out_spec = spec
+            else:
+                def body(x):  # per device (1, q) -> replicated (world, q)
+                    return jax.lax.all_gather(
+                        x, self.axis, axis=0, tiled=True)
+
+                out_spec = jax.sharding.PartitionSpec()
+            # check_rep can't statically infer that a tiled all-gather's
+            # output is replicated; the gather itself guarantees it
+            fn = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=spec, out_specs=out_spec,
+                check_rep=(kind == "rs")))
+            arg = jax.ShapeDtypeStruct(shape, dtype, sharding=self._sharding)
+            with compile_watch("ici"):
+                exe = fn.lower(arg).compile()
+            self._execs[key] = exe
+            self.last_hlo = exe.as_text()
+        return exe
+
+    def _local_rows_of(self, out: Any) -> list[np.ndarray]:
+        """This process's device rows of a dim-0-sharded array, in global
+        row order, asserted contiguous (the formed mesh orders devices by
+        process, which is what makes a process's shard one contiguous
+        slice)."""
+        rows = sorted(
+            ((s.index[0].start or 0, np.asarray(s.data))
+             for s in out.addressable_shards),
+            key=lambda t: t[0])
+        starts = [r for r, _ in rows]
+        if starts != list(range(starts[0], starts[0] + len(starts))):
+            raise RuntimeError(
+                f"process device rows not contiguous in mesh: {starts}")
+        return [d.reshape(-1) for _, d in rows]
+
+    def reduce_scatter(self, vec: np.ndarray) -> np.ndarray:
+        """SUM-reduce an identical-length 1-D vector across member
+        processes and return only this process's :meth:`rs_bounds` shard
+        — one compiled ``reduce-scatter``, moving ``(world-1)/world`` of
+        the bytes an all-reduce would.  Exactness: only each process's
+        FIRST device row carries the payload (the rest contribute
+        zeros), so the device-level sum equals the process-level sum
+        with no replication scaling to divide away."""
+        import jax
+
+        if self.on_check is not None:
+            self.on_check()
+        vec = np.asarray(vec)
+        n = vec.size
+        lo, hi = self.rs_bounds(n)[jax.process_index()]
+        if n == 0:
+            return np.empty(0, vec.dtype)
+        q = -(-n // self.world)
+        npad = q * self.world
+        local = np.zeros((self.local_rows, npad), dtype=vec.dtype)
+        local[0, :n] = vec
+        garr = jax.make_array_from_process_local_data(
+            self._sharding, local, (self.world, npad))
+        out = self._rs_executable("rs", (self.world, npad), vec.dtype)(garr)
+        self._wait_ready(out)
+        full = np.concatenate(self._local_rows_of(out))
+        return full[:hi - lo]
+
+    def all_gather(self, shard: np.ndarray, n: int) -> np.ndarray:
+        """Inverse of :meth:`reduce_scatter`: every process contributes
+        its ``rs_bounds`` shard of a length-``n`` vector and receives the
+        whole vector — one compiled ``all-gather``, no arithmetic, so
+        the result is bitwise the concatenation of the posted shards on
+        every member."""
+        import jax
+
+        if self.on_check is not None:
+            self.on_check()
+        shard = np.asarray(shard)
+        bounds = self.rs_bounds(n)
+        lo, hi = bounds[jax.process_index()]
+        if shard.size != hi - lo:
+            raise ValueError(
+                f"shard has {shard.size} elements; rs_bounds expects "
+                f"{hi - lo} for process {jax.process_index()} of n={n}")
+        if n == 0:
+            return np.empty(0, shard.dtype)
+        q = -(-n // self.world)
+        per = q * self.local_rows
+        buf = np.zeros(per, dtype=shard.dtype)
+        buf[:shard.size] = shard
+        local = buf.reshape(self.local_rows, q)
+        garr = jax.make_array_from_process_local_data(
+            self._sharding, local, (self.world, q))
+        out = self._rs_executable("ag", (self.world, q), shard.dtype)(garr)
+        self._wait_ready(out)
+        flat = np.asarray(out.addressable_shards[0].data) \
+            .reshape(self.num_processes, per)
+        return np.concatenate([
+            flat[p, :bounds[p][1] - bounds[p][0]]
+            for p in range(self.num_processes)])
+
     # -- async handles ------------------------------------------------------
     # XLA dispatch is ALREADY asynchronous (the executable call returns
     # before the collective completes; _wait_ready polls afterwards), so
@@ -601,3 +733,38 @@ class IciAsyncHandle:
         if self.scale != 1.0:
             row = jax.tree.map(lambda x: x * self.scale, row)
         return row
+
+
+class IciIntraHost:
+    """Adapter presenting an :class:`IciCollectives` that spans ONE
+    host's member processes as the intra-host plane of
+    ``HostCollectives(config=CollectiveConfig(algorithm="hier"), intra=...)``.
+
+    With it, hier's phase 1 and phase 3 ride the compiled ICI
+    reduce-scatter/all-gather instead of the coord store, and only the
+    per-shard cross-host ring touches the store — the deployment shape
+    the hierarchical algorithm exists for.  Requirements checked by the
+    dispatcher: the wrapped mesh's process group must be exactly this
+    rank's host group (``local_world == world // hosts``, host-contiguous
+    rank numbering), which is how the launcher numbers a gang.
+
+    The protocol (duck-typed by :class:`HostCollectives`):
+    ``local_world`` / ``local_index`` attributes, ``bounds(n)`` for the
+    shard map, ``reduce_scatter(vec)`` → this process's shard of the
+    host sum, ``all_gather(shard, n)`` → the full host vector."""
+
+    def __init__(self, coll: IciCollectives) -> None:
+        import jax
+
+        self._coll = coll
+        self.local_world = coll.num_processes
+        self.local_index = jax.process_index()
+
+    def bounds(self, n: int) -> list[tuple[int, int]]:
+        return self._coll.rs_bounds(n)
+
+    def reduce_scatter(self, vec: np.ndarray) -> np.ndarray:
+        return self._coll.reduce_scatter(vec)
+
+    def all_gather(self, shard: np.ndarray, n: int) -> np.ndarray:
+        return self._coll.all_gather(shard, n)
