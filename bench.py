@@ -3795,6 +3795,231 @@ def bench_kv_tier(on_tpu: bool) -> None:
           tier_drained=bool(owner.tier_drained() in (None, True)))
 
 
+def bench_serve_migration(on_tpu: bool) -> None:
+    """Live KV-page migration as a scheduling action (ISSUE 19), two
+    rows:
+
+    * ``serve_migration_priority`` — one loop, both best-effort slots
+      pinned by fat decode budgets while a steady stream of priority
+      requests arrives, run with ``preempt="degrade"`` (the clamp
+      baseline: priority waits for a lane) vs ``preempt="migrate"``
+      (the victim's KV pages export to the host tier, priority runs
+      NOW, the victim resumes byte-exactly).  Value is the baseline's
+      priority p99 over the migrate arm's — the acceptance floor is
+      2x.
+    * ``serve_migration_drain`` — a 2-replica fleet mid-decode, one
+      replica drained.  Graceful drain waits out every in-flight
+      budget; fast drain (``--preempt migrate``) exports the in-flight
+      slots to the surviving replica and collapses to ~one handoff
+      round trip.  Value is graceful wall over migrate wall — the
+      acceptance floor is again 2x (ISSUE 19's "<= 0.5x baseline").
+
+    Every row asserts ``exact_match`` (per-request byte-identity vs an
+    uninterrupted solo loop on the same seed-0 weights),
+    ``pool_drained``, and ``lost_requests == 0`` — migration is an
+    optimization, never a correctness event."""
+    import threading
+
+    import numpy as np
+
+    from tpudist import obs
+    from tpudist.models.serving import Request, ServeLoop
+    from tpudist.runtime.coord import CoordClient, CoordServer
+    from tpudist.runtime.router import (Router, build_tiny_lm,
+                                        drain_replicas, exit_reports,
+                                        launch_local_fleet, stop_fleet,
+                                        wait_live)
+
+    cfg, params = build_tiny_lm(seed=0)
+
+    def solo(rid, prompt, max_new):
+        lp = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                       cache_layout="paged", kv_block_size=16)
+        return tuple(int(t) for t in lp.run(
+            [Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                     max_new_tokens=max_new)])[0].tokens)
+
+    # -- row 1: priority preemption vs the degrade-clamp baseline ----------
+
+    # a DEEP best-effort backlog is what the baseline degrades on:
+    # admission is FIFO in degrade mode, so every priority request
+    # honestly waits out the queue ahead of it; migrate mode admits
+    # priority-first and preempts the in-flight victim
+    n_bes, n_vips, be_budget, vip_budget = 24, 5, 80, 8
+    be_prompts = [np.arange(i % 7 + 2, i % 7 + 10, dtype=np.int32)
+                  for i in range(n_bes)]
+    vip_prompt = np.arange(6, dtype=np.int32)
+
+    def run_arm(preempt):
+        loop = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                         cache_layout="paged", kv_block_size=16,
+                         preempt=preempt)
+        t_submit, lat = {}, {}
+        state = {"n": 0}
+        expected = n_bes + n_vips
+
+        def source():
+            state["n"] += 1
+            n = state["n"]
+            if n == 1:
+                reqs = [Request(rid=f"be{i}", prompt=p,
+                                max_new_tokens=be_budget, priority=0)
+                        for i, p in enumerate(be_prompts)]
+                for r in reqs:
+                    t_submit[r.rid] = time.perf_counter()
+                return reqs
+            if n % 5 == 0 and n // 5 <= n_vips:
+                r = Request(rid=f"vip{n // 5}", prompt=vip_prompt,
+                            max_new_tokens=vip_budget, priority=5)
+                t_submit[r.rid] = time.perf_counter()
+                return [r]
+            if len(lat) >= expected:
+                return None
+            return []
+
+        def sink(c):
+            lat[str(c.rid)] = time.perf_counter() - t_submit[str(c.rid)]
+
+        pre0 = obs.counter("serve/preempted", unit="reqs").value()
+        res0 = obs.counter("serve/resumed", unit="reqs").value()
+        comps = {str(c.rid): c for c in loop.run(
+            source=source, sink=sink, idle_wait_s=0.0)}
+        exact = all(
+            tuple(int(t) for t in comps[rid].tokens)
+            == solo(rid, comps[rid].prompt,
+                    be_budget if rid.startswith("be") else vip_budget)
+            for rid in comps)
+        vip_lat = [lat[r] for r in lat if r.startswith("vip")]
+        return {
+            "p99_s": round(float(np.percentile(vip_lat, 99)), 4),
+            "exact": exact and len(comps) == expected,
+            "drained": loop.pool is not None
+            and loop.pool.used_blocks == 0 and not loop._parked,
+            "lost": expected - len(comps),
+            "preempted": int(
+                obs.counter("serve/preempted", unit="reqs").value()
+                - pre0),
+            "resumed": int(
+                obs.counter("serve/resumed", unit="reqs").value()
+                - res0),
+        }
+
+    base = run_arm("degrade")
+    fast = run_arm("migrate")
+    _emit("serve_migration_priority",
+          round(base["p99_s"] / max(fast["p99_s"], 1e-9), 2), "x", None,
+          degrade_p99_s=base["p99_s"], migrate_p99_s=fast["p99_s"],
+          preempted=fast["preempted"], resumed=fast["resumed"],
+          baseline_preempted=base["preempted"],
+          exact_match=bool(base["exact"] and fast["exact"]),
+          pool_drained=bool(base["drained"] and fast["drained"]),
+          lost_requests=int(base["lost"] + fast["lost"]))
+
+    # -- row 2: fast drain vs graceful drain over a live fleet -------------
+
+    try:
+        server = CoordServer(0)
+    except Exception as e:  # noqa: BLE001 - native lib may be unbuilt
+        _emit("ERROR_bench_serve_migration", 0, "error", None,
+              error=f"coord server unavailable: {e}")
+        return
+
+    # a meatier model (4 layers, embed 256) makes per-token decode time
+    # real, and the budget split — one short trigger request plus five
+    # fat ones — guarantees the drained replica still holds live decode
+    # state the moment the trigger's terminal lands
+    bcfg, bparams = build_tiny_lm(64, 4, 8, 4, 256, 256)
+
+    def solo_big(rid, prompt, max_new):
+        lp = ServeLoop(bcfg, bparams, num_slots=2, steps_per_sync=4,
+                       cache_layout="paged", kv_block_size=16)
+        return tuple(int(t) for t in lp.run(
+            [Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                     max_new_tokens=max_new)])[0].tokens)
+
+    n_requests, trigger_budget, long_budget = 6, 8, 240
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 64, 6 + i).astype(np.int32)
+               for i in range(n_requests)]
+    budgets = [trigger_budget] + [long_budget] * (n_requests - 1)
+    want = {f"d{i}": solo_big(f"d{i}", p, budgets[i])
+            for i, p in enumerate(prompts)}
+    base_args = ["--cache-layout", "paged", "--kv-block-size", "16",
+                 "--ttl", "1.0", "--steps-per-sync", "4",
+                 "--prefill-chunk", "8", "--layers", "4", "--heads", "8",
+                 "--kv-heads", "4", "--embed", "256",
+                 "--seq-len", "256"]
+
+    drain_walls, arm_stats = {}, {}
+    for mode in ("graceful", "migrate"):
+        ns = f"bench-mig-{mode}"
+        client = CoordClient(port=server.port)
+        args = base_args + (["--preempt", "migrate"]
+                            if mode == "migrate" else [])
+        procs = launch_local_fleet(f"127.0.0.1:{server.port}", 2,
+                                   namespace=ns, replica_args=args)
+        comps: list = []
+        delivered: list = []
+        try:
+            wait_live(client, 2, namespace=ns, timeout_s=120.0)
+            before = obs.snapshot()["counters"]
+            router = Router(client, namespace=ns, lost_after_s=5.0)
+            reqs = [Request(prompts[i], budgets[i], rid=f"d{i}")
+                    for i in range(n_requests)]
+            th = threading.Thread(
+                target=lambda: comps.extend(router.run(
+                    reqs, timeout_s=180.0,
+                    on_complete=lambda k, c: delivered.append(c))))
+            th.start()
+            # wait for the first terminal: at that point every inbox
+            # has been picked up and the rest of the fleet is
+            # mid-decode — then drain r0 out from under its slots
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not delivered:
+                time.sleep(0.02)
+            t0 = time.perf_counter()
+            ok = drain_replicas(client, ["r0"], namespace=ns,
+                                timeout_s=90.0)
+            drain_walls[mode] = time.perf_counter() - t0
+            th.join(timeout=180.0)
+        finally:
+            stop_fleet(client, procs, namespace=ns)
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        got = {str(c.rid): tuple(int(t) for t in c.tokens)
+               for c in comps}
+        reports = exit_reports(client, namespace=ns)
+        arm_stats[mode] = {
+            "lost": n_requests - len(got),
+            "exact": all(got.get(r) == w for r, w in want.items()),
+            "drained": all(r.get("pool_drained")
+                           for r in reports.values()),
+        }
+        _emit("serve_migration_drain_arm", round(drain_walls[mode], 3),
+              "s", None, mode=mode, drain_ok=bool(ok),
+              requests=n_requests,
+              lost_requests=arm_stats[mode]["lost"],
+              exact_match=arm_stats[mode]["exact"],
+              pool_drained=arm_stats[mode]["drained"],
+              migrations=int(delta("router/migrations")),
+              migration_fallbacks=int(
+                  delta("router/migration_fallbacks")))
+    _emit("serve_migration_drain",
+          round(drain_walls["graceful"]
+                / max(drain_walls["migrate"], 1e-9), 2), "x", None,
+          graceful_drain_s=round(drain_walls["graceful"], 3),
+          migrate_drain_s=round(drain_walls["migrate"], 3),
+          exact_match=bool(all(a["exact"] for a in arm_stats.values())),
+          pool_drained=bool(all(a["drained"]
+                                for a in arm_stats.values())),
+          lost_requests=int(sum(a["lost"] for a in arm_stats.values())))
+    server.stop()
+
+
 def main() -> None:
     import jax
 
@@ -3817,7 +4042,8 @@ def main() -> None:
                bench_sim_replay, bench_router_failover,
                bench_coord_brownout, bench_corruption_quarantine,
                bench_serve_prefix_batching, bench_serve_disagg,
-               bench_kv_tier, bench_serve_alerts]
+               bench_kv_tier, bench_serve_alerts,
+               bench_serve_migration]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

@@ -475,3 +475,47 @@ class TestIntegrityKnobs:
         assert faults.flip_wire_bits(b"abc") == b"abc"
         assert not faults.poison_logits(10 ** 9)
         assert not faults.corrupt_probe("probe-r1-000000")
+
+
+class TestMigrateKnobs:
+    """ISSUE 19 chaos seams: the MIGRATE payload-drop budget and the
+    kill-at-migrate window knob (the SIGKILL itself is exercised by the
+    fleet E2E in tests/test_migration.py)."""
+
+    def test_env_parsing(self):
+        plan = FaultPlan.from_env({
+            "TPUDIST_FAULT_MIGRATE_DROP": "2",
+            "TPUDIST_FAULT_KILL_AT_MIGRATE": "1",
+        })
+        assert plan.active
+        assert plan.migrate_drop == 2
+        assert plan.kill_at_migrate == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="migrate_drop"):
+            FaultPlan(migrate_drop=0)
+        with pytest.raises(ValueError, match="kill_at_migrate"):
+            FaultPlan(kill_at_migrate=0)
+
+    def test_drop_budget_swallows_first_n_then_flows(self):
+        plan = FaultPlan(migrate_drop=2)
+        assert plan.drop_migrate()
+        assert plan.drop_migrate()
+        assert not plan.drop_migrate()      # budget spent
+        assert plan.injected["migrate_drop"] == 2
+
+    def test_drop_inert_without_knob(self):
+        plan = FaultPlan()
+        assert not plan.drop_migrate()
+        assert plan.injected["migrate_drop"] == 0
+
+    def test_migrate_drop_is_independent_of_handoff_drop(self):
+        # one knob per seam: a migrate budget never swallows handoffs
+        plan = FaultPlan(migrate_drop=1)
+        assert not plan.drop_publish()
+        assert plan.drop_migrate()
+
+    def test_on_migrate_published_inert_without_knob(self):
+        plan = FaultPlan()
+        plan.on_migrate_published()         # must not kill the test
+        assert plan.injected["migrate_kill"] == 0

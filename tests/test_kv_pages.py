@@ -175,6 +175,132 @@ class TestChurnProperty:
         assert pool.used_blocks == 0
 
 
+class TestPreemptChurnProperty:
+    """ISSUE 19 priority preemption through the allocator: the churn
+    fuzz extended with preempt/resume ops.  A preempt exports a live
+    slot's pages, parks the page bytes in the host tier, and frees the
+    slot — a paused request holds ZERO pool pages.  A later resume
+    takes the parked bytes back (byte-checked against what was
+    exported), re-adopts into the SAME pool, and decodes on.  Tier
+    eviction pressure races the resumes: a parked payload that was
+    evicted must fall back to a fresh re-admit (the re-prefill path),
+    never corrupt the pool.  ``pool.check()`` and ``tier.check()``
+    after every op; full drain at the end."""
+
+    BS = 16
+
+    @staticmethod
+    def _layers_for(rid):
+        base = np.full((16, 8), (int(rid) % 251) / 7.0, np.float32)
+        return [{"k": base, "v": base + 1.0},
+                {"k": base + 2.0, "v": base + 3.0}]
+
+    def test_preempt_park_resume_churn_drains(self):
+        from tpudist.models.kv_tier import HostTier
+
+        BS = self.BS
+        rng = np.random.default_rng(0x919)
+        S = 12 * BS
+        pool = BlockPool(24, BS, 4, S)
+        per_entry = 4 * 16 * 8 * 4           # _layers_for: 4 arrays
+        tier = HostTier(8 * per_entry)       # room for 8 parked slots
+        live: dict[int, int] = {}            # slot -> rid
+        parked: dict[int, tuple[int, int]] = {}   # rid -> (L, max_new)
+        next_rid = [0]
+        preempts = resumes = fallbacks = 0
+
+        def check_all():
+            pool.check()
+            tier.check(())
+
+        for step in range(300):
+            op = rng.random()
+            free_slots = [s for s in range(4) if s not in live]
+            if op < 0.35 and free_slots:
+                L = int(rng.integers(1, 150))
+                mn = int(rng.integers(1, min(100, S - L)))
+                if pool.can_admit(L, mn):
+                    slot = int(rng.choice(free_slots))
+                    pool.admit(slot, L, mn)
+                    live[slot] = next_rid[0]
+                    next_rid[0] += 1
+            elif op < 0.50 and live:
+                pool.grow(int(rng.choice(list(live))),
+                          int(rng.integers(1, BS)))
+            elif op < 0.62 and live:
+                slot = int(rng.choice(list(live)))
+                pool.free_slot(slot)
+                del live[slot]
+            elif op < 0.80 and live:
+                # PREEMPT: export the slot, park the bytes, free the
+                # pages — the paused request holds no pool state
+                slot = int(rng.choice(list(live)))
+                rid = live[slot]
+                man = pool.export_slot(slot)
+                L = int(man["true_len"]) if "true_len" in man \
+                    else len(man["blocks"]) * BS
+                tier.put(rid, self._layers_for(rid), parent=None)
+                pool.complete_export(slot)
+                parked[rid] = (max(1, min(L, S - 1)),
+                               int(rng.integers(1, BS)))
+                del live[slot]
+                preempts += 1
+            elif op < 0.92 and parked and free_slots:
+                # RESUME: take the parked bytes back (byte-identical)
+                # and re-adopt into the same pool; an evicted payload
+                # means re-prefill — a fresh admit, never corruption
+                rid = int(rng.choice(list(parked)))
+                L, mn = parked[rid]
+                if not pool.can_admit(L, mn):
+                    continue
+                slot = int(rng.choice(free_slots))
+                if tier.has(rid):
+                    layers = tier.take(rid)
+                    assert layers is not None
+                    for got, w in zip(layers, self._layers_for(rid)):
+                        np.testing.assert_array_equal(got["k"], w["k"])
+                        np.testing.assert_array_equal(got["v"], w["v"])
+                    blks = pool.adopt_blocks(slot, L, mn)
+                    assert len(blks) == blocks_for(L, pool.block_size)
+                    resumes += 1
+                else:
+                    pool.admit(slot, L, mn)   # payload lost: re-prefill
+                    fallbacks += 1
+                del parked[rid]
+                live[slot] = rid
+            else:
+                tier.evict_one()             # park pressure races resume
+            check_all()
+
+        # the fuzz must actually have exercised the preempt cycle
+        assert preempts > 10 and resumes > 5
+
+        # full drain: every parked request resumes (or re-prefills) and
+        # finishes; the pool must return to fully free, the tier empty
+        for rid in sorted(parked):
+            L, mn = parked[rid]
+            if (not pool.can_admit(L, mn)
+                    or all(s in live for s in range(4))):
+                for s in list(live):
+                    pool.free_slot(s)
+                    del live[s]
+            slot = next(s for s in range(4) if s not in live)
+            if tier.has(rid):
+                tier.take(rid)
+            pool.admit(slot, L, mn)
+            live[slot] = rid
+            check_all()
+            pool.free_slot(slot)
+            del live[slot]
+        for slot in list(live):
+            pool.free_slot(slot)
+        tier.flush()
+        check_all()
+        assert pool.free_blocks == pool.num_blocks
+        assert pool.used_blocks == 0
+        assert len(tier) == 0
+
+
 class TestServeChurnEndToEnd:
     def test_serve_churn_returns_pool_to_free(self):
         """The ISSUE's churn property through the REAL ServeLoop:

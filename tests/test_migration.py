@@ -1,0 +1,393 @@
+"""Live KV-page migration as a scheduling action (ISSUE 19): the
+payload riders (generated tokens + weights version) through the codec,
+in-process priority preemption (park to the host tier, resume, finish
+byte-identically), evacuation exports adopted by a second loop, the
+version gate refusing cross-roll pages, and the chaos seams — a
+MIGRATE_DROP'd payload and a replica SIGKILLed mid-migration must both
+end in byte-identical terminals with zero lost requests."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpudist import obs
+from tpudist.models.serving import Request, ServeLoop
+from tpudist.runtime import faults
+from tpudist.runtime.disagg import (
+    CoordKVTransport, decode_payload, encode_payload)
+from tpudist.runtime.faults import FaultPlan
+from tpudist.runtime.router import (
+    Router, build_tiny_lm, drain_replicas, exit_reports,
+    launch_local_fleet, stop_fleet, wait_live)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _counter(name):
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = build_tiny_lm(seed=0)
+    return _MODEL
+
+
+def _loop(**kw):
+    cfg, params = _model()
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("steps_per_sync", 4)
+    kw.setdefault("cache_layout", "paged")
+    kw.setdefault("kv_block_size", 16)
+    return ServeLoop(cfg, params, **kw)
+
+
+def _solo(rid, prompt, max_new):
+    return [int(t) for t in _loop().run(
+        [Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                 max_new_tokens=max_new)])[0].tokens]
+
+
+# -- payload riders --------------------------------------------------------
+
+class TestMigrationRiders:
+    def test_generated_and_version_survive_the_codec(self):
+        import json
+        rng = np.random.default_rng(3)
+        p = {"key": "k", "rid": "r", "prompt": [3, 1, 4],
+             "max_new_tokens": 9, "first": 7, "true_len": 5,
+             "block_size": 8, "chain": [11], "published_at": 0.0,
+             "generated": [5, 9], "version": 4,
+             "layers": [{"k": rng.standard_normal((1, 8, 4))
+                         .astype(np.float32),
+                         "v": rng.standard_normal((1, 8, 4))
+                         .astype(np.float32)}]}
+        got = decode_payload(json.loads(json.dumps(encode_payload(p))))
+        assert got["generated"] == [5, 9] and got["version"] == 4
+
+    def test_handoff_payload_stays_riderless(self):
+        p = {"key": "k", "rid": "r", "prompt": [1], "max_new_tokens": 2,
+             "first": 0, "true_len": 1, "block_size": 8, "chain": [],
+             "published_at": 0.0, "layers": []}
+        doc = encode_payload(p)
+        assert "generated" not in doc and "version" not in doc
+        assert "generated" not in decode_payload(doc)
+
+    def test_migrate_kind_routes_through_migrate_drop(self):
+        class _KV:
+            def __init__(self):
+                self.kv = {}
+
+            def get(self, key):
+                return self.kv.get(key)
+
+            def set(self, key, value):
+                self.kv[key] = value
+
+            def delete(self, key):
+                self.kv.pop(key, None)
+
+        store = _KV()
+        t = CoordKVTransport(store, namespace="m")
+        rng = np.random.default_rng(0)
+        p = {"key": "k1", "rid": "r", "prompt": [1, 2],
+             "max_new_tokens": 4, "first": 3, "true_len": 2,
+             "block_size": 8, "chain": [], "published_at": 0.0,
+             "layers": [{"k": rng.standard_normal((1, 8, 2))
+                         .astype(np.float32),
+                         "v": rng.standard_normal((1, 8, 2))
+                         .astype(np.float32)}]}
+        faults.install(FaultPlan(migrate_drop=1))
+        # a handoff-kind publish is NOT affected by MIGRATE_DROP
+        ref, _ = t.publish("k1", p)
+        assert t.fetch(ref) is not None
+        # the first migrate-kind publish is swallowed in flight:
+        # ref returned (the exporter believes it landed), fetch None
+        ref2, _ = t.publish("k2", p, kind="migrate")
+        assert t.fetch(ref2) is None
+        # the injection budget is spent; the next migrate lands
+        ref3, _ = t.publish("k3", p, kind="migrate")
+        assert t.fetch(ref3) is not None
+
+
+# -- in-process preemption, resume, evacuation -----------------------------
+
+class TestPreemptResume:
+    def test_priority_preempts_and_everything_stays_exact(self):
+        """Both slots pinned by fat best-effort budgets; a priority
+        request must preempt (export -> host-tier park), run NOW, and
+        every request — including the paused-and-resumed victim — must
+        finish byte-identical to an uninterrupted solo run."""
+        loop = _loop(preempt="migrate")
+        script = [
+            [Request(rid=f"be{i}", prompt=np.arange(8, dtype=np.int32),
+                     max_new_tokens=48, priority=0) for i in range(2)],
+            [], [],
+            [Request(rid="vip", prompt=np.arange(6, dtype=np.int32),
+                     max_new_tokens=8, priority=5)],
+        ] + [[]] * 200 + [None]
+        it = iter(script)
+        pre0 = _counter("serve/preempted")
+        res0 = _counter("serve/resumed")
+        comps = {str(c.rid): c for c in loop.run(
+            source=lambda: next(it, None), sink=lambda c: None,
+            idle_wait_s=0.0)}
+        assert sorted(comps) == ["be0", "be1", "vip"]
+        assert _counter("serve/preempted") - pre0 >= 1
+        assert _counter("serve/resumed") - res0 >= 1
+        for rid, c in comps.items():
+            mn = 8 if rid == "vip" else 48
+            assert [int(t) for t in c.tokens] == \
+                _solo(rid, c.prompt, mn), rid
+        assert loop.pool.used_blocks == 0
+        assert not loop._parked
+        assert loop.tier_drained() in (None, True)
+
+    def test_degrade_mode_never_preempts(self):
+        loop = _loop()   # default preempt="degrade"
+        script = [
+            [Request(rid="be0", prompt=np.arange(8, dtype=np.int32),
+                     max_new_tokens=24, priority=0)],
+            [Request(rid="vip", prompt=np.arange(6, dtype=np.int32),
+                     max_new_tokens=8, priority=5)],
+        ] + [[]] * 200 + [None]
+        it = iter(script)
+        pre0 = _counter("serve/preempted")
+        comps = loop.run(source=lambda: next(it, None),
+                         sink=lambda c: None, idle_wait_s=0.0)
+        assert len(comps) == 2
+        assert _counter("serve/preempted") - pre0 == 0
+
+    def test_evacuation_exports_and_peer_adopts_exactly(self):
+        """request_evacuate() exports every in-flight slot with a
+        payload and every queued request ref-less; a second loop adopts
+        the payloads mid-decode and finishes byte-identical."""
+        loop1 = _loop(preempt="migrate")
+        state = {"n": 0}
+
+        def source():
+            state["n"] += 1
+            if state["n"] == 1:
+                return [Request(rid="a",
+                                prompt=np.arange(9, dtype=np.int32),
+                                max_new_tokens=30),
+                        Request(rid="b",
+                                prompt=np.arange(7, dtype=np.int32),
+                                max_new_tokens=30),
+                        Request(rid="q",
+                                prompt=np.arange(5, dtype=np.int32),
+                                max_new_tokens=30)]
+            if state["n"] == 4:
+                loop1.request_evacuate()
+            return None if state["n"] > 4 else []
+
+        out1 = loop1.run(source=source, sink=lambda c: None,
+                         idle_wait_s=0.0)
+        mig = {str(c.rid): c for c in out1 if c.reason == "migrate"}
+        assert sorted(mig) == ["a", "b", "q"]
+        with_payload = {r for r, c in mig.items()
+                        if c.handoff is not None}
+        assert with_payload == {"a", "b"}   # q never held a slot
+        assert loop1.pool.used_blocks == 0 and not loop1._parked
+
+        loop2 = _loop(preempt="migrate")
+        reqs2 = []
+        for rid, c in mig.items():
+            orig = Request(rid=rid, prompt=np.asarray(c.prompt, np.int32),
+                           max_new_tokens=30)
+            reqs2.append(
+                dataclasses.replace(orig, kv_handoff=c.handoff)
+                if c.handoff is not None else orig)
+        ad0 = _counter("serve/adoptions")
+        out2 = {str(c.rid): [int(t) for t in c.tokens]
+                for c in loop2.run(reqs2)}
+        assert _counter("serve/adoptions") - ad0 == 2
+        for rid, c in mig.items():
+            assert out2[rid] == _solo(rid, c.prompt, 30), rid
+
+    def test_version_gate_refuses_cross_roll_pages(self):
+        """A migration payload stamped with a different weights version
+        must NOT be adopted — the adopter re-prefills and the output is
+        still byte-identical (fleet-identical weights)."""
+        loop1 = _loop(preempt="migrate")
+        state = {"n": 0}
+
+        def source():
+            state["n"] += 1
+            if state["n"] == 1:
+                return [Request(rid="v",
+                                prompt=np.arange(6, dtype=np.int32),
+                                max_new_tokens=20)]
+            if state["n"] == 3:
+                loop1.request_evacuate()
+            return None if state["n"] > 3 else []
+
+        out1 = loop1.run(source=source, sink=lambda c: None,
+                         idle_wait_s=0.0)
+        c = next(x for x in out1 if x.reason == "migrate")
+        assert c.handoff is not None and "version" in c.handoff
+        stale = dict(c.handoff)
+        stale["version"] = int(stale["version"]) + 1
+        loop2 = _loop(preempt="migrate")
+        ad0 = _counter("serve/adoptions")
+        out2 = loop2.run([dataclasses.replace(
+            Request(rid="v", prompt=np.asarray(c.prompt, np.int32),
+                    max_new_tokens=20), kv_handoff=stale)])
+        assert _counter("serve/adoptions") - ad0 == 0
+        assert [int(t) for t in out2[0].tokens] == \
+            _solo("v", c.prompt, 20)
+
+
+# -- chaos E2Es over a real fleet ------------------------------------------
+
+def _coord_pair():
+    try:
+        from tpudist.runtime.coord import CoordClient, CoordServer
+
+        server = CoordServer(0)
+    except Exception as e:  # NativeUnavailable or build failure
+        pytest.skip(f"native coord store unavailable: {e}")
+    return server, CoordClient("127.0.0.1", server.port)
+
+
+_BIG = None
+
+
+def _big_model():
+    """A meatier config (4 layers, embed 256) shared by the chaos E2Es
+    and their solo references: per-token decode time is real, so the
+    drain reliably catches live in-flight state on the victim."""
+    global _BIG
+    if _BIG is None:
+        _BIG = build_tiny_lm(64, 4, 8, 4, 256, 256)
+    return _BIG
+
+
+def _solo_big(rid, prompt, max_new):
+    cfg, params = _big_model()
+    lp = ServeLoop(cfg, params, num_slots=2, steps_per_sync=4,
+                   cache_layout="paged", kv_block_size=16)
+    return [int(t) for t in lp.run(
+        [Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                 max_new_tokens=max_new)])[0].tokens]
+
+
+def _drain_requests():
+    """One short request (the drain trigger: its terminal proves the
+    fleet is mid-decode) and three fat ones so the drained replica is
+    guaranteed to hold live decode state when the drain fires."""
+    rng = np.random.default_rng(5)
+    out = [Request(rng.integers(0, 64, 5).astype(np.int32), 8,
+                   rid="m0")]
+    out += [Request(rng.integers(0, 64, 6 + i).astype(np.int32), 200,
+                    rid=f"m{i + 1}") for i in range(3)]
+    return out
+
+
+def _run_drain_fleet(ns, server, client, *, env0):
+    """Launch 2 unified --preempt migrate replicas (r0 carrying the
+    fault env), route 4 requests, drain r0 the moment the first
+    terminal lands, and return (completions, procs)."""
+    base = ["--cache-layout", "paged", "--kv-block-size", "16",
+            "--ttl", "1.0", "--steps-per-sync", "4",
+            "--prefill-chunk", "8", "--preempt", "migrate",
+            "--layers", "4", "--heads", "8", "--kv-heads", "4",
+            "--embed", "256", "--seq-len", "256"]
+    procs = launch_local_fleet(
+        f"127.0.0.1:{server.port}", 2, namespace=ns,
+        replica_args=base, env_overrides={0: env0})
+    comps: list = []
+    delivered: list = []
+    try:
+        wait_live(client, 2, namespace=ns, timeout_s=90.0)
+        router = Router(client, namespace=ns, lost_after_s=5.0)
+        th = threading.Thread(
+            target=lambda: comps.extend(router.run(
+                _drain_requests(), timeout_s=120.0,
+                on_complete=lambda k, c: delivered.append(c))))
+        th.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not delivered:
+            time.sleep(0.02)
+        drain_replicas(client, ["r0"], namespace=ns, timeout_s=90.0)
+        th.join(timeout=150.0)
+    finally:
+        stop_fleet(client, procs, namespace=ns)
+    return comps, procs
+
+
+@pytest.mark.slow  # two subprocess fleets decoding a 4-layer/embed-256 model
+class TestMigrationChaosE2E:
+    def _check_exact(self, comps):
+        want = {str(r.rid): _solo_big(r.rid, r.prompt, r.max_new_tokens)
+                for r in _drain_requests()}
+        assert sorted(str(c.rid) for c in comps) == sorted(want)
+        for c in comps:
+            assert [int(t) for t in c.tokens] == want[str(c.rid)], c.rid
+
+    def test_migrate_drop_falls_back_byte_identical(self):
+        """The drained replica's first migrate payload is swallowed in
+        flight (TPUDIST_FAULT_MIGRATE_DROP=1): the commit still lands,
+        the adopter's fetch misses, and the request re-prefills to a
+        byte-identical terminal — zero lost, fallback counted."""
+        server, client = _coord_pair()
+        before = obs.snapshot()["counters"]
+        comps, procs = _run_drain_fleet(
+            "mig-drop", server, client,
+            env0={"TPUDIST_FAULT_MIGRATE_DROP": "1"})
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        self._check_exact(comps)
+        assert delta("router/migrations") >= 1
+        assert delta("router/migration_fallbacks") >= 1
+        reports = exit_reports(client, namespace="mig-drop")
+        assert all(r.get("pool_drained") for r in reports.values())
+        assert client.keys("mig-drop/kv/") == []
+        server.stop()
+
+    def test_kill_at_migrate_zero_lost_exact(self):
+        """The harshest migration window: r0 SIGKILLs itself right
+        after publishing its first migrate payload, BEFORE the migrate
+        done record commits.  The router sweeps the departure (counted
+        as a drain, since the drain was already in flight when the kill
+        landed), redispatches the orphaned work, and delivers every
+        request exactly once, byte-identical."""
+        server, client = _coord_pair()
+        before = obs.snapshot()["counters"]
+        comps, procs = _run_drain_fleet(
+            "mig-kill", server, client,
+            env0={"TPUDIST_FAULT_KILL_AT_MIGRATE": "1"})
+        after = obs.snapshot()["counters"]
+
+        def delta(name):
+            return (after.get(name, {}).get("value", 0)
+                    - before.get(name, {}).get("value", 0))
+
+        self._check_exact(comps)
+        assert procs[0].returncode == -9   # SIGKILL, not a clean exit
+        # the sweep classifies the lapse as a death OR — when the kill
+        # raced an in-flight drain — a drain departure; either way the
+        # orphaned requests were redispatched, never lost
+        assert (delta("router/replica_deaths")
+                + delta("router/drains")) >= 1
+        assert delta("router/redispatched") >= 1
+        # the dead exporter leaves no report; the survivor drains clean
+        reports = exit_reports(client, namespace="mig-kill")
+        assert all(r.get("pool_drained") for r in reports.values())
+        server.stop()

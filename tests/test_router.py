@@ -963,3 +963,68 @@ class TestFleetE2E:
         assert set(reports) == {"r0", "r1"}
         assert all(r["clean"] and r["pool_drained"]
                    for r in reports.values())
+
+
+class TestRebalanceUnit:
+    """ISSUE 19 hot/cold rebalancing: the skew detector and the victim
+    picker are pure static helpers — unit-tested on synthetic loads."""
+
+    def test_depth_gap_flags_hot_and_cold(self):
+        loads = {"r0": {"queue_depth": 3}, "r1": {"queue_depth": 0}}
+        got = Router.rebalance_hot_cold(loads, ["r0", "r1"],
+                                        {"r0": 1, "r1": 0})
+        assert got == ("r0", "r1")
+
+    def test_gap_below_min_gap_is_noise(self):
+        loads = {"r0": {"queue_depth": 1}, "r1": {"queue_depth": 0}}
+        assert Router.rebalance_hot_cold(
+            loads, ["r0", "r1"], {}) is None
+
+    def test_assigned_counts_toward_depth(self):
+        # no published queue depth at all: router-side assignment
+        # counts alone can flag the skew
+        got = Router.rebalance_hot_cold({}, ["r0", "r1"],
+                                        {"r0": 4, "r1": 1})
+        assert got == ("r0", "r1")
+
+    def test_wait_percentile_skew_flags_below_depth_gap(self):
+        # depth gap below min_gap, but the hot replica's queue-wait
+        # quantile is 2x the coolest's non-zero one
+        loads = {"r0": {"queue_depth": 2, "queue_wait_q": 0.9},
+                 "r1": {"queue_depth": 1, "queue_wait_q": 0.3}}
+        assert Router.rebalance_hot_cold(
+            loads, ["r0", "r1"], {}) == ("r0", "r1")
+
+    def test_zero_cold_wait_never_divides_into_a_signal(self):
+        loads = {"r0": {"queue_depth": 2, "queue_wait_q": 5.0},
+                 "r1": {"queue_depth": 1, "queue_wait_q": 0.0}}
+        assert Router.rebalance_hot_cold(
+            loads, ["r0", "r1"], {}) is None
+
+    def test_single_candidate_is_never_skewed(self):
+        assert Router.rebalance_hot_cold(
+            {"r0": {"queue_depth": 9}}, ["r0"], {}) is None
+
+    def test_min_gap_is_tunable(self):
+        loads = {"r0": {"queue_depth": 1}, "r1": {"queue_depth": 0}}
+        assert Router.rebalance_hot_cold(
+            loads, ["r0", "r1"], {}, min_gap=1) == ("r0", "r1")
+
+    def test_victim_is_oldest_outstanding_on_hot(self):
+        entries = {"k2": {"assigned": "r0"},
+                   "k1": {"assigned": "r0"},
+                   "k0": {"assigned": "r1"}}
+        assert Router.rebalance_victim(entries, {}, "r0") == "k1"
+
+    def test_victim_skips_done_migrating_and_pull(self):
+        entries = {"k1": {"assigned": "r0"},
+                   "k2": {"assigned": "r0"},
+                   "k3": {"assigned": "r0", "stage": "pull"},
+                   "k4": {"assigned": "r0"}}
+        got = Router.rebalance_victim(entries, {"k1": object()}, "r0",
+                                      migrating=("k2",))
+        assert got == "k4"
+
+    def test_no_eligible_victim_returns_none(self):
+        entries = {"k1": {"assigned": "r1"}}
+        assert Router.rebalance_victim(entries, {}, "r0") is None

@@ -345,6 +345,8 @@ def _passing_row(name: str) -> dict:
             "scale_ups_decode": env.min_scale_ups_decode,
             "p99_ttft_s": 0.05,
             "priority_bad": 0, "replica_deaths": 0,
+            "preemptions": env.min_preemptions,
+            "p99_priority_wait_s": 0.05,
             "router_recoveries": env.min_router_recoveries,
             "quarantines": env.min_quarantines,
             "reinstated": env.min_reinstated,
@@ -748,3 +750,33 @@ class TestSimCLI:
         spath.write_text(json.dumps(spec))
         assert sim_main(["--spec", str(spath), "--check"]) == 1
         assert "envelope VIOLATED" in capsys.readouterr().err
+
+
+class TestPrioritySaturation:
+    """ISSUE 19's ``priority_saturation`` builtin end-to-end: an
+    oversaturated single replica in migrate mode must preempt
+    best-effort decodes so priority traffic meets its queue-wait
+    ceiling — and the SAME workload with the preemption knob off must
+    fail exactly those envelope gates (proof the gate is real, not
+    vacuously green)."""
+
+    def test_builtin_envelope_passes_with_preemption(self):
+        from tpudist.sim.simulator import FleetSim
+
+        row = FleetSim(builtin("priority_saturation")).run()
+        assert row["envelope_ok"], row["violations"]
+        assert row["preemptions"] >= 5
+        assert row["preempt_resumes"] >= 1
+        assert row["lost_requests"] == 0
+        assert row["p99_priority_wait_s"] <= 0.5
+
+    def test_degrade_baseline_fails_the_priority_gates(self):
+        from tpudist.sim.simulator import FleetSim
+
+        raw = dict(BUILTIN["priority_saturation"])
+        raw["fleet"] = dict(raw["fleet"], preempt="degrade")
+        row = FleetSim(ScenarioSpec.from_dict(raw)).run()
+        assert not row["envelope_ok"]
+        viol = " ".join(row["violations"])
+        assert "p99_priority_wait_s" in viol
+        assert "preemptions" in viol

@@ -37,6 +37,7 @@ same kernels either way.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import time
 from collections import deque
@@ -69,6 +70,15 @@ from tpudist.models.transformer import TransformerConfig, TransformerLM
 # placeholder page row for the dense layout's admit signature (the insert
 # walk never reaches a paged node there)
 _NO_PAGES = np.zeros((0,), np.int32)
+
+
+def _park_hash(rid: str, i: int) -> int:
+    """Synthetic host-tier key for a parked (preempted) slot's i-th KV
+    block: a 63-bit blake2b digest of ``(rid, i)`` — int-typed as the
+    tier requires, and disjoint from prefix chain hashes with
+    overwhelming probability."""
+    d = hashlib.blake2b(f"park:{rid}:{i}".encode(), digest_size=8)
+    return int.from_bytes(d.digest(), "big") >> 1
 
 
 @dataclasses.dataclass
@@ -327,6 +337,7 @@ class ServeLoop:
         chunked_prefill: bool = True,
         prefix_sharing: bool = True,
         role: str = "both",
+        preempt: str = "degrade",
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -367,6 +378,22 @@ class ServeLoop:
             raise ValueError(
                 "role='decode' needs cache_layout='paged': handoff "
                 "adoption scatters migrated pages into the block pool")
+        if preempt not in ("degrade", "migrate"):
+            raise ValueError(
+                f"preempt must be 'degrade' or 'migrate', got "
+                f"{preempt!r}")
+        if preempt == "migrate" and cache_layout != "paged":
+            raise ValueError(
+                "preempt='migrate' needs cache_layout='paged': "
+                "preemption exports the victim slot's pool pages")
+        # pressure policy: 'degrade' clamps best-effort budgets under a
+        # degrade_queue breach (the PR 10 ladder); 'migrate' PAUSES them
+        # instead — the victim slot's KV pages export to the host tier
+        # (or a dict park) and re-adopt when pressure clears, so
+        # best-effort output is byte-identical to the undisturbed run.
+        # 'migrate' also makes admission priority-first and lets the
+        # worker evacuate in-flight work at drain/swap time.
+        self.preempt = preempt
         self.role = role
         self.cfg = cfg
         self.params = params
@@ -687,6 +714,25 @@ class ServeLoop:
         self._obs_adoptions = obs.counter("serve/adoptions", unit="reqs")
         self._obs_handoff_fallbacks = obs.counter(
             "serve/handoff_fallbacks", unit="reqs")
+        # live-migration accounting (PR 19): preempted/resumed count the
+        # LOCAL park/unpark cycle (priority preemption), migrated_out
+        # counts reason="migrate" exports handed to the router
+        # (rebalance + fast drain) — the fleet-level mirror lives on
+        # router/migrations
+        self._obs_preempted = obs.counter("serve/preempted", unit="reqs")
+        self._obs_resumed = obs.counter("serve/resumed", unit="reqs")
+        self._obs_migrated_out = obs.counter("serve/migrated_out",
+                                             unit="reqs")
+        # rid -> parked entry: the request, its original enqueue time,
+        # and the exported payload — either whole ("payload") or with
+        # its page bytes spilled per-block into the host tier ("keys",
+        # "meta"); loss anywhere falls back to re-prefill, byte-exact
+        self._parked: dict[str, dict] = {}
+        # router-initiated migration intents, consumed by the run loop:
+        # request keys to migrate out (rebalance) / evacuate-everything
+        # (fast drain, fast swap)
+        self._migrate_rids: set[str] = set()
+        self._evacuate = False
         if self.chunked:
             # chunked admission's three dispatches: (a) gather a shared
             # prefix's pool blocks into the dense batch-1 prefill cache
@@ -1787,30 +1833,116 @@ class ServeLoop:
         self.pool.complete_export(slot)
         return payload
 
+    def _build_migration(self, slot: int, st: dict) -> dict:
+        """Serialize an IN-FLIGHT decode slot as a migration payload
+        and free the slot — the mid-decode sibling of
+        :meth:`_build_handoff`, used by priority preemption (local
+        park), hot/cold rebalancing, and fast drain.
+
+        The caller must have resolved every in-flight segment first
+        (the host token list is final, no stale merge can touch the
+        exported pages) and frozen the lane on device.  The payload's
+        ``generated`` rider carries every emitted token but the last;
+        the last emitted token travels as ``first`` (the adopter's
+        deferred-first lane stamp re-emits it), so the resumed output
+        concatenates to exactly the uninterrupted sequence.  The
+        ``version`` stamp keeps a roll in flight from mixing KV across
+        weight versions — a mismatched adopter re-prefills instead."""
+        req = st["req"]
+        tokens = st["tokens"]
+        prompt = np.asarray(req.prompt, np.int32)
+        if st.get("pending_first") or not tokens:
+            # the deferred first token is still device-side (fresh
+            # admission — or a re-exported ADOPTION, whose seeded
+            # tokens are already page-covered and ride ``generated``)
+            first = int(self._first[slot])
+            generated = [int(t) for t in tokens]
+        else:
+            first = int(tokens[-1])
+            generated = [int(t) for t in tokens[:-1]]
+        prompt_eff = (np.concatenate(
+            [prompt, np.asarray(generated, np.int32)])
+            if generated else prompt)
+        true_len = int(prompt.size) + len(generated)
+        manifest = self.pool.export_slot(slot)
+        # the pool grows lanes a segment ahead of the watermark; the
+        # adopter allocates exactly ceil(true_len / bs), so trim the
+        # gather to the pages real KV occupies
+        n_used = -(-true_len // self.kv_block_size)
+        pages = np.asarray(manifest["blocks"], np.int32)[:n_used]
+        layers = [{"k": np.asarray(node["paged_key"][pages]),
+                   "v": np.asarray(node["paged_value"][pages])}
+                  for node in self._paged_nodes(self.cache)]
+        payload = {
+            "key": None,   # stamped by the worker at publish
+            "rid": req.rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "first": first,
+            "generated": generated,
+            "true_len": true_len,
+            "block_size": int(self.kv_block_size),
+            "chain": chain_hashes(prompt_eff, self.kv_block_size),
+            "published_at": time.time(),
+            "version": int(self.weights_version),
+            "layers": layers,
+        }
+        self.pool.complete_export(slot)
+        return payload
+
     def _admit_adopt(self, slot: int, req: Request, prompt: np.ndarray,
                      L: int) -> dict | None:
         """Admit ``req`` by ADOPTING its migrated KV payload — zero
         prefill compute.  Returns ``None`` when the payload fails any
         verification gate (structure, lengths, block size, prefix-hash
-        chain, layer count/shape): the caller falls back to an ordinary
-        re-prefill of the carried prompt, which greedy decoding over
-        fleet-identical weights makes byte-identical."""
+        chain, layer count/shape, weights version): the caller falls
+        back to an ordinary re-prefill of the carried prompt, which
+        greedy decoding over fleet-identical weights makes
+        byte-identical.
+
+        A MID-DECODE payload (preemption / rebalance / drain) carries
+        ``generated`` — tokens the exporter already emitted, excluding
+        the ``first`` rider.  The pages then cover prompt+generated,
+        the chain is recomputed over that effective prompt, the slot's
+        output list is SEEDED with the generated tokens, and the
+        remaining budget shrinks by their count — so the terminal
+        completion carries the full byte-identical token sequence and
+        the original request (deadline, trace, priority) rides along
+        untouched."""
         payload = req.kv_handoff
         try:
             first = int(payload["first"])
             true_len = int(payload["true_len"])
             bs = int(payload["block_size"])
             chain = [int(h) for h in payload["chain"]]
+            generated = [int(t) for t in payload.get("generated", ())]
             layers = payload["layers"]
         except (KeyError, TypeError, ValueError):
             return None
+        if "version" in payload:
+            # KV computed under one weights version must never continue
+            # under another (a roll in flight): refuse and re-prefill
+            # under THIS replica's weights instead
+            try:
+                if int(payload["version"]) != self.weights_version:
+                    return None
+            except (TypeError, ValueError):
+                return None
+        if generated:
+            prompt_eff = np.concatenate(
+                [prompt, np.asarray(generated, np.int32)])
+        else:
+            prompt_eff = prompt
+        L_eff = L + len(generated)
+        max_new_eff = int(req.max_new_tokens) - len(generated)
         nodes = self._paged_nodes(self.cache)
-        if (true_len != L or bs != self.kv_block_size
-                or chain != chain_hashes(prompt, self.kv_block_size)
+        if (true_len != L_eff or bs != self.kv_block_size
+                or max_new_eff < 1
+                or chain != chain_hashes(prompt_eff, self.kv_block_size)
                 or len(layers) != len(nodes)):
             return None
-        max_new = int(req.max_new_tokens)
-        blocks = self.pool.adopt_blocks(slot, L, max_new)
+        max_new = max_new_eff
+        blocks = self.pool.adopt_blocks(slot, L_eff, max_new)
         m_used = len(blocks)
         kv = []
         for l in layers:
@@ -1836,8 +1968,12 @@ class ServeLoop:
             np.int32(first))
         self._obs_adoptions.inc()
         obs.recorder.record("serve_adopt", slot=slot, prompt_len=L,
-                            blocks=m_used)
-        return {"req": req, "tokens": [], "pending_first": True}
+                            blocks=m_used, generated=len(generated))
+        # seed the output with the exporter's already-emitted tokens:
+        # the terminal completion replaces the exporter's partial state
+        # wholesale, so the router never assembles tokens across hops
+        return {"req": req, "tokens": list(generated),
+                "pending_first": True}
 
     def _plan_steps(self, slot_state) -> int:
         """Per-dispatch segment length: ``steps_per_sync``, CLAMPED
@@ -1898,6 +2034,27 @@ class ServeLoop:
         already pending."""
         self._pending_swap = {"fn": params_fn, "version": version,
                               "on_swapped": on_swapped}
+
+    def request_migrate(self, rids) -> None:
+        """Ask the loop to migrate the named requests OUT (hot/cold
+        rebalancing): at the next safe point each named request —
+        queued, parked, or in-flight — leaves as a
+        ``reason="migrate"`` completion carrying its exported KV
+        payload (in-flight) or nothing (queued: a ref-less requeue the
+        router redispatches as a fresh, byte-identical prefill).
+        Unknown rids are ignored — the request finished first, and its
+        normal terminal wins.  Callable from the ``source()`` callback;
+        the loop is single-threaded, so no locking."""
+        self._migrate_rids.update(str(r) for r in rids)
+
+    def request_evacuate(self) -> None:
+        """Ask the loop to migrate EVERYTHING out — queued, parked, and
+        in-flight (fast drain): the worker calls this when its replica
+        is marked draining, collapsing drain time from "longest
+        remaining decode" to roughly one handoff RTT.  Idempotent; the
+        flag clears after one evacuation pass, so a draining worker
+        re-arms it every poll to bounce late arrivals too."""
+        self._evacuate = True
 
     def run(self, requests: Sequence[Request] = (), *,
             source=None, sink=None,
@@ -2101,7 +2258,18 @@ class ServeLoop:
                 return
             for slot in range(self.B):
                 if slot_state[slot] is None and pending:
-                    req, t_q = pending[0]
+                    if self.preempt == "migrate":
+                        # priority-first admission: the best waiting
+                        # class jumps the queue (FIFO within a class);
+                        # a blocked high-priority head is what arms
+                        # maybe_preempt rather than starving behind
+                        # best-effort arrivals
+                        sel = max(range(len(pending)),
+                                  key=lambda i: (pending[i][0].priority,
+                                                 -i))
+                    else:
+                        sel = 0
+                    req, t_q = pending[sel]
                     if self.pool is not None:
                         L_q = int(np.asarray(req.prompt).size)
                         if self._prefix_cache is not None:
@@ -2124,8 +2292,9 @@ class ServeLoop:
                             # request behind it, which would starve
                             # long prompts
                             break
-                    pending.popleft()
-                    if (self._degraded and req.priority <= 0
+                    del pending[sel]
+                    if (self.preempt != "migrate" and self._degraded
+                            and req.priority <= 0
                             and req.max_new_tokens > self.degrade_max_new):
                         # degraded mode: best-effort traffic gets a short
                         # answer instead of (later) no answer.  A copy —
@@ -2541,6 +2710,248 @@ class ServeLoop:
                     self.pool.free_slot(slot)
                     slot_state[slot] = None
 
+        # -- live KV migration (preempt / rebalance / fast drain) ----------
+
+        def quiesce() -> None:
+            """Resolve EVERY in-flight segment: after this, each lane's
+            host token list is final and no stale merge can touch pages
+            an export is about to read — the precondition of
+            ``_build_migration``."""
+            while inflight:
+                drain_oldest()
+
+        def export_slot_payload(slot: int) -> dict:
+            """Freeze ``slot`` on device, serialize it as a migration
+            payload, and release the lane (no Completion — the caller
+            decides whether the request parks locally or leaves as a
+            ``reason="migrate"`` commit)."""
+            st = slot_state[slot]
+            self._active = self._active.at[slot].set(False)
+            payload = self._build_migration(slot, st)
+            slot_state[slot] = None
+            return payload
+
+        def park(slot: int) -> None:
+            """Export ``slot`` and park it LOCALLY: payload metadata in
+            the host dict, page bytes spilled per-block into the host
+            tier when one exists (budget-accounted; eviction of any
+            parked block downgrades the resume to a byte-identical
+            re-prefill)."""
+            st = slot_state[slot]
+            req = st["req"]
+            n_gen = len(st["tokens"])
+            payload = export_slot_payload(slot)
+            entry: dict = {"req": req, "t_q": time.perf_counter()}
+            if self._tier is not None and payload["layers"]:
+                n_blk = int(np.asarray(
+                    payload["layers"][0]["k"]).shape[0])
+                keys: list[int] = []
+                parent = None
+                ok = True
+                for i in range(n_blk):
+                    h = _park_hash(req.rid, i)
+                    blk = [{"k": np.asarray(l["k"][i]),
+                            "v": np.asarray(l["v"][i])}
+                           for l in payload["layers"]]
+                    if not self._tier.put(h, blk, parent=parent,
+                                          version=self.weights_version):
+                        ok = False
+                        break
+                    keys.append(h)
+                    parent = h
+                if ok:
+                    entry["meta"] = {k: v for k, v in payload.items()
+                                     if k != "layers"}
+                    entry["keys"] = keys
+                else:
+                    # tier refused (budget): keep the payload whole in
+                    # host RAM rather than losing the pages outright
+                    for h in keys:
+                        self._tier.discard(h)
+                    entry["payload"] = payload
+            else:
+                entry["payload"] = payload
+            self._parked[req.rid] = entry
+            self._obs_preempted.inc()
+            obs.recorder.record("serve_preempt", slot=slot,
+                                tokens=n_gen, parked=len(self._parked))
+            tev("preempt", req, stage="replica", slot=slot,
+                tokens=n_gen, parked=len(self._parked))
+
+        def unpark(entry: dict) -> dict | None:
+            """Rebuild a parked payload; ``None`` when any tier block
+            was evicted or version-flushed — the resume falls back to a
+            re-prefill of the original request (byte-identical)."""
+            if "payload" in entry:
+                return entry["payload"]
+            blocks = []
+            for h in entry["keys"]:
+                blk = self._tier.take(h, version=self.weights_version)
+                if blk is None:
+                    drop_parked(entry)
+                    return None
+                blocks.append(blk)
+            n_lay = len(blocks[0]) if blocks else 0
+            layers = [{"k": np.stack([b[li]["k"] for b in blocks]),
+                       "v": np.stack([b[li]["v"] for b in blocks])}
+                      for li in range(n_lay)]
+            return {**entry["meta"], "layers": layers}
+
+        def drop_parked(entry: dict) -> None:
+            for h in entry.get("keys", ()):
+                self._tier.discard(h)
+            entry.pop("keys", None)
+            entry.pop("payload", None)
+
+        def migrate_out(req: Request, payload: dict | None,
+                        stage: str) -> None:
+            """Hand one request back to the router as a
+            ``reason="migrate"`` completion — with its exported KV
+            (in-flight) or ref-less (queued/prefill-phase: the
+            redispatch re-prefills, byte-identical)."""
+            self._obs_migrated_out.inc()
+            tev("migrate_export", req, stage=stage,
+                tokens=(len(payload.get("generated", ()))
+                        + 1 if payload else 0),
+                refless=payload is None)
+            emit(Completion(
+                rid=req.rid, prompt=np.asarray(req.prompt),
+                tokens=np.zeros((0,), np.int32),
+                reason="migrate", handoff=payload))
+
+        def do_migrates() -> bool:
+            """Router-initiated migration: evacuate everything (fast
+            drain / fast swap) or the named requests (hot/cold
+            rebalance).  Unknown rids mean the request finished first —
+            its normal terminal wins and the intent is dropped."""
+            nonlocal pending
+            if not (self._evacuate or self._migrate_rids):
+                return False
+            if sink is None and source is None:
+                # batch mode has no router to resume a migrated
+                # request — the intents are meaningless here
+                self._migrate_rids.clear()
+                self._evacuate = False
+                return False
+            evac = self._evacuate
+            wanted = set(self._migrate_rids)
+            moved = False
+            if pending:
+                kept: deque[tuple[Request, float]] = deque()
+                for req, t_q in pending:
+                    if evac or req.rid in wanted:
+                        migrate_out(req, None, "queue")
+                        moved = True
+                    else:
+                        kept.append((req, t_q))
+                pending = kept
+            for rid in list(self._parked):
+                if evac or rid in wanted:
+                    entry = self._parked.pop(rid)
+                    payload = unpark(entry)
+                    migrate_out(entry["req"], payload, "parked")
+                    moved = True
+            if self.pool is not None and any(
+                    st is not None and not st.get("zombie")
+                    and (evac or st["req"].rid in wanted)
+                    for st in slot_state):
+                quiesce()
+                for slot in range(self.B):
+                    st = slot_state[slot]
+                    if (st is None or st.get("zombie")
+                            or not (evac or st["req"].rid in wanted)):
+                        continue
+                    req = st["req"]
+                    if "prefill" in st:
+                        # mid-chunked-prefill: the pages are not a
+                        # finished prefix yet — requeue ref-less, the
+                        # target re-prefills to identical bytes
+                        self.pool.free_slot(slot)
+                        slot_state[slot] = None
+                        migrate_out(req, None, "prefill")
+                    else:
+                        migrate_out(req, export_slot_payload(slot),
+                                    "decode")
+                    moved = True
+            self._migrate_rids.clear()
+            self._evacuate = False
+            return moved
+
+        def maybe_preempt() -> bool:
+            """Priority preemption (``preempt='migrate'``): under
+            pressure — the degrade watermark breached, or the
+            best-priority waiting request blocked on a lane/pool a
+            strictly-lower-priority decode holds — quiesce and PARK the
+            lowest-priority in-flight slot instead of degrade-clamping
+            it.  Paused, never killed or truncated."""
+            if (self.preempt != "migrate" or self.pool is None
+                    or self.role == "prefill" or not pending):
+                return False
+
+            def victims() -> list[tuple[int, int, int]]:
+                top = max(r.priority for r, _ in pending)
+                return sorted(
+                    (st["req"].priority, -len(st["tokens"]), slot)
+                    for slot, st in enumerate(slot_state)
+                    if st is not None and not st.get("zombie")
+                    and "prefill" not in st
+                    and st["req"].priority < top)
+            if not victims():
+                return False
+            if not self._degraded:
+                top_req = max(
+                    (r for r, _ in pending), key=lambda r: r.priority)
+                blocked = not any(s is None for s in slot_state)
+                if not blocked:
+                    blocked = not self.pool.can_admit(
+                        int(np.asarray(top_req.prompt).size),
+                        int(top_req.max_new_tokens))
+                if not blocked:
+                    return False
+            quiesce()   # drains may finalize lanes: re-pick after
+            vs = victims()
+            if not vs:
+                return False
+            park(vs[0][2])
+            return True
+
+        def maybe_resume() -> bool:
+            """Resume the oldest parked request once pressure clears
+            (or unconditionally once intake is closed): its payload
+            re-enters through the adopt path at the FRONT of the queue,
+            original deadline/trace/priority intact."""
+            if not self._parked or self._pending_swap is not None:
+                return False
+            if not closed and self._degraded:
+                return False
+            rid = next(iter(self._parked))
+            entry = self._parked[rid]
+            req = entry["req"]
+            if (req.deadline_s is not None
+                    and self._clock() > req.deadline_s):
+                drop_parked(entry)
+                del self._parked[rid]
+                complete_unadmitted(req, "timeout")
+                return True
+            if not any(s is None for s in slot_state):
+                return False
+            if not self.pool.can_admit(
+                    int(np.asarray(req.prompt).size),
+                    int(req.max_new_tokens)):
+                return False
+            payload = unpark(entry)
+            del self._parked[rid]
+            resumed = (dataclasses.replace(req, kv_handoff=payload)
+                       if payload is not None else req)
+            pending.appendleft((resumed, entry["t_q"]))
+            self._obs_resumed.inc()
+            obs.recorder.record("serve_resume",
+                                fallback=payload is None,
+                                parked=len(self._parked))
+            tev("resume", req, stage="replica",
+                fallback=payload is None, parked=len(self._parked))
+            return True
+
         # an unhandled exception mid-serve dumps the flight-recorder
         # bundle (admission ring, final snapshot) before propagating
         with obs.recorder.guard("serve_loop", num_slots=self.B,
@@ -2559,6 +2970,16 @@ class ServeLoop:
                         admit_free()
                         shed()
                 expire_inflight()
+                if (self.preempt == "migrate"
+                        and self._pending_swap is not None
+                        and source is not None):
+                    # fast swap: evacuate in-flight work to peers so
+                    # the swap barrier drains in ~one handoff RTT
+                    # instead of the longest remaining decode
+                    self._evacuate = True
+                if do_migrates() | maybe_preempt() | maybe_resume():
+                    admit_free()
+                    shed()
                 advance_admissions()
                 if can_work():
                     dispatch()
@@ -2570,7 +2991,7 @@ class ServeLoop:
                     drain_oldest()
                     admit_free()
                 maybe_swap()
-                if not (pending or inflight or any(
+                if not (pending or inflight or self._parked or any(
                         st is not None for st in slot_state)):
                     if closed:
                         break

@@ -228,6 +228,12 @@ def default_rules() -> tuple[AlertRule, ...]:
            fn="delta", window_s=60.0, op=">", threshold=3.0, severity="warn",
            help="disagg prefill->decode handoffs falling back to "
                 "re-prefill faster than 3/min"),
+        mk(name="MigrationFallbackSpike",
+           metric="router/migration_fallbacks",
+           fn="delta", window_s=60.0, op=">", threshold=3.0, severity="warn",
+           help="live KV migrations losing their payload (ref-less "
+                "commit or adopt-side fetch miss -> re-prefill) faster "
+                "than 3/min"),
         mk(name="FleetDegraded", metric="serve/degraded", fn="last",
            window_s=5.0, op=">", threshold=0.0, severity="warn",
            help="a replica is advertising degraded service"),

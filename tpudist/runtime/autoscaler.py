@@ -280,6 +280,16 @@ class Autoscaler:
                                       unit="replicas")
         self._obs_drained = obs.counter(f"autoscale/drain_completed{tag}",
                                         unit="replicas")
+        self._obs_drain_migrations = obs.counter(
+            f"autoscale/drain_migrations{tag}", unit="reqs",
+            help="requests a draining replica migrated out instead of "
+                 "finishing in place (fast drain)")
+        # fast-drain bookkeeping: a draining victim's serve/migrated_out
+        # counter at drain start (base) and at the last tick (last) —
+        # growth past base plus an empty queue means the work LEFT, so
+        # the stop key must not keep waiting on completions
+        self._drain_mig_base: dict[str, float] = {}
+        self._drain_mig_last: dict[str, float] = {}
         self._obs_polls = obs.counter(f"autoscale/polls{tag}",
                                       unit="polls")
         self._obs_suppressed = obs.counter(
@@ -444,24 +454,61 @@ class Autoscaler:
 
     # -- the drain state machine (one tick per poll) -----------------------
 
-    def _tick_drains(self, live: set[str], draining: set[str]) -> None:
+    def _tick_drains(self, live: set[str], draining: set[str],
+                     snaps: dict[int, dict] | None = None) -> None:
         """Advance in-progress graceful drains: a draining replica with
         an empty inbox gets its targeted stop key (its close path
         finishes all accepted work first — zero loss); one whose lease
-        is gone gets its coordination residue swept."""
+        is gone gets its coordination residue swept.
+
+        A drain finishes two ways: the work COMPLETES in place, or —
+        fast drain, ``preempt="migrate"`` replicas — the work MIGRATES
+        out as ``reason="migrate"`` commits the router redispatches.
+        The drain decision therefore observes BOTH signals: inbox
+        empty, or the victim advertising migrated-out-empty (its
+        ``serve/migrated_out`` counter grew since the drain began and
+        its queue-depth gauge is back to zero).  Without the second
+        condition a fast drain deadlocks: the inbox can hold a key
+        that raced the drain flag in while every completion the state
+        machine is waiting for already left the replica.  Stopping on
+        the migrated-out signal is still zero-loss — unconsumed inbox
+        keys are swept and redispatched by the router's drain-departure
+        path."""
         regs = self._registrations()
+        rank_to_rid = {int(info.get("rank", -1)): rid
+                       for rid, info in regs.items()}
+        mig: dict[str, tuple[float, float]] = {}
+        for rank, snap in (snaps or {}).items():
+            rid = rank_to_rid.get(rank)
+            if rid is None:
+                continue
+            mig[rid] = (
+                (snap.get("counters", {}).get("serve/migrated_out")
+                 or {}).get("value") or 0.0,
+                (snap.get("gauges", {}).get("serve/queue_depth")
+                 or {}).get("value") or 0.0)
         # union with the loop's own memory: the router's drain-
         # departure path may sweep the coord key first (it polls on the
         # request path and usually wins the race) — completion must be
         # counted either way
         for rid in sorted(draining | self._drains):
             if rid in live:
+                migrated_clear = False
+                if rid in mig:
+                    out, depth = mig[rid]
+                    base = self._drain_mig_base.setdefault(rid, out)
+                    last = self._drain_mig_last.get(rid, base)
+                    if out > last:
+                        self._obs_drain_migrations.inc(out - last)
+                        self._drain_mig_last[rid] = out
+                    migrated_clear = out > base and depth <= 0.0
                 if (self.client.get(f"{self.ns}/stop/{rid}") is None
-                        and not self.client.keys(
-                            f"{self.ns}/inbox/{rid}/")):
+                        and (migrated_clear or not self.client.keys(
+                            f"{self.ns}/inbox/{rid}/"))):
                     self.client.set(f"{self.ns}/stop/{rid}", b"1")
-                    log.info("autoscale: replica %s inbox empty; "
-                             "stopping it", rid)
+                    log.info("autoscale: replica %s %s; stopping it",
+                             rid, "migrated its work out"
+                             if migrated_clear else "inbox empty")
                 continue
             for key in (f"{self.ns}/draining/{rid}",
                         f"{self.ns}/stop/{rid}",
@@ -473,6 +520,8 @@ class Autoscaler:
                 except OSError:
                     pass
             self._drains.discard(rid)
+            self._drain_mig_base.pop(rid, None)
+            self._drain_mig_last.pop(rid, None)
             self._obs_drained.inc()
             log.info("autoscale: replica %s drain complete", rid)
 
@@ -528,7 +577,7 @@ class Autoscaler:
                         "suppressing this poll", err)
             return record
         live, draining = view["live"], view["draining"]
-        self._tick_drains(live, draining)
+        self._tick_drains(live, draining, view["snaps"])
         # quarantined capacity is MISSING capacity: the router will not
         # dispatch to it, so counting it would starve the backfill —
         # and it must never be picked as a scale-down victim (it is

@@ -30,6 +30,23 @@ This module is the transport between them.  One payload per handoff::
                                          # per paged layer, cache-walk
                                          #   order, [used_blocks, bs, F]
 
+Two OPTIONAL riders extend the same schema to mid-decode migration
+(priority preemption, hot/cold rebalancing, fast drain — PR 19):
+
+    {"generated":  [token ids],          # tokens already emitted by the
+                                         #   exporter, EXCLUDING "first";
+                                         #   the adopter seeds its output
+                                         #   with them, the chain covers
+                                         #   prompt+generated, and
+                                         #   true_len = len(prompt) +
+                                         #   len(generated)
+     "version":    int}                  # exporter's weights_version;
+                                         #   an adopter on different
+                                         #   weights refuses the pages
+                                         #   and re-prefills (a roll in
+                                         #   flight must not mix KV
+                                         #   across versions)
+
 Two transports implement one interface:
 
 * :class:`CoordKVTransport` — the baseline path: the payload crosses the
@@ -97,6 +114,8 @@ def encode_payload(payload: dict) -> dict:
     doc = {k: v for k, v in payload.items() if k != "layers"}
     doc["prompt"] = [int(t) for t in payload["prompt"]]
     doc["chain"] = [int(h) for h in payload["chain"]]
+    if "generated" in payload:
+        doc["generated"] = [int(t) for t in payload["generated"]]
     doc["layers"] = [{"k": _pack_array(np.asarray(l["k"])),
                       "v": _pack_array(np.asarray(l["v"]))}
                      for l in payload["layers"]]
@@ -110,6 +129,8 @@ def decode_payload(doc: dict) -> dict:
     out = {k: v for k, v in doc.items() if k != "layers"}
     out["prompt"] = [int(t) for t in doc["prompt"]]
     out["chain"] = [int(h) for h in doc["chain"]]
+    if "generated" in doc:
+        out["generated"] = [int(t) for t in doc["generated"]]
     out["layers"] = [{"k": _unpack_array(l["k"]),
                       "v": _unpack_array(l["v"])}
                      for l in doc["layers"]]
@@ -141,10 +162,14 @@ class KVTransport:
         self._obs_bytes = obs.counter("serve/handoff_bytes", unit="bytes")
         self._obs_wait = obs.histogram("serve/handoff_wait_s", unit="s")
 
-    def publish(self, key: str, payload: dict) -> tuple[str, int]:
+    def publish(self, key: str, payload: dict, *,
+                kind: str = "handoff") -> tuple[str, int]:
         """Ship one payload; returns ``(ref, nbytes)``.  ``ref`` is the
         opaque token the decode side fetches by (it rides the router's
-        dispatch doc and journal record)."""
+        dispatch doc and journal record).  ``kind`` selects which fault
+        knob can swallow the publish: ``"handoff"`` (prefill→decode
+        seam, ``HANDOFF_DROP``) or ``"migrate"`` (mid-decode
+        preemption/rebalance/drain, ``MIGRATE_DROP``)."""
         raise NotImplementedError
 
     def fetch(self, ref: str) -> dict | None:
@@ -180,15 +205,18 @@ class CoordKVTransport(KVTransport):
         self.client = client
         self.ns = namespace
 
-    def publish(self, key: str, payload: dict) -> tuple[str, int]:
+    def publish(self, key: str, payload: dict, *,
+                kind: str = "handoff") -> tuple[str, int]:
         ref = f"{self.ns}/kv/{key}"
         raw = wire.encode_record("kv_migration", encode_payload(payload))
-        if faults.drop_handoff():
+        dropped = (faults.drop_migrate() if kind == "migrate"
+                   else faults.drop_handoff())
+        if dropped:
             # injected in-flight loss: the exporter believes the publish
             # landed (ref returned, done committed) but the payload
-            # never reaches the store — the decode side MUST fall back
-            log.warning("disagg: HANDOFF_DROP injected; payload %s "
-                        "lost in flight", key)
+            # never reaches the store — the adopting side MUST fall back
+            log.warning("disagg: %s_DROP injected; payload %s "
+                        "lost in flight", kind.upper(), key)
         else:
             self.client.set(ref, raw)
         self._published(len(raw))
@@ -235,12 +263,15 @@ class IciKVTransport(KVTransport):
         self.device = device
         self._store: dict[str, dict] = {}
 
-    def publish(self, key: str, payload: dict) -> tuple[str, int]:
+    def publish(self, key: str, payload: dict, *,
+                kind: str = "handoff") -> tuple[str, int]:
         ref = f"ici://{key}"
         n = payload_nbytes(payload)
-        if faults.drop_handoff():
-            log.warning("disagg: HANDOFF_DROP injected; payload %s "
-                        "lost in flight", key)
+        dropped = (faults.drop_migrate() if kind == "migrate"
+                   else faults.drop_handoff())
+        if dropped:
+            log.warning("disagg: %s_DROP injected; payload %s "
+                        "lost in flight", kind.upper(), key)
         else:
             if self.device is not None:
                 import jax

@@ -119,6 +119,20 @@ Environment knobs (all optional):
                                     request exactly-once (re-prefill on a
                                     surviving replica, byte-identical
                                     output)
+``TPUDIST_FAULT_MIGRATE_DROP``      swallow the first N preemption/rebalance
+                                    MIGRATE payload publishes (the
+                                    mid-decode analogue of
+                                    ``HANDOFF_DROP``): the exporting
+                                    replica believes the migration landed
+                                    but the pages never reach the store —
+                                    the adopting side must fall back to a
+                                    byte-identical re-prefill
+``TPUDIST_FAULT_KILL_AT_MIGRATE``   SIGKILL self immediately after
+                                    publishing the Nth MIGRATE payload,
+                                    BEFORE committing the migrate done
+                                    record — the router's death sweep must
+                                    redispatch the in-flight request
+                                    exactly-once with byte-identical output
 ``TPUDIST_FAULT_COLL_KILL_PHASE``   SIGKILL self when the hierarchical
                                     allreduce reaches this phase boundary
                                     (``hier_intra`` / ``hier_cross`` /
@@ -147,7 +161,8 @@ __all__ = ["FaultInjected", "RouterKilled", "FaultPlan", "plan",
            "drop_publish", "on_segment", "on_warmup", "corrupt_canary",
            "autoscale_poll", "on_router_poll", "flip_wire_bits",
            "poison_logits", "corrupt_probe", "drop_handoff",
-           "on_handoff_published", "on_coll_phase"]
+           "on_handoff_published", "drop_migrate",
+           "on_migrate_published", "on_coll_phase"]
 
 ENV_PREFIX = "TPUDIST_FAULT_"
 
@@ -199,6 +214,8 @@ class FaultPlan:
         probe_fail: int | None = None,
         handoff_drop: int | None = None,
         kill_at_handoff: int | None = None,
+        migrate_drop: int | None = None,
+        kill_at_migrate: int | None = None,
         coll_kill_phase: str | None = None,
         coll_kill_rank: int | None = None,
         coll_kill_raise: bool = False,
@@ -274,6 +291,16 @@ class FaultPlan:
                 f"kill_at_handoff must be >= 1, got {kill_at_handoff}")
         self.kill_at_handoff = (None if kill_at_handoff is None
                                 else int(kill_at_handoff))
+        if migrate_drop is not None and int(migrate_drop) < 1:
+            raise ValueError(
+                f"migrate_drop must be >= 1, got {migrate_drop}")
+        self.migrate_drop = (None if migrate_drop is None
+                             else int(migrate_drop))
+        if kill_at_migrate is not None and int(kill_at_migrate) < 1:
+            raise ValueError(
+                f"kill_at_migrate must be >= 1, got {kill_at_migrate}")
+        self.kill_at_migrate = (None if kill_at_migrate is None
+                                else int(kill_at_migrate))
         _COLL_PHASES = ("hier_intra", "hier_cross", "hier_ag")
         if coll_kill_phase is not None and coll_kill_phase not in \
                 _COLL_PHASES:
@@ -291,6 +318,7 @@ class FaultPlan:
         self._router_polls = 0
         self._wire_payloads = 0
         self._handoffs_published = 0
+        self._migrates_published = 0
         self._born = time.monotonic()
         # per-kind injection tallies, inspectable by tests
         self.injected = {"coord_error": 0, "coord_delay": 0,
@@ -300,6 +328,7 @@ class FaultPlan:
                          "router_kill": 0, "wire_flip": 0,
                          "nan_logits": 0, "probe_corrupt": 0,
                          "handoff_drop": 0, "handoff_kill": 0,
+                         "migrate_drop": 0, "migrate_kill": 0,
                          "coll_kill": 0}
         self.active = bool(coord_error_p or coord_delay_p
                            or heartbeat_stop_after_s is not None
@@ -315,6 +344,8 @@ class FaultPlan:
                            or self.probe_fail is not None
                            or self.handoff_drop is not None
                            or self.kill_at_handoff is not None
+                           or self.migrate_drop is not None
+                           or self.kill_at_migrate is not None
                            or self.coll_kill_phase is not None)
 
     @classmethod
@@ -352,6 +383,12 @@ class FaultPlan:
             kill_at_handoff=(
                 None if _env_float(env, "KILL_AT_HANDOFF") is None
                 else int(_env_float(env, "KILL_AT_HANDOFF"))),
+            migrate_drop=(
+                None if _env_float(env, "MIGRATE_DROP") is None
+                else int(_env_float(env, "MIGRATE_DROP"))),
+            kill_at_migrate=(
+                None if _env_float(env, "KILL_AT_MIGRATE") is None
+                else int(_env_float(env, "KILL_AT_MIGRATE"))),
             coll_kill_phase=(env.get(ENV_PREFIX + "COLL_KILL_PHASE")
                              or None),
             coll_kill_rank=(
@@ -538,6 +575,38 @@ class FaultPlan:
         if n >= self.kill_at_handoff:
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def drop_migrate(self) -> bool:
+        """True when this preemption/rebalance MIGRATE payload should be
+        lost in flight: the first ``migrate_drop`` publishes are
+        swallowed — the exporting replica's publish "succeeds" but the
+        pages never land, so the adopting side's fetch misses and must
+        re-prefill the original prompt (byte-identical output is the
+        contract being tested)."""
+        if self.migrate_drop is None:
+            return False
+        with self._lock:
+            if self.injected["migrate_drop"] >= self.migrate_drop:
+                return False
+            self.injected["migrate_drop"] += 1
+        return True
+
+    def on_migrate_published(self) -> None:
+        """Count one published MIGRATE payload; SIGKILL self at the
+        configured count — after the pages are in the store but BEFORE
+        the migrate done record commits.  The harshest migration-window
+        death: the router's death sweep must redispatch the request
+        (the orphaned payload is garbage-collected) and the retry must
+        produce byte-identical output."""
+        if self.kill_at_migrate is None:
+            return
+        with self._lock:
+            self._migrates_published += 1
+            n = self._migrates_published
+            if n >= self.kill_at_migrate:
+                self.injected["migrate_kill"] += 1
+        if n >= self.kill_at_migrate:
+            os.kill(os.getpid(), signal.SIGKILL)
+
     def on_coll_phase(self, phase: str, rank: int | None = None) -> None:
         """Kill this participant when the hierarchical allreduce crosses
         the configured phase boundary (``hier_intra`` → before the
@@ -676,6 +745,17 @@ def on_handoff_published() -> None:
     p = plan()
     if p.active:
         p.on_handoff_published()
+
+
+def drop_migrate() -> bool:
+    p = plan()
+    return p.active and p.drop_migrate()
+
+
+def on_migrate_published() -> None:
+    p = plan()
+    if p.active:
+        p.on_migrate_published()
 
 
 def on_coll_phase(phase: str, rank: int | None = None) -> None:

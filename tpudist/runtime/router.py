@@ -278,19 +278,25 @@ def _decode_request(raw: bytes, *, namespace: str = "", key: str = "",
 
 
 def _encode_completion(replica_id: str, comp,
-                       handoff_ref: str | None = None) -> bytes:
+                       handoff_ref: str | None = None,
+                       adopt_fallback: bool = False) -> bytes:
     doc = {
         "key": comp.rid,
         "tokens": np.asarray(comp.tokens).astype(int).tolist(),
         "reason": comp.reason,
         "replica": replica_id,
     }
-    # reason="handoff" commits carry the migration payload's transport
-    # ref, NOT the payload (that crossed separately, before this
-    # commit): the router journals the ref and re-sends it on the
+    # reason="handoff"/"migrate" commits carry the migration payload's
+    # transport ref, NOT the payload (that crossed separately, before
+    # this commit): the router journals the ref and re-sends it on the
     # decode-stage dispatch
     if handoff_ref is not None:
         doc["handoff_ref"] = str(handoff_ref)
+    if adopt_fallback:
+        # this request was dispatched with a payload ref whose fetch
+        # missed — the replica re-prefilled (exact); the router counts
+        # it against router/migration_fallbacks when the entry migrated
+        doc["adopt_fallback"] = True
     return wire.encode_record("completion", doc)
 
 
@@ -365,6 +371,9 @@ class ReplicaWorker:
         # the outage, and greedy determinism re-produces it).
         self._done_buf: list[tuple[str, bytes]] = []
         self._done_buf_cap = 4096
+        # rids whose handoff/migration payload fetch missed (the loop
+        # re-prefilled): their terminal commits carry adopt_fallback
+        self._fallback_rids: set[str] = set()
         # last published prefix-affinity summary; republished on change
         # OR half-TTL age (the summary carries a wall-clock stamp the
         # router's staleness bound reads, so an unchanged-but-alive
@@ -571,6 +580,29 @@ class ReplicaWorker:
             self._flush_done_buffer()
             self._publish_prefix()
             self._serve_pulls()
+            if getattr(self.loop, "preempt", "degrade") == "migrate":
+                # live-migration control plane, checked BEFORE the stop
+                # key: a drain sets draining and stop back-to-back once
+                # the inbox is empty, and the evacuation armed on this
+                # very poll must win that race — the loop keeps flushing
+                # migrations after the source closes, so arming here is
+                # enough.  Rebalance intents name the requests to
+                # evacuate; an intent for a request that already
+                # finished is a no-op loop-side (its terminal wins).  A
+                # draining flag evacuates EVERYTHING — re-armed every
+                # poll so work that arrives after the flag (a racing
+                # final dispatch) bounces out too, collapsing drain
+                # time to ~one handoff RTT.
+                mig_prefix = f"{self.ns}/migrate_req/{self.replica_id}/"
+                rids = []
+                for key in sorted(self.client.keys(mig_prefix)):
+                    self.client.delete(key)
+                    rids.append(key[len(mig_prefix):])
+                if rids:
+                    self.loop.request_migrate(rids)
+                if self.client.get(f"{self.ns}/draining/"
+                                   f"{self.replica_id}") is not None:
+                    self.loop.request_evacuate()
             if (self.client.get(f"{self.ns}/stop") is not None
                     or self.client.get(
                         f"{self.ns}/stop/{self.replica_id}") is not None):
@@ -712,6 +744,10 @@ class ReplicaWorker:
         payload = self.kv_transport.fetch(stub["handoff_ref"])
         if payload is None:
             obs.counter("serve/handoff_fallbacks", unit="reqs").inc()
+            # remembered until this request's commit: the terminal
+            # carries adopt_fallback=True so the router can attribute
+            # a migrated request's lost-payload re-prefill
+            self._fallback_rids.add(str(req.rid))
             log.warning("replica %s: KV payload %s missing; request %s "
                         "falls back to re-prefill", self.replica_id,
                         stub["handoff_ref"], req.rid)
@@ -795,8 +831,31 @@ class ReplicaWorker:
                             "decode side will re-prefill",
                             self.replica_id, comp.rid)
             faults.on_handoff_published()
-        payload = _encode_completion(self.replica_id, comp,
-                                     handoff_ref=handoff_ref)
+        elif comp.reason == "migrate":
+            # live migration, publish-then-commit like the handoff seam:
+            # the exported KV crosses the transport first (kind="migrate"
+            # routes it through the MIGRATE_DROP knob), then the commit
+            # carries the ref.  A SIGKILL in the window — KILL_AT_MIGRATE
+            # — leaves no done key; the router's death sweep redispatches
+            # the request whole, and greedy determinism makes the re-run
+            # byte-identical.  Queued/mid-prefill evacuations arrive
+            # payload-less and commit ref-less: the redispatch
+            # re-prefills.
+            if comp.handoff is not None:
+                doc = dict(comp.handoff)
+                doc["key"] = str(comp.rid)
+                try:
+                    handoff_ref, _ = self.kv_transport.publish(
+                        str(comp.rid), doc, kind="migrate")
+                except ConnectionError:
+                    log.warning("replica %s: KV publish for migrating "
+                                "%s failed; target will re-prefill",
+                                self.replica_id, comp.rid)
+            faults.on_migrate_published()
+        payload = _encode_completion(
+            self.replica_id, comp, handoff_ref=handoff_ref,
+            adopt_fallback=str(comp.rid) in self._fallback_rids)
+        self._fallback_rids.discard(str(comp.rid))
         # injected wire corruption: flip a bit in the ENCODED frame, so
         # the router-side checksum — not any replica-side check — is
         # the thing that has to catch it
@@ -924,6 +983,9 @@ class Router:
                  outage_grace_s: float = 5.0,
                  pull_min_blocks: int = 2,
                  pull_timeout_s: float = 5.0,
+                 rebalance_after_polls: int = 0,
+                 rebalance_min_gap: int = 2,
+                 rebalance_timeout_s: float = 5.0,
                  prefix_ttl_s: float | None = None,
                  quarantine: bool = True,
                  golden_probe: GoldenProbe | None = None,
@@ -980,6 +1042,18 @@ class Router:
         # lose one.
         self.pull_min_blocks = int(pull_min_blocks)
         self.pull_timeout_s = float(pull_timeout_s)
+        # hot/cold rebalancing: after `rebalance_after_polls` consecutive
+        # polls showing the SAME replica at least `rebalance_min_gap`
+        # outstanding requests above the coolest candidate (or with a
+        # published queue wait >= 2x the coolest's), the router asks the
+        # hot replica to migrate its oldest in-flight request out via a
+        # {ns}/migrate_req control key.  0 (the default) disables it —
+        # the least-loaded score then remains admission-time-only.
+        self.rebalance_after_polls = int(rebalance_after_polls)
+        self.rebalance_min_gap = int(rebalance_min_gap)
+        self.rebalance_timeout_s = float(rebalance_timeout_s)
+        self._skew_streak: tuple[str, int] | None = None  # (hot rid, n)
+        self._migrating: dict[str, float] = {}   # entry key -> cooldown
         self.prefix_dir = PrefixDirectory(client, namespace=namespace,
                                           ttl_s=prefix_ttl_s, wall=wall)
         self._journal_docs: dict[str, dict] = {}
@@ -1041,6 +1115,17 @@ class Router:
         # (prefill done -> decode dispatch) and the per-stage depth of
         # the outstanding set — the two pools' load signals
         self._obs_handoffs = obs.counter("router/handoffs", unit="reqs")
+        # live KV migration: migrate commits consumed (preemption
+        # overflow, rebalance, fast drain), migrations that lost their
+        # payload (ref-less commit or adopt-side fetch miss — the
+        # request re-prefilled, slower but byte-identical), and
+        # rebalance intents issued
+        self._obs_migrations = obs.counter("router/migrations",
+                                           unit="reqs")
+        self._obs_migration_fallbacks = obs.counter(
+            "router/migration_fallbacks", unit="reqs")
+        self._obs_rebalances = obs.counter("router/rebalances",
+                                           unit="reqs")
         self._obs_stage_depth = {
             stage: obs.gauge(f"router/stage_depth~stage={stage}",
                              unit="reqs")
@@ -1244,6 +1329,49 @@ class Router:
             self._obs_prefix_affinity.inc()
         return best
 
+    # -- hot/cold rebalancing ----------------------------------------------
+
+    @staticmethod
+    def rebalance_hot_cold(loads: dict[str, dict],
+                           candidates: Sequence[str],
+                           assigned: dict[str, int], *,
+                           min_gap: int = 2) -> tuple[str, str] | None:
+        """``(hot, cold)`` when one candidate carries at least
+        ``min_gap`` more outstanding work (router assignments + its
+        published queue depth) than the coolest — or advertises a
+        queue-wait percentile at least 2x the coolest's non-zero one.
+        Pure: the skew signal is unit-testable on synthetic loads."""
+        if len(candidates) < 2:
+            return None
+
+        def depth(rid: str) -> float:
+            return assigned.get(rid, 0) + (
+                loads.get(rid, {}).get("queue_depth") or 0.0)
+
+        hot = max(candidates, key=depth)
+        cold = min(candidates, key=depth)
+        if hot == cold:
+            return None
+        hot_wait = loads.get(hot, {}).get("queue_wait_q") or 0.0
+        cold_wait = loads.get(cold, {}).get("queue_wait_q") or 0.0
+        if (depth(hot) - depth(cold) >= min_gap
+                or (cold_wait > 0.0 and hot_wait >= 2.0 * cold_wait)):
+            return hot, cold
+        return None
+
+    @staticmethod
+    def rebalance_victim(entries: dict[str, dict], done: dict,
+                         hot: str, migrating=()) -> str | None:
+        """The OLDEST outstanding request assigned to the hot replica
+        (smallest dispatch key — the longest-running decode, whose
+        remaining work is most worth moving) not already
+        mid-migration."""
+        keys = sorted(k for k, e in entries.items()
+                      if k not in done and k not in migrating
+                      and e.get("assigned") == hot
+                      and e.get("stage", "prefill") != "pull")
+        return keys[0] if keys else None
+
     def _sweep_dead(self, rid: str, regs: dict[str, dict]) -> None:
         """Remove a dead replica's coordination residue so restarted
         ids and fresh health rounds start clean."""
@@ -1251,7 +1379,12 @@ class Router:
                     # pending pull requests addressed to the dead
                     # owner: nobody will answer them (the waiting
                     # entries revert to prefill on their pull timeout)
-                    + list(self.client.keys(f"{self.ns}/pullreq/{rid}/"))):
+                    + list(self.client.keys(f"{self.ns}/pullreq/{rid}/"))
+                    # unconsumed migrate intents: the outstanding work
+                    # is redispatched below anyway, and a replica
+                    # reusing the id must not inherit stale evictions
+                    + list(self.client.keys(
+                        f"{self.ns}/migrate_req/{rid}/"))):
             try:
                 self.client.delete(key)
             except ConnectionError:
@@ -1334,17 +1467,20 @@ class Router:
         doc["attempts"] = int(e["attempts"])
         self._journal_write(k)
 
-    def _journal_handoff(self, k: str, e: dict) -> None:
-        """The stage transition's journal record: stage=decode plus the
+    def _journal_handoff(self, k: str, e: dict, *,
+                         stage: str = "decode") -> None:
+        """The stage transition's journal record: the new stage plus the
         payload ref, written BEFORE the prefill done key is destroyed —
         a router crash in between recovers into a decode-stage entry
         and redispatches it exactly once (to the decode pool, payload
         ref intact; a lost payload degrades to re-prefill, never to a
-        lost or doubled request)."""
+        lost or doubled request).  Migrate commits ride the same record
+        with ``stage="decode"`` (payload exported) or ``"prefill"``
+        (ref-less: the redispatch re-prefills)."""
         doc = self._journal_docs.get(k)
         if doc is None:
             return
-        doc["stage"] = "decode"
+        doc["stage"] = stage
         doc["handoff_ref"] = e.get("handoff_ref")
         doc["assigned"] = None
         doc["attempts"] = int(e["attempts"])
@@ -1823,11 +1959,43 @@ class Router:
                     obs.events.record("handoff", trace=trace.trace_id,
                                       from_replica=replica,
                                       ref=e["handoff_ref"])
+            elif comp.reason == "migrate":
+                # live migration: the replica evacuated this request
+                # (preemption overflow, rebalance intent, fast drain).
+                # NOT a terminal — with a payload ref the entry becomes
+                # a decode-stage redispatch (the target adopts the
+                # mid-decode pages and continues); ref-less (queued or
+                # mid-prefill at export time, or the publish browned
+                # out) it reverts to an ordinary prefill, byte-identical
+                # under greedy determinism.  Same journal-then-delete
+                # ordering as the handoff stage, so recover() resumes a
+                # mid-migration request exactly once.
+                ref = payload.get("handoff_ref")
+                e["stage"] = "decode" if ref else "prefill"
+                e["handoff_ref"] = ref
+                e["assigned"] = None
+                e["migrated"] = True
+                self._journal_handoff(k, e, stage=e["stage"])
+                self.client.delete(key)
+                self._obs_migrations.inc()
+                if not ref:
+                    self._obs_migration_fallbacks.inc()
+                self._migrating.pop(k, None)
+                trace = e.get("trace")
+                if trace is not None:
+                    obs.events.record("migrate", trace=trace.trace_id,
+                                      from_replica=replica, ref=ref)
             else:
                 # commit-point ordering: journal the terminal (WITH the
                 # tokens) before destroying the done key, so a crash in
                 # between leaves a replayable record instead of an
                 # outcome that was consumed and lost
+                if e.get("migrated") and payload.get("adopt_fallback"):
+                    # the migrated payload crossed but the adopting
+                    # replica's fetch missed (drop-injected or expired):
+                    # it re-prefilled — exact, but the migration's
+                    # latency win was lost.  Count it.
+                    self._obs_migration_fallbacks.inc()
                 self._journal_terminal(k, comp.reason, comp.tokens)
                 self.client.delete(key)
                 complete(k, comp)
@@ -2196,6 +2364,52 @@ class Router:
                     obs.events.record("dispatch", trace=trace.trace_id,
                                       replica=rid,
                                       attempt=e["attempts"])
+
+        # 4) hot/cold rebalancing (opt-in): after a sustained skew
+        # streak, write a {ns}/migrate_req control key naming the hot
+        # replica's oldest outstanding request — the replica exports it
+        # as a reason="migrate" commit (consumed in step 1), and the
+        # redispatch lands least-loaded.  A cooldown per request key
+        # keeps one intent in flight; an intent for a request that
+        # finishes first is ignored replica-side (terminal wins).
+        now_reb = self._clock()
+        self._migrating = {k2: t for k2, t in self._migrating.items()
+                           if t > now_reb and k2 in entries}
+        if self.rebalance_after_polls and len(candidates) >= 2:
+            counts: dict[str, int] = {}
+            for e2 in entries.values():
+                if e2["assigned"] is not None:
+                    counts[e2["assigned"]] = counts.get(
+                        e2["assigned"], 0) + 1
+            skew = self.rebalance_hot_cold(
+                loads, candidates, counts,
+                min_gap=self.rebalance_min_gap)
+            if skew is None:
+                self._skew_streak = None
+            else:
+                hot, cold = skew
+                prev = self._skew_streak
+                n = prev[1] + 1 if prev and prev[0] == hot else 1
+                self._skew_streak = (hot, n)
+                if n >= self.rebalance_after_polls:
+                    victim = self.rebalance_victim(
+                        entries, done, hot, self._migrating)
+                    if victim is not None:
+                        self.client.set(
+                            f"{self.ns}/migrate_req/{hot}/{victim}",
+                            b"1")
+                        self._migrating[victim] = (
+                            now_reb + self.rebalance_timeout_s)
+                        self._obs_rebalances.inc()
+                        self._skew_streak = None
+                        log.info("router: sustained skew on %s; "
+                                 "migrating %s toward %s", hot,
+                                 victim, cold)
+                        trace = entries[victim].get("trace")
+                        if trace is not None:
+                            obs.events.record(
+                                "rebalance", trace=trace.trace_id,
+                                from_replica=hot, to=cold)
         return progressed
 
 
@@ -2816,6 +3030,13 @@ def main() -> None:  # pragma: no cover - subprocess entry point
                          "decodes; 'both' (default) is a unified "
                          "replica (requires --cache-layout paged for "
                          "prefill/decode)")
+    ap.add_argument("--preempt", default="degrade",
+                    choices=["degrade", "migrate"],
+                    help="overload policy: 'degrade' clamps best-effort "
+                         "budgets; 'migrate' pauses the lowest-priority "
+                         "in-flight decode via KV-page export instead "
+                         "(requires --cache-layout paged) and enables "
+                         "fast drain + rebalance intents")
     ap.add_argument("--snapshot-dir", default="",
                     help="fleet weight snapshot dir (Checkpointer, "
                          "layout=steps): restored at startup (joiners "
@@ -2855,7 +3076,7 @@ def main() -> None:  # pragma: no cover - subprocess entry point
         degrade_queue=None if args.degrade_queue < 0
         else args.degrade_queue,
         degrade_max_new=args.degrade_max_new,
-        role=args.role)
+        role=args.role, preempt=args.preempt)
     host, port = args.coord.rsplit(":", 1)
     client = CoordClient(host, int(port))
     worker = ReplicaWorker(loop, client, args.replica_id,

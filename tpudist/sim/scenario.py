@@ -207,6 +207,11 @@ _FLEET_DEFAULTS: dict[str, Any] = {
     # and the host-tier capacity its LRU spills land in
     "prefix_cache_blocks": 0,
     "tier_blocks": 0,
+    # priority preemption (ISSUE 19): "migrate" turns on the replicas'
+    # pause/resume flow model — a higher-priority arrival preempts the
+    # running best-effort request instead of queueing behind it, the
+    # sim mirror of ``ServeLoop(preempt="migrate")``
+    "preempt": "degrade",
     # observability plane (ISSUE 17): cadence of the real
     # scrape->TSDB->alert-rules path every sim runs on the virtual
     # clock (the default rule set from tpudist.obs.alerts; the
@@ -259,6 +264,13 @@ class Envelope:
     max_p99_ttft_s: float | None = None
     min_scale_ups_prefill: int = 0
     min_scale_ups_decode: int = 0
+    # preemption gates (ISSUE 19): the queue-wait tail the PRIORITY
+    # class alone must hold (the number preemption exists to protect —
+    # the overall p99 is dominated by paused best-effort traffic and
+    # would hide the win), and the preemption-volume floor that proves
+    # the pause path actually ran rather than the fleet being oversized
+    max_p99_priority_wait_s: float | None = None
+    min_preemptions: int = 0
     decisions: dict = field(default_factory=dict)
     # alert-envelope (ISSUE 17): which alert RULES the run's real
     # scrape->TSDB->evaluate path must (and must not) have fired, read
@@ -359,6 +371,14 @@ class Envelope:
             if ttft > self.max_p99_ttft_s:
                 bad.append(f"p99_ttft_s={ttft:.4g} > "
                            f"{self.max_p99_ttft_s}")
+        if self.max_p99_priority_wait_s is not None:
+            pw = num("p99_priority_wait_s")
+            if pw > self.max_p99_priority_wait_s:
+                bad.append(f"p99_priority_wait_s={pw:.4g} > "
+                           f"{self.max_p99_priority_wait_s}")
+        if num("preemptions") < self.min_preemptions:
+            bad.append(f"preemptions={num('preemptions'):g} < min "
+                       f"{self.min_preemptions}")
         for pool in ("prefill", "decode"):
             floor = getattr(self, f"min_scale_ups_{pool}")
             v = num(f"scale_ups_{pool}")
@@ -457,6 +477,9 @@ class ScenarioSpec:
                      "fleet.replicas must be >= 1")
         _require(float(merged["seconds_per_token"]) > 0,
                  "fleet.seconds_per_token must be > 0")
+        _require(merged["preempt"] in ("degrade", "migrate"),
+                 f"fleet.preempt must be 'degrade' or 'migrate', "
+                 f"got {merged['preempt']!r}")
         # frozen dataclass: route the normalized fleet through __setattr__
         object.__setattr__(self, "fleet", merged)
         object.__setattr__(self, "tenants", tuple(self.tenants))
@@ -772,6 +795,36 @@ BUILTIN: dict[str, dict] = {
             "max_p99_ttft_s": 6.0,
             "min_scale_ups_prefill": 1,
             "min_scale_ups_decode": 1,
+            "decisions": {"failed": {"max": 0}},
+            "alerts": {"must_fire": ["QueueWaitHigh"],
+                       "must_not_fire": "*"},
+        },
+    },
+    "priority_saturation": {
+        "name": "priority_saturation",
+        "duration_s": 30.0,
+        "arrival": {"kind": "constant", "rate": 12.0},
+        # one replica, preemption ON, no autoscaler: a flood of fat
+        # best-effort budgets oversaturates the lane (~0.1 s per
+        # request at the default service rate — ~1.2x capacity, so the
+        # backlog grows all run and QueueWaitHigh pages), and the
+        # steady paid stream can only hold its wait floor by PAUSING
+        # whatever is running.  With preempt="degrade" the same
+        # workload parks paid p99 behind the multi-second best-effort
+        # backlog; the envelope's priority-wait ceiling is unreachable
+        # there, which is the regression gate on the preemption path.
+        "max_new": {"kind": "const", "value": 44},
+        "tenants": [
+            {"name": "batch", "weight": 8.0, "priority": 0},
+            {"name": "paid", "weight": 2.0, "priority": 1},
+        ],
+        "seed": 23,
+        "fleet": {"replicas": 1, "preempt": "migrate"},
+        "envelope": {
+            "max_lost": 0,
+            "max_priority_bad": 0,    # paid burns zero SLO budget
+            "max_p99_priority_wait_s": 0.5,
+            "min_preemptions": 5,     # the pause path must actually run
             "decisions": {"failed": {"max": 0}},
             "alerts": {"must_fire": ["QueueWaitHigh"],
                        "must_not_fire": "*"},

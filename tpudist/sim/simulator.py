@@ -109,7 +109,8 @@ class SimReplica:
                  wait_window_s: float = 15.0,
                  kv_blocks_total: int = 0,
                  prefix_cache_blocks: int = 0,
-                 tier_blocks: int = 0) -> None:
+                 tier_blocks: int = 0,
+                 preempt: str = "degrade") -> None:
         self.fabric = fabric
         self.clock = clock
         self.rank = int(rank)
@@ -132,6 +133,23 @@ class SimReplica:
         # synthetic KV occupancy (decode-pool autoscale signal): each
         # resident request pins ceil((prompt + budget) / 16) of these
         self.kv_blocks_total = int(kv_blocks_total)
+        # priority preemption (ISSUE 19): "migrate" makes this replica
+        # the flow-model mirror of ``ServeLoop(preempt="migrate")`` — a
+        # strictly-higher-priority arrival PAUSES the running request
+        # (its remaining service time parks in ``_paused`` and it
+        # re-queues at the FRONT, the sim analogue of export -> host
+        # tier -> re-adopt) and admission picks priority-first.  The
+        # default keeps every pre-migration scenario byte-stable.
+        if preempt not in ("degrade", "migrate"):
+            raise ValueError(f"preempt must be degrade/migrate, "
+                             f"got {preempt!r}")
+        self.preempt = preempt
+        self.preempted = 0
+        self.resumed = 0
+        self._paused: dict[str, float] = {}   # rid -> remaining service s
+        self.all_waits_priority: list[float] = []
+        self._obs_preempted = obs.counter("serve/preempted", unit="reqs")
+        self._obs_resumed = obs.counter("serve/resumed", unit="reqs")
         self.alive = True
         self.killed = False
         self.served = 0
@@ -242,6 +260,33 @@ class SimReplica:
             return int(req.max_new_tokens) * self.spt
         return (self._prefill_s_of(req, covered_tokens)
                 + int(req.max_new_tokens) * self.spt)
+
+    def _maybe_preempt(self, now: float) -> bool:
+        """Pause the running request when a strictly-higher-priority
+        one waits (preempt="migrate" only): remaining service parks in
+        ``_paused`` and the request re-queues at the FRONT — the flow
+        model of ServeLoop's export -> park -> resume, byte-exactness
+        included (the sim data plane is deterministic either way).
+        Returns True when it preempted (the caller re-picks)."""
+        if (self.preempt != "migrate" or self.role == "prefill"
+                or self._cur is None or not self._queue):
+            return False
+        req, enq_t, start, finish_at, covered = self._cur
+        curp = int(getattr(req, "priority", 0) or 0)
+        top = max(int(getattr(r, "priority", 0) or 0)
+                  for r, _ in self._queue)
+        if top <= curp:
+            return False
+        self._paused[str(req.rid)] = finish_at - now
+        self._queue.insert(0, (req, enq_t))
+        self._cur = None
+        self.preempted += 1
+        self._obs_preempted.inc()
+        if req.trace is not None:
+            obs.events.record("preempt", trace=req.trace.trace_id,
+                              replica=self.rid,
+                              remaining_s=round(finish_at - now, 6))
+        return True
 
     # -- tiered prefix-chain model (ISSUE 16) -------------------------------
 
@@ -378,7 +423,13 @@ class SimReplica:
                 "serve/queue_depth": {"value": float(len(self._queue))},
                 "serve/seconds_per_token": {"value": self.spt},
             },
-            "counters": {},
+            # the preempt/resume counters ride the snapshot exactly as
+            # a live ServeLoop publishes them (empty dict when the mode
+            # is off, keeping pre-migration snapshots byte-stable)
+            "counters": (
+                {"serve/preempted": {"value": float(self.preempted)},
+                 "serve/resumed": {"value": float(self.resumed)}}
+                if self.preempt == "migrate" else {}),
             "histograms": {},
         }
         if self.kv_blocks_total > 0:
@@ -540,7 +591,9 @@ class SimReplica:
             if self._cur is not None:
                 req, enq_t, start, finish_at, covered = self._cur
                 if now < finish_at:
-                    break
+                    if not self._maybe_preempt(now):
+                        break
+                    continue
                 if self.role == "prefill":
                     # stage done: first token exists, KV migrated.  The
                     # ref is synthetic (the sim carries no pages) — the
@@ -561,10 +614,31 @@ class SimReplica:
                 self._cur = None
             if not self._queue:
                 break
-            req, enq_t = self._queue.pop(0)
+            if self.preempt == "migrate":
+                # priority-first admission, FIFO within a class — the
+                # sim mirror of ServeLoop's migrate-mode admit_free
+                sel = max(range(len(self._queue)),
+                          key=lambda i: (int(getattr(
+                              self._queue[i][0], "priority", 0) or 0),
+                              -i))
+            else:
+                sel = 0
+            req, enq_t = self._queue.pop(sel)
+            remaining = self._paused.pop(str(req.rid), None)
             wait = now - enq_t
-            self._waits.append((now, wait))
-            self.all_waits.append(wait)
+            if remaining is None:
+                self._waits.append((now, wait))
+                self.all_waits.append(wait)
+                if int(getattr(req, "priority", 0) or 0) > 0:
+                    self.all_waits_priority.append(wait)
+            else:
+                # a paused lane resuming: its wait was already counted
+                # at first admission
+                self.resumed += 1
+                self._obs_resumed.inc()
+                if req.trace is not None:
+                    obs.events.record("resume", trace=req.trace.trace_id,
+                                      replica=self.rid)
             if (req.deadline_s is not None
                     and self.clock.wall() > req.deadline_s):
                 # expired while queued: the replica-side deadline kill
@@ -580,14 +654,17 @@ class SimReplica:
                 self._affinity[int(phash)] = None
                 while len(self._affinity) > 128:
                     self._affinity.pop(next(iter(self._affinity)))
-            covered = self._admit_chains(req)
+            covered = 0 if remaining is not None \
+                else self._admit_chains(req)
             if req.trace is not None:
                 obs.events.record("admit", trace=req.trace.trace_id,
                                   replica=self.rid,
                                   queue_wait_s=round(wait, 6),
                                   prefix_hit=hit)
             self._cur = (req, enq_t, now,
-                         now + self._service_s(req, covered), covered)
+                         now + (remaining if remaining is not None
+                                else self._service_s(req, covered)),
+                         covered)
 
         if now >= self._next_pub:
             self._publish()
@@ -756,7 +833,8 @@ class FleetSim:
             kv_blocks_total=int(fleet.get("kv_blocks_total") or 0),
             prefix_cache_blocks=int(
                 fleet.get("prefix_cache_blocks") or 0),
-            tier_blocks=int(fleet.get("tier_blocks") or 0))
+            tier_blocks=int(fleet.get("tier_blocks") or 0),
+            preempt=str(fleet.get("preempt") or "degrade"))
         if warmup_s == 0.0:
             r.step()   # live (and publishing) before the first poll
         self.replicas.append(r)
@@ -887,6 +965,8 @@ class FleetSim:
             reasons[c.reason] = reasons.get(c.reason, 0) + 1
         waits = [w for r in self.replicas for w in r.all_waits]
         ttfts = [t for r in self.replicas for t in r.all_ttfts]
+        pwaits = [w for r in self.replicas
+                  for w in r.all_waits_priority]
         now = _counters_now(self.ns)
         delta = {k: now.get(k, 0.0) - base.get(k, 0.0) for k in now}
 
@@ -978,6 +1058,18 @@ class FleetSim:
                 "router/prefix_pull_fallbacks", 0.0),
             "prefix_stale_skips": delta.get(
                 "router/prefix_stale_skips", 0.0),
+            # migration accounting (ISSUE 19): preempt/resume volume on
+            # the replicas, the PRIORITY class's own queue-wait tail
+            # (the number preemption exists to hold down), and the
+            # router-side migrate-stage commits and their fallbacks
+            "preemptions": delta.get("serve/preempted", 0.0),
+            "preempt_resumes": delta.get("serve/resumed", 0.0),
+            "p99_priority_wait_s": (
+                round(float(np.percentile(pwaits, 99)), 6)
+                if pwaits else 0.0),
+            "migrations": delta.get("router/migrations", 0.0),
+            "migration_fallbacks": delta.get(
+                "router/migration_fallbacks", 0.0),
         }
         for reason in ("completed", "shed", "rejected", "failed",
                        "timeout"):
@@ -1023,6 +1115,9 @@ def _counters_now(ns: str) -> dict[str, float]:
                             "router/recoveries", "coord/",
                             "integrity/", "probe/", "quarantine/",
                             "router/quarantines", "router/reinstated",
-                            "router/retired", "router/prefix")):
+                            "router/retired", "router/prefix",
+                            "serve/preempted", "serve/resumed",
+                            "router/migrations",
+                            "router/migration_fallbacks")):
             out[name] = float(m.get("value") or 0.0)
     return out
