@@ -4020,6 +4020,160 @@ def bench_serve_migration(on_tpu: bool) -> None:
     server.stop()
 
 
+def bench_train_mesh_compose(on_tpu: bool) -> None:
+    """One mesh-axis spec, measured: the composition matrix (dp×tp,
+    fsdp×tp, dp×fsdp×tp, dp×pp, dp×pp×tp, dp×ep) each bitwise vs its
+    single-strategy reference at equal global batch, plus the real
+    16-layer TransformerLM through interleaved 1F1B at P=4/M=16/V=4 —
+    one row per combination with step time, ``bubble_fraction``,
+    ``exact_match`` and ``mfu_reported`` (the CI mesh-smoke contract).
+
+    The matrix needs 8 devices; when this process has fewer it runs
+    ``python -m tpudist.parallel.mesh_bench`` as a subprocess with
+    ``--force-cpu`` (8 simulated CPU devices) and re-emits its JSONL
+    rows, so one bench entry serves TPU hosts and the CPU CI alike.
+
+    A second section demonstrates the composed step's dp gradient
+    leg riding the host-collective overlap path: per-dp-rank gradients
+    of the SAME composed LM pushed leaf-by-leaf in backward order
+    through ``OverlappedGradSync`` buckets, asserting the bucketed sum
+    is bitwise the one-shot allreduce and allclose to the full-batch
+    gradient the compiled step differentiates."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import jax
+
+    if jax.device_count() >= 8:
+        from tpudist.parallel import mesh_bench
+
+        rows = mesh_bench.run_all()
+    else:
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "mesh_rows.jsonl")
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [sys.executable, "-m", "tpudist.parallel.mesh_bench",
+                 "--out", out, "--force-cpu"],
+                capture_output=True, text=True, timeout=1800, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"mesh_bench subprocess failed: {proc.stderr[-500:]}")
+            with open(out) as f:
+                rows = [json.loads(line) for line in f if line.strip()]
+
+    for row in rows:
+        extra = {k: v for k, v in row.items() if k != "step_time_ms"}
+        _emit("train_mesh_compose", row.get("step_time_ms", 0.0), "ms",
+              None, **extra)
+
+    # -- dp grad leg over host collectives: bucketed backward-order sync --
+    # The compiled composed step sums dp gradients inside XLA; the
+    # multi-host deployment hands that same sum to OverlappedGradSync
+    # (PR 18's bucketed path).  Both must be the same arithmetic: the
+    # bucketed accumulation is bitwise the one-shot allreduce, and the
+    # averaged result matches the full-batch gradient to float tolerance.
+    try:
+        import threading
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpudist.elastic.worker import OverlappedGradSync
+        from tpudist.models import TransformerConfig, TransformerLM
+        from tpudist.ops.losses import cross_entropy
+        from tpudist.runtime.collectives import (
+            CollectiveConfig, HostCollectives,
+        )
+        from tpudist.runtime.coord import CoordClient, CoordServer
+
+        cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                                embed_dim=16, max_seq_len=8)
+        model = TransformerLM(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 32, (8, 8)), jnp.int32)
+        params = model.init(jax.random.key(0), tokens[:2])["params"]
+
+        def loss(p, toks):
+            logits = model.apply({"params": p}, toks)
+            return cross_entropy(
+                logits[:, :-1].reshape(-1, cfg.vocab_size),
+                toks[:, 1:].reshape(-1))
+
+        grad_fn = jax.jit(jax.grad(loss))
+        world = 2
+        shards = [tokens[:4], tokens[4:]]
+        # per-rank SUMS (not means) so rank grads add to the global sum
+        rank_grads = [
+            {k: np.asarray(v) * (len(shards[r]) / len(tokens))
+             for k, v in _flatten_grad(grad_fn(params, shards[r])).items()}
+            for r in range(world)
+        ]
+        full_grad = _flatten_grad(grad_fn(params, tokens))
+
+        server = CoordServer(0)
+
+        def fn(rank, client):
+            coll = HostCollectives(
+                client, rank, world, round_id=777, timeout_s=60.0,
+                config=CollectiveConfig(algorithm="ring", compress="none",
+                                        bucket_bytes=256 << 10))
+            leaves = rank_grads[rank]
+            coll.allreduce_sum(leaves)  # warm
+            one_shot = coll.allreduce_sum(leaves)
+            sync_obj = OverlappedGradSync(coll, bucket_bytes=64 << 10)
+            for n in reversed(list(leaves)):  # backward order
+                sync_obj.grad_ready(n, leaves[n])
+            bucketed = sync_obj.reduce()
+            bitwise = all(one_shot[n].tobytes() == bucketed[n].tobytes()
+                          for n in leaves)
+            matches_step = all(
+                np.allclose(bucketed[n], full_grad[n], rtol=1e-5,
+                            atol=1e-6) for n in leaves)
+            coll.close()
+            return bitwise, matches_step
+
+        results, errors = [None] * world, []
+
+        def work(rank):
+            try:
+                with CoordClient(port=server.port) as client:
+                    results[rank] = fn(rank, client)
+            except Exception as e:  # noqa: BLE001
+                errors.append((rank, repr(e)))
+
+        threads = [threading.Thread(target=work, args=(r,))
+                   for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        server.stop()
+        if errors:
+            raise RuntimeError(f"grad sync workers failed: {errors}")
+        _emit("mesh_compose_grad_sync", world, "ranks", None,
+              bucketed_bitwise=all(r[0] for r in results),
+              matches_full_batch_grad=all(r[1] for r in results))
+    except Exception as e:  # noqa: BLE001 - coord server may be unbuilt
+        _emit("mesh_compose_grad_sync", 0, "ranks", None,
+              skipped=str(e)[:200])
+
+
+def _flatten_grad(tree) -> dict:
+    """Grad pytree → {dotted-path: float32 ndarray} in traversal order."""
+    import numpy as np
+
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): np.asarray(leaf, np.float32)
+            for path, leaf in flat}
+
+
 def main() -> None:
     import jax
 
@@ -4043,7 +4197,7 @@ def main() -> None:
                bench_coord_brownout, bench_corruption_quarantine,
                bench_serve_prefix_batching, bench_serve_disagg,
                bench_kv_tier, bench_serve_alerts,
-               bench_serve_migration]
+               bench_serve_migration, bench_train_mesh_compose]
     # optional name filters: `python bench.py serve_loop moe` (positional
     # substrings) or `python bench.py --only serve_loop,input_pipeline`
     # (comma-separated; the CI smoke job's spelling) run only the benches

@@ -46,7 +46,7 @@ class ShardedLoader:
         arrays: Sequence[np.ndarray],
         global_batch: int,
         mesh: Mesh | None = None,
-        data_axis: str = "data",
+        data_axis: str | tuple = "data",
         shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
@@ -59,7 +59,12 @@ class ShardedLoader:
         self.arrays = list(arrays)
         self.mesh = mesh
         self.data_axis = data_axis
-        self.num_shards = mesh.shape[data_axis] if mesh is not None else 1
+        # a tuple axis shards the batch over several mesh axes at once —
+        # the composed dp×fsdp layout (PartitionSpec(("dp", "fsdp")))
+        axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
+        self.num_shards = (
+            int(np.prod([mesh.shape[a] for a in axes]))
+            if mesh is not None else 1)
         if global_batch % self.num_shards:
             raise ValueError(
                 f"global batch {global_batch} not divisible by {self.num_shards} shards"

@@ -1,12 +1,21 @@
-"""Parallelism strategies over mesh axes.
+"""Parallelism as ONE composed mesh, not five strategies.
 
-The reference's L3 layer (SURVEY.md §1): DDP / Horovod data parallelism →
-:mod:`data_parallel` (explicit ``psum`` over ICI); the RPC micro-batched
-pipeline → :mod:`pipeline` (``ppermute`` + ``lax.scan`` schedules); the
-parameter-server hybrid → :mod:`ps_hybrid` (model-axis-sharded embedding +
-data-parallel dense).  Distributed autograd and DistributedOptimizer have no
-counterpart here because ``jax.grad`` + optax work through shardings natively
-(SURVEY.md §2.2).
+The entry point is :mod:`tpudist.parallel.mesh`: declare axis sizes in a
+:class:`~tpudist.parallel.mesh.MeshSpec` (``dp`` batch replication,
+``fsdp`` ZeRO parameter sharding, ``tp`` tensor rules, ``pp`` pipeline
+schedule, ``ep`` expert sharding) and
+:func:`~tpudist.parallel.mesh.make_composed_train_step` compiles one step
+for that point of the composition space — ``MeshSpec(dp=2, fsdp=2, tp=2)``
+trains the same model the same way ``MeshSpec(tp=4)`` does, with no
+per-strategy wiring.  The per-axis modules remain as the building blocks
+the composition reuses (and as standalone references the composed step is
+bitwise-tested against): :mod:`data_parallel` explicit ``psum`` DP,
+:mod:`tensor_parallel` GSPMD rule programs, :mod:`fsdp` ZeRO specs and the
+explicit gather/scatter step, :mod:`expert_parallel` MoE expert sharding,
+:mod:`pipeline` compiled GPipe/1F1B/interleaved schedules (``pp`` stays a
+schedule in time, not a GSPMD layout — see docs/DESIGN.md "One mesh
+spec"), plus :mod:`ps_hybrid` and :mod:`ring_attention` for the
+parameter-server and sequence-parallel specials.
 """
 
 from tpudist.parallel.data_parallel import (
@@ -41,6 +50,14 @@ from tpudist.parallel.pipeline import (
     state_specs_like,
     unpack_stage_params,
 )
+from tpudist.parallel.mesh import (
+    MESH_AXES,
+    MeshSpec,
+    make_composed_eval_step,
+    make_composed_state,
+    make_composed_train_step,
+    shard_composed_batch,
+)
 from tpudist.parallel.ps_hybrid import (
     make_ps_hybrid_forward,
     make_ps_hybrid_train_step,
@@ -64,6 +81,12 @@ from tpudist.parallel.tensor_parallel import (
 )
 
 __all__ = [
+    "MESH_AXES",
+    "MeshSpec",
+    "make_composed_eval_step",
+    "make_composed_state",
+    "make_composed_train_step",
+    "shard_composed_batch",
     "broadcast_params",
     "fsdp_specs",
     "make_ep_shard_train_step",

@@ -137,5 +137,6 @@ def make_ep_shard_train_step(
     def train_step(state, *batch):
         return stepped(state, batch)
 
+    train_step.lower = lambda state, *batch: stepped.lower(state, batch)
     train_step.jitted = stepped  # for HLO schedule assertions
     return train_step

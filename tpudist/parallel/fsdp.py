@@ -189,5 +189,6 @@ def make_zero3_train_step(
     def train_step(state, *batch):
         return stepped(state, batch)
 
+    train_step.lower = lambda state, *batch: stepped.lower(state, batch)
     train_step.jitted = stepped  # for HLO schedule assertions
     return train_step

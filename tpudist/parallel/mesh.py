@@ -1,0 +1,355 @@
+"""One mesh-axis spec: declarative dp × fsdp × tp × pp × ep composition.
+
+The strategies in this package are building blocks — explicit data
+parallelism (:mod:`data_parallel`), GSPMD sharding-rule programs
+(:mod:`tensor_parallel`), ZeRO-style parameter sharding (:mod:`fsdp`),
+expert sharding (:mod:`expert_parallel`) and the compiled pipeline
+schedules (:mod:`pipeline`).  Each is its own entry point, which is how the
+reference suite worked and why it composed at most two axes at a time.
+
+:class:`MeshSpec` replaces strategy selection with axis sizes: declare
+``MeshSpec(dp=2, fsdp=2, tp=2)``, build ONE :class:`jax.sharding.Mesh`
+with the five canonical axes, and :func:`make_composed_train_step` returns
+ONE compiled step for that point of the composition space, assembled from
+the same building blocks:
+
+* ``dp``    — batch replication; gradients mean over the axis.
+* ``fsdp``  — ZeRO parameter/optimizer sharding; :func:`~tpudist.parallel.
+  fsdp.fsdp_specs` picks the largest free divisible dim of every leaf.
+* ``tp``    — tensor (Megatron) sharding from path-pattern rules
+  (``spec.rules``, e.g. :func:`~tpudist.parallel.tensor_parallel.
+  transformer_tp_rules` over the ``tp`` axis).
+* ``ep``    — expert sharding from the same rule language
+  (:func:`~tpudist.parallel.expert_parallel.moe_ep_rules`).
+* ``pp``    — pipeline parallelism.  Unlike the other four, ``pp`` is NOT
+  expressed as a GSPMD layout: XLA partitions one program in space, while a
+  pipeline is a schedule in TIME (fill/drain, 1F1B ordering, bounded
+  activation banking).  The ``pp`` mesh axis therefore carries the
+  stage-sharded parameter placement, and the compiled schedule tables from
+  :mod:`pipeline` (GPipe / 1F1B / interleaved) order the work.
+
+Rule precedence when two axes want the same tensor dimension: ``tp``/``ep``
+rules are applied first (first matching rule wins, as in
+:func:`spec_tree_from_rules`), then ``fsdp`` shards the largest dimension
+the rules left free (see :func:`~tpudist.parallel.fsdp.fsdp_specs`); a leaf
+with no free divisible dimension replicates over ``fsdp``.  ``dp`` never
+claims a parameter dimension — it only shards the batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# NOTE: the per-strategy building blocks (data_parallel / fsdp / pipeline /
+# tensor_parallel) are imported lazily inside the functions that assemble
+# them: tensor_parallel reaches tpudist.train at import time, and the
+# Trainer there composes THIS module — top-level imports would cycle.
+Rules = Sequence  # alias of tensor_parallel.Rules, kept import-light here
+
+MESH_AXES = ("dp", "fsdp", "ep", "pp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Axis sizes + sharding rules: the one declarative knob for how a
+    model trains.
+
+    ``rules`` are path-pattern → :class:`PartitionSpec` pairs over the
+    CANONICAL axis names (``"tp"``, ``"ep"``) — e.g.
+    ``transformer_tp_rules("tp")`` or ``moe_ep_rules("ep")`` (concatenate
+    for MoE × TP; first match wins).  ``num_microbatches`` /
+    ``virtual_stages`` parameterize the pipeline schedule and are ignored
+    at ``pp == 1``.
+
+    Distinct from :class:`tpudist.runtime.mesh.MeshSpec` (a generic
+    ``{name: size}`` grid builder): this one fixes the five axis names and
+    their composition semantics.
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    rules: Rules = ()
+    num_microbatches: int = 1
+    virtual_stages: int = 1
+
+    def __post_init__(self) -> None:
+        for name in MESH_AXES:
+            if getattr(self, name) < 1:
+                raise ValueError(f"axis {name!r} must be >= 1, got "
+                                 f"{getattr(self, name)}")
+        if self.num_microbatches < 1 or self.virtual_stages < 1:
+            raise ValueError("num_microbatches and virtual_stages must be >= 1")
+
+    @classmethod
+    def parse(cls, text: str, **kwargs) -> "MeshSpec":
+        """``MeshSpec.parse("dp=2,fsdp=2,tp=2")`` — the CLI spelling."""
+        sizes: dict[str, int] = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, val = part.partition("=")
+            if name not in MESH_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {name!r}; valid: {MESH_AXES}")
+            sizes[name] = int(val)
+        return cls(**sizes, **kwargs)
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in MESH_AXES}
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.axis_sizes().values())
+
+    @property
+    def n_stages(self) -> int:
+        """Stacked depth of the pipeline parameter stack (P·V chunks)."""
+        return self.pp * self.virtual_stages
+
+    def data_axes(self) -> tuple[str, ...]:
+        """Mesh axes the BATCH dimension shards over.  ``ep`` and ``tp``
+        shard parameters/experts, not the batch; the pipeline path keeps
+        the batch on ``dp`` alone (``fsdp``/``ep`` are rejected with
+        ``pp`` anyway)."""
+        return ("dp",) if self.pp > 1 else ("dp", "fsdp")
+
+    def batch_spec(self) -> P:
+        return P(self.data_axes())
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        """One mesh, all five axes (size-1 axes included so every spec
+        compiles against the same axis names)."""
+        if devices is None:
+            devices = jax.devices()[: self.n_devices]
+        if len(devices) != self.n_devices:
+            raise ValueError(
+                f"{self.axis_sizes()} needs {self.n_devices} devices, got "
+                f"{len(devices)}")
+        import numpy as np
+
+        grid = np.asarray(devices).reshape(
+            tuple(self.axis_sizes().values()))
+        return Mesh(grid, MESH_AXES)
+
+    def param_specs(self, params: Any) -> Any:
+        """PartitionSpec tree for the non-pipeline axes: ``rules`` claim
+        dims for ``tp``/``ep`` first, then ``fsdp`` shards the largest
+        remaining divisible dim of every leaf (rule precedence above)."""
+        from tpudist.parallel.fsdp import fsdp_specs
+        from tpudist.parallel.tensor_parallel import spec_tree_from_rules
+
+        if self.fsdp > 1:
+            # fsdp_specs merges rule claims before picking its dim; it
+            # needs the mesh only for the axis size, which we know.
+            mesh = _FakeAxisSize({"fsdp": self.fsdp})
+            return fsdp_specs(params, mesh, axis="fsdp",
+                              tp_rules=self.rules or None)
+        return spec_tree_from_rules(params, self.rules)
+
+
+class _FakeAxisSize:
+    """Duck-typed stand-in for a Mesh where only ``shape[axis]`` is read —
+    lets spec derivation run before (or without) building the real mesh."""
+
+    def __init__(self, sizes: dict[str, int]) -> None:
+        self.shape = sizes
+
+
+def shard_composed_batch(batch: Any, mesh: Mesh, spec: MeshSpec) -> Any:
+    """Place batch arrays with their leading dim sharded over the spec's
+    data axes (``dp×fsdp``, or ``dp`` under pipelining)."""
+    sharding = NamedSharding(mesh, spec.batch_spec())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def _publish_mesh_gauges(spec: MeshSpec, bubble: float) -> None:
+    """Telemetry satellite: axis sizes + the composed step's schedule
+    bubble (0.0 for non-pipeline specs) into the obs registry."""
+    try:
+        from tpudist import obs
+    except Exception:  # noqa: BLE001 - obs must never block training
+        return
+    for name, size in spec.axis_sizes().items():
+        obs.gauge(f"mesh/axis_size~axis={name}").set(float(size))
+    obs.gauge("train/bubble_fraction").set(float(bubble))
+
+
+def make_composed_train_step(
+    spec: MeshSpec,
+    mesh: Mesh,
+    loss_fn: Callable | None = None,
+    *,
+    params: Any = None,
+    state_example: Any = None,
+    block_fn: Callable | None = None,
+    stage_loss_fn: Callable | None = None,
+    embed_fn: Callable | None = None,
+    head_loss_fn: Callable | None = None,
+    state_specs: Any = None,
+    grad_sync_axes: Any = None,
+    schedule: str = "1f1b",
+    donate: bool = True,
+):
+    """ONE compiled ``train_step(state, x, y) -> (state, metrics)`` for any
+    point of the dp × fsdp × tp × pp × ep composition space, reusing the
+    per-strategy building blocks instead of adding a sixth code path:
+
+    * ``pp == 1``, everything else 1 → the explicit-collective DP step
+      (:func:`make_dp_train_step` over the ``dp`` axis) — bitwise the
+      single-strategy data-parallel program.
+    * ``pp == 1``, any of fsdp/tp/ep > 1 → the GSPMD global program
+      (:func:`make_spmd_train_step`) under ``spec.param_specs(params)``:
+      the sharding rules ARE the strategy, one jit covers every
+      dp×fsdp×tp×ep combination.  Requires ``loss_fn`` (the
+      :data:`~tpudist.parallel.tensor_parallel.LossFn` contract) and
+      example ``params``.
+    * ``pp > 1`` → a compiled pipeline schedule over the ``pp`` axis with
+      dp riding along.  ``tp > 1`` (or ``schedule="gpipe"``) selects the
+      stacked fill-drain schedule (:func:`make_stacked_pipeline_train_step`
+      — pass ``state_specs``/``grad_sync_axes`` for tensor-parallel
+      blocks); otherwise the 1F1B/interleaved schedule
+      (:func:`make_1f1b_pipeline_train_step`) with
+      ``spec.num_microbatches``/``spec.virtual_stages``, including the
+      real-model ``embed_fn``/``head_loss_fn`` mode.  Requires
+      ``block_fn`` + ``state_example``.  ``fsdp``/``ep`` under ``pp`` are
+      rejected with a clear error (stage-sharded params already partition
+      the model; composing ZeRO or expert sharding into the schedule is a
+      separate project, not a silent mis-sharding).
+
+    Every returned step exposes ``.lower(state, x, y)`` (the cost-probe /
+    MFU hook), ``.jitted``, ``.bubble_fraction`` (0.0 off-pipeline), and
+    ``.mesh_spec`` — and publishes the ``mesh/axis_size~axis=`` and
+    ``train/bubble_fraction`` gauges at build time.
+    """
+    from tpudist.parallel.data_parallel import make_dp_train_step
+    from tpudist.parallel.pipeline import (
+        make_1f1b_pipeline_train_step,
+        make_stacked_pipeline_train_step,
+    )
+    from tpudist.parallel.tensor_parallel import make_spmd_train_step
+
+    for name in MESH_AXES:
+        if mesh.shape.get(name) != getattr(spec, name):
+            raise ValueError(
+                f"mesh axis {name!r} is {mesh.shape.get(name)} but spec "
+                f"says {getattr(spec, name)}; build the mesh with "
+                f"spec.build()")
+    if spec.pp == 1:
+        if loss_fn is None:
+            raise ValueError("pp == 1 composition requires loss_fn "
+                             "(params, batch, rng) -> (loss, aux)")
+        if spec.fsdp == spec.tp == spec.ep == 1:
+            step = make_dp_train_step(loss_fn, mesh, axis="dp",
+                                      donate=donate)
+            step.param_specs = None
+        else:
+            if params is None:
+                params = getattr(state_example, "params", None)
+            if params is None:
+                raise ValueError(
+                    "fsdp/tp/ep composition needs example params (pass "
+                    "params= or state_example=) to derive sharding specs")
+            param_specs = spec.param_specs(params)
+            step = make_spmd_train_step(loss_fn, mesh, param_specs, donate)
+            step.param_specs = param_specs
+        step.bubble_fraction = 0.0
+    else:
+        if spec.fsdp > 1 or spec.ep > 1:
+            raise ValueError(
+                f"pp={spec.pp} with fsdp={spec.fsdp}/ep={spec.ep} is not "
+                "supported: pipeline stages already shard parameters over "
+                "the pp axis; combine pp with dp and tp, or drop pp and "
+                "use fsdp×tp×ep")
+        if block_fn is None or state_example is None:
+            raise ValueError(
+                "pp > 1 composition requires block_fn and state_example "
+                "(stage-stacked params — see pipeline.py)")
+        if spec.tp > 1 or schedule == "gpipe":
+            if spec.virtual_stages > 1:
+                raise ValueError(
+                    "virtual_stages > 1 requires the 1f1b schedule "
+                    "(tp == 1)")
+            step = make_stacked_pipeline_train_step(
+                block_fn, stage_loss_fn, mesh, spec.num_microbatches,
+                state_example, data_axis="dp", stage_axis="pp",
+                donate=donate, state_specs=state_specs,
+                grad_sync_axes=grad_sync_axes)
+        elif schedule == "1f1b":
+            step = make_1f1b_pipeline_train_step(
+                block_fn, stage_loss_fn, mesh, spec.num_microbatches,
+                state_example, data_axis="dp", stage_axis="pp",
+                donate=donate, virtual_stages=spec.virtual_stages,
+                embed_fn=embed_fn, head_loss_fn=head_loss_fn)
+        else:
+            raise ValueError(f"unknown schedule {schedule!r} "
+                             "(expected '1f1b' or 'gpipe')")
+        step.param_specs = state_specs
+    step.mesh_spec = spec
+    _publish_mesh_gauges(spec, step.bubble_fraction)
+    return step
+
+
+def make_composed_state(
+    model_apply: Callable,
+    params: Any,
+    tx,
+    spec: MeshSpec,
+    mesh: Mesh,
+    rng: Any = 0,
+):
+    """Shard ``params`` by ``spec.param_specs`` onto the composed mesh and
+    build the TrainState (optimizer state inherits the shardings — the
+    :func:`~tpudist.parallel.tensor_parallel.make_tp_state` recipe, driven
+    by axis sizes instead of a strategy choice).  Returns
+    ``(state, param_specs)``.  Non-pipeline specs only: stage-stacked
+    pipeline states are built by the caller (see pipeline.py)."""
+    if spec.pp > 1:
+        raise ValueError(
+            "make_composed_state is for pp == 1 specs; pipeline states are "
+            "stage-stacked trees built per-model (see pipeline.py)")
+    from tpudist.parallel.tensor_parallel import shard_tree
+    from tpudist.train.state import TrainState
+
+    param_specs = spec.param_specs(params)
+    sharded = shard_tree(params, mesh, param_specs)
+    state = TrainState.create(model_apply, sharded, tx, rng=rng)
+    return state, param_specs
+
+
+def make_composed_eval_step(
+    predict_fn: Callable[[Any, tuple], jnp.ndarray],
+    mesh: Mesh,
+):
+    """Masked exact-count evaluation for ANY non-pipeline composition:
+    written as a GSPMD global program (like :func:`make_spmd_train_step`),
+    so the same jit serves dp, fsdp, tp and ep layouts — the counts are
+    global sums, no explicit collective needed.  Contract matches
+    :func:`~tpudist.parallel.data_parallel.make_dp_masked_eval_step`:
+    ``eval_step(params, *inputs, labels, mask) -> (correct, total)``."""
+
+    def _step(params, batch):
+        *inputs, labels, mask = batch
+        logits = predict_fn(params, tuple(inputs))
+        hit = (jnp.argmax(logits, -1) == labels) & mask
+        return (jnp.sum(hit.astype(jnp.int32)),
+                jnp.sum(mask.astype(jnp.int32)))
+
+    with mesh:
+        stepped = jax.jit(_step)
+
+    def eval_step(params, *batch):
+        with mesh:
+            return stepped(params, batch)
+
+    eval_step.jitted = stepped
+    return eval_step

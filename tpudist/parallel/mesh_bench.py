@@ -1,0 +1,478 @@
+"""Composition-matrix bench body for ``bench.py --only train_mesh_compose``.
+
+Runs :func:`~tpudist.parallel.mesh.make_composed_train_step` at ≥ 6 points
+of the dp × fsdp × tp × pp × ep space and BITWISE-compares loss + updated
+params against the hand-assembled single-strategy entry point for the same
+math at the same global batch (same init, same optimizer, same data — only
+the axis names and the entry point differ).  Then trains a real multi-stage
+:class:`~tpudist.models.transformer.TransformerLM` through the interleaved
+1F1B schedule (P=4, M=16, V=4 — the acceptance point) and reports the
+schedule bubble fraction, step time and whether the cost probe
+(``.lower`` → ``cost_analysis``) produced FLOPs for the composed step
+(``mfu_reported``, the ``xla/step_tflops``/``xla/mfu`` feed).
+
+Separate from ``bench.py`` because the matrix needs 8 devices: the parent
+bench runs this module as a subprocess with CPU-device forcing when the
+host doesn't have them (``python -m tpudist.parallel.mesh_bench --out f
+--force-cpu``), or calls :func:`run_all` inline when it does.  Keep module
+import free of jax so ``--force-cpu`` can set platform flags first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _bitwise_equal(a, b) -> tuple[bool, float]:
+    """(bitwise_equal, max_abs_diff) over two pytrees of arrays."""
+    import jax
+    import numpy as np
+
+    la = list(map(np.asarray, jax.tree.leaves(a)))
+    lb = list(map(np.asarray, jax.tree.leaves(b)))
+    if len(la) != len(lb):
+        return False, float("inf")
+    exact = all(x.tobytes() == y.tobytes() for x, y in zip(la, lb))
+    diff = max((float(np.max(np.abs(x.astype(np.float64) - y))) if x.size
+                else 0.0) for x, y in zip(la, lb))
+    return exact, diff
+
+
+def _run(step, state, batch, steps):
+    import jax
+
+    metrics = None
+    for _ in range(steps):
+        state, metrics = step(state, *batch)
+    jax.block_until_ready((state, metrics))
+    return state, metrics
+
+
+def _time_step(step, state, batch, iters=3) -> float:
+    """Best-of-N wall seconds for one already-compiled step (donate=False
+    combos only — state is reused)."""
+    import jax
+
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = step(state, *batch)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _mfu_reported(step, state, batch) -> bool:
+    """Satellite 1 contract: the composed step's ``.lower`` delegate must
+    yield cost_analysis FLOPs — the Trainer's ``xla/step_tflops``/
+    ``xla/mfu`` feed — under ANY axis combination."""
+    from tpudist.obs import xla as obs_xla
+
+    lower = getattr(step, "lower", None)
+    if lower is None:
+        return False
+    try:
+        return obs_xla.cost_flops(lower(state, *batch)) is not None
+    except Exception:  # noqa: BLE001 - probe must not fail the bench
+        return False
+
+
+def _lm_setup(num_layers=1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.ops.losses import cross_entropy
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=num_layers,
+                            num_heads=2, embed_dim=16, max_seq_len=8)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.key(0), tokens)["params"]
+
+    def loss_fn(p, batch, rng):
+        toks, tgts = batch
+        logits = model.apply({"params": p}, toks)
+        return cross_entropy(logits.reshape(-1, logits.shape[-1]),
+                             tgts.reshape(-1)), {}
+
+    return cfg, model, params, loss_fn, (tokens, targets)
+
+
+def _gspmd_row(name, spec, ref_axes, ref_specs_fn, ref_data_axes,
+               model, params, loss_fn, batch, steps=2):
+    """One GSPMD matrix point: composed step vs the single-strategy
+    reference assembled from the same building blocks."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudist.parallel.mesh import (
+        make_composed_state, make_composed_train_step, shard_composed_batch,
+    )
+    from tpudist.parallel.tensor_parallel import (
+        make_spmd_train_step, shard_tree,
+    )
+    from tpudist.runtime.mesh import make_mesh
+    from tpudist.train.state import TrainState
+
+    devs = jax.devices()[: spec.n_devices]
+    tx = optax.sgd(0.1)
+
+    ref_mesh = make_mesh(ref_axes, devs)
+    ref_specs = ref_specs_fn(ref_mesh)
+    ref_state = TrainState.create(
+        model.apply, shard_tree(params, ref_mesh, ref_specs), tx)
+    ref_step = make_spmd_train_step(loss_fn, ref_mesh, ref_specs,
+                                    donate=False)
+    ref_batch = jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(ref_mesh, P(ref_data_axes))), batch)
+    ref_state, ref_metrics = _run(ref_step, ref_state, ref_batch, steps)
+
+    mesh = spec.build(devs)
+    step = make_composed_train_step(spec, mesh, loss_fn, params=params,
+                                    donate=False)
+    state, _ = make_composed_state(model.apply, params, tx, spec, mesh)
+    cbatch = shard_composed_batch(batch, mesh, spec)
+    state, metrics = _run(step, state, cbatch, steps)
+
+    exact, diff = _bitwise_equal(
+        (metrics["loss"], state.params),
+        (ref_metrics["loss"], ref_state.params))
+    return {
+        "combo": name, "devices": spec.n_devices, "steps": steps,
+        "exact_match": exact, "max_abs_diff": diff,
+        "loss": float(metrics["loss"]), "ref_loss": float(ref_metrics["loss"]),
+        "step_time_ms": round(_time_step(step, state, cbatch) * 1e3, 3),
+        "mfu_reported": _mfu_reported(step, state, cbatch),
+        "bubble_fraction": step.bubble_fraction,
+    }
+
+
+def _pipeline_rows():
+    """dp×pp (1F1B) and dp×pp×tp (stacked schedule + Megatron block)
+    composed points vs the direct pipeline entry points."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpudist.parallel.common import id_fwd_psum_bwd, psum_fwd_id_bwd
+    from tpudist.parallel.mesh import MeshSpec, make_composed_train_step
+    from tpudist.parallel.pipeline import (
+        make_1f1b_pipeline_train_step,
+        make_stacked_pipeline_train_step,
+        state_specs_like,
+    )
+    from tpudist.runtime.mesh import make_mesh
+    from tpudist.train.state import TrainState
+
+    rows = []
+    rng = np.random.default_rng(0)
+    M, d, ff = 4, 8, 16
+    tx = optax.sgd(0.1)
+
+    def mse(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    # -- dp2 × pp2: homogeneous tanh blocks through the 1F1B schedule ----
+    P_ = 2
+    params = {
+        "w": jnp.asarray(rng.standard_normal((P_, d, d)) * 0.3, jnp.float32),
+        "b": jnp.zeros((P_, d), jnp.float32),
+    }
+
+    def block(p, a):
+        return jnp.tanh(a @ p["w"] + p["b"])
+
+    x = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+    batch = (x, y)
+
+    devs = jax.devices()[:4]
+    ref_mesh = make_mesh({"data": 2, "stage": P_}, devs)
+    ref_state = TrainState.create(None, params, tx)
+    ref_step = make_1f1b_pipeline_train_step(
+        block, mse, ref_mesh, M, ref_state, donate=False)
+    ref_state, ref_metrics = _run(ref_step, ref_state, batch, 2)
+
+    spec = MeshSpec(dp=2, pp=P_, num_microbatches=M)
+    mesh = spec.build(devs)
+    state = TrainState.create(None, params, tx)
+    step = make_composed_train_step(
+        spec, mesh, block_fn=block, stage_loss_fn=mse, state_example=state,
+        donate=False)
+    state, metrics = _run(step, state, batch, 2)
+    exact, diff = _bitwise_equal(
+        (metrics["loss"], state.params),
+        (ref_metrics["loss"], ref_state.params))
+    rows.append({
+        "combo": "dp2_pp2", "devices": 4, "steps": 2,
+        "exact_match": exact, "max_abs_diff": diff,
+        "loss": float(metrics["loss"]), "ref_loss": float(ref_metrics["loss"]),
+        "step_time_ms": round(_time_step(step, state, batch) * 1e3, 3),
+        "mfu_reported": _mfu_reported(step, state, batch),
+        "bubble_fraction": step.bubble_fraction,
+    })
+
+    # -- dp2 × pp2 × tp2: stacked schedule, Megatron MLP block ------------
+    params3 = {
+        "up": jnp.asarray(rng.standard_normal((P_, d, ff)) * 0.3,
+                          jnp.float32),
+        "down": jnp.asarray(rng.standard_normal((P_, ff, d)) * 0.3,
+                            jnp.float32),
+    }
+
+    def tp_block(axis):
+        def fn(p, a):
+            a = id_fwd_psum_bwd(a, axis)
+            h = jnp.tanh(a @ p["up"])
+            return psum_fwd_id_bwd(h @ p["down"], axis)
+        return fn
+
+    devs8 = jax.devices()[:8]
+    ref_mesh = make_mesh({"data": 2, "stage": P_, "model": 2}, devs8)
+    ref_state = TrainState.create(None, params3, tx)
+    ref_specs = state_specs_like(
+        ref_state, {"up": P("stage", None, "model"),
+                    "down": P("stage", "model", None)})
+    ref_step = make_stacked_pipeline_train_step(
+        tp_block("model"), mse, ref_mesh, M, ref_state,
+        state_specs=ref_specs, grad_sync_axes=("model",), donate=False)
+    ref_state, ref_metrics = _run(ref_step, ref_state, batch, 2)
+
+    spec = MeshSpec(dp=2, pp=P_, tp=2, num_microbatches=M)
+    mesh = spec.build(devs8)
+    state = TrainState.create(None, params3, tx)
+    specs = state_specs_like(
+        state, {"up": P("pp", None, "tp"), "down": P("pp", "tp", None)})
+    step = make_composed_train_step(
+        spec, mesh, block_fn=tp_block("tp"), stage_loss_fn=mse,
+        state_example=state, state_specs=specs, grad_sync_axes=("tp",),
+        donate=False)
+    state, metrics = _run(step, state, batch, 2)
+    exact, diff = _bitwise_equal(
+        (metrics["loss"], state.params),
+        (ref_metrics["loss"], ref_state.params))
+    rows.append({
+        "combo": "dp2_pp2_tp2", "devices": 8, "steps": 2,
+        "exact_match": exact, "max_abs_diff": diff,
+        "loss": float(metrics["loss"]), "ref_loss": float(ref_metrics["loss"]),
+        "step_time_ms": round(_time_step(step, state, batch) * 1e3, 3),
+        "mfu_reported": _mfu_reported(step, state, batch),
+        "bubble_fraction": step.bubble_fraction,
+    })
+    return rows
+
+
+def _ep_row():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpudist.models import MoEConfig, MoETransformerLM, TransformerConfig
+    from tpudist.ops.losses import cross_entropy
+    from tpudist.parallel.expert_parallel import (
+        make_ep_state, make_ep_train_step, moe_ep_rules,
+    )
+    from tpudist.parallel.mesh import MeshSpec
+    from tpudist.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=2,
+                            embed_dim=16, max_seq_len=8)
+    model = MoETransformerLM(cfg, MoEConfig(num_experts=2, top_k=1,
+                                            capacity_factor=4.0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (8, 8)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+
+    def loss_fn(p, batch, rng):
+        (toks,) = batch
+        logits, aux = model.apply({"params": p}, toks)
+        ce = cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab_size),
+                           toks[:, 1:].reshape(-1))
+        return ce + aux, {}
+
+    # reference assembles its own state to keep make_ep_state on its
+    # native axis name; composed uses the same rules over the "ep" axis
+    def ref_specs_fn(ref_mesh):
+        from tpudist.parallel.tensor_parallel import spec_tree_from_rules
+        return spec_tree_from_rules(params, moe_ep_rules("expert"))
+
+    spec = MeshSpec(dp=2, ep=2, rules=tuple(moe_ep_rules("ep")))
+    return _gspmd_row(
+        "dp2_ep2", spec, {"data": 2, "expert": 2}, ref_specs_fn, "data",
+        model, params, loss_fn, (tokens,))
+
+
+def run_matrix() -> list:
+    """The ≥6-combo composition matrix, bitwise vs references."""
+    import numpy as np
+
+    from tpudist.parallel.fsdp import fsdp_specs
+    from tpudist.parallel.mesh import MeshSpec
+    from tpudist.parallel.tensor_parallel import (
+        spec_tree_from_rules, transformer_tp_rules,
+    )
+
+    cfg, model, params, loss_fn, batch = _lm_setup()
+    rows = []
+
+    rows.append(_gspmd_row(
+        "dp2_tp2",
+        MeshSpec(dp=2, tp=2, rules=tuple(transformer_tp_rules("tp"))),
+        {"data": 2, "model": 2},
+        lambda m: spec_tree_from_rules(params, transformer_tp_rules("model")),
+        "data", model, params, loss_fn, batch))
+
+    rows.append(_gspmd_row(
+        "fsdp2_tp2",
+        MeshSpec(fsdp=2, tp=2, rules=tuple(transformer_tp_rules("tp"))),
+        {"fsdp": 2, "model": 2},
+        lambda m: fsdp_specs(params, m, axis="fsdp",
+                             tp_rules=transformer_tp_rules("model")),
+        "fsdp", model, params, loss_fn, batch))
+
+    rows.append(_gspmd_row(
+        "dp2_fsdp2_tp2",
+        MeshSpec(dp=2, fsdp=2, tp=2,
+                 rules=tuple(transformer_tp_rules("tp"))),
+        {"data": 2, "fsdp": 2, "model": 2},
+        lambda m: fsdp_specs(params, m, axis="fsdp",
+                             tp_rules=transformer_tp_rules("model")),
+        ("data", "fsdp"), model, params, loss_fn, batch))
+
+    rows.extend(_pipeline_rows())
+    rows.append(_ep_row())
+    return rows
+
+
+def run_real_lm(n_stages=4, microbatches=16, virtual=4, dp=2, steps=3):
+    """The acceptance point: a REAL multi-stage TransformerLM trained
+    end-to-end through the interleaved 1F1B schedule — stage-boundary
+    activations flowing through the ppermute ring, embedding and head
+    gradients riding the extra-params path, bubble measured from the
+    schedule that actually executed (P=4/M=16/V=4 → ≤ 0.08)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpudist.models import TransformerConfig, TransformerLM
+    from tpudist.models.transformer import DecoderBlock
+    from tpudist.ops.losses import cross_entropy
+    from tpudist.parallel.mesh import MeshSpec, make_composed_train_step
+    from tpudist.parallel.pipeline import interleave_params
+    from tpudist.train.state import TrainState
+
+    L = n_stages * virtual
+    cfg = TransformerConfig(vocab_size=32, num_layers=L, num_heads=2,
+                            embed_dim=16, max_seq_len=8)
+    seq = cfg.max_seq_len
+    rng = np.random.default_rng(0)
+    per_shard = microbatches * 2          # micro-batch of 2 sequences
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (per_shard * dp, seq)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    flat = TransformerLM(cfg).init(
+        jax.random.key(0), tokens[:2])["params"]
+    stages = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[flat[f"block{i}"] for i in range(L)])
+    stages = interleave_params(stages, n_stages, virtual)
+    extra = {k: v for k, v in flat.items() if not k.startswith("block")}
+    params = {"stages": stages, "extra": extra}
+
+    block_mod = DecoderBlock(cfg)
+    ln_f = nn.LayerNorm(name="ln_f")
+
+    def block_fn(p, a):
+        return block_mod.apply({"params": p}, a)
+
+    def embed_fn(ex, x_mb):
+        a = jnp.take(ex["tok_embed"]["embedding"], x_mb, axis=0)
+        pos = jnp.arange(x_mb.shape[1])
+        return a + jnp.take(ex["pos_embed"]["embedding"], pos, axis=0)[None]
+
+    def head_loss_fn(ex, out, y_mb):
+        h = ln_f.apply({"params": ex["ln_f"]}, out)
+        logits = h @ ex["lm_head"]["kernel"]
+        return cross_entropy(logits.reshape(-1, cfg.vocab_size),
+                             y_mb.reshape(-1))
+
+    spec = MeshSpec(dp=dp, pp=n_stages, num_microbatches=microbatches,
+                    virtual_stages=virtual)
+    mesh = spec.build(jax.devices()[: spec.n_devices])
+    state = TrainState.create(None, params, optax.sgd(0.1))
+    step = make_composed_train_step(
+        spec, mesh, block_fn=block_fn, embed_fn=embed_fn,
+        head_loss_fn=head_loss_fn, state_example=state, donate=True)
+
+    losses = []
+    t_first = time.perf_counter()
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        state, metrics = step(state, tokens, targets)
+        jax.block_until_ready(metrics)
+        losses.append(float(metrics["loss"]))
+    compile_plus_first_s = losses and time.perf_counter() - t_first
+    mfu = _mfu_reported(step, state, (tokens, targets))
+    t0 = time.perf_counter()
+    state, metrics = step(state, tokens, targets)
+    jax.block_until_ready(metrics)
+    steady_ms = (time.perf_counter() - t0) * 1e3
+    losses.append(float(metrics["loss"]))
+    return {
+        "combo": f"real_lm_pp{n_stages}_dp{dp}_1f1b",
+        "devices": spec.n_devices, "P": n_stages, "M": microbatches,
+        "V": virtual, "layers": L, "steps": len(losses),
+        "global_batch": int(tokens.shape[0]),
+        "bubble_fraction": round(step.bubble_fraction, 4),
+        "schedule_ticks": int(step.schedule.T),
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "trained": bool(losses[-1] < losses[0])
+        and all(np.isfinite(losses)),
+        "step_time_ms": round(steady_ms, 3),
+        "mfu_reported": mfu,
+    }
+
+
+def run_all() -> list:
+    rows = run_matrix()
+    rows.append(run_real_lm())
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="write JSON rows here (one per line); else stdout")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="force 8 CPU devices before importing jax")
+    args = ap.parse_args(argv)
+    if args.force_cpu:
+        from tpudist.runtime.simulate import force_cpu_devices
+        force_cpu_devices(8)
+    rows = run_all()
+    text = "\n".join(json.dumps(r) for r in rows) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

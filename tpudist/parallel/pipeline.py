@@ -41,7 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
@@ -248,6 +248,8 @@ def make_pipeline_train_step(
     def train_step(state, x, y):
         return stepped(state, (x, y))
 
+    train_step.lower = lambda state, x, y: stepped.lower(state, (x, y))
+    train_step.jitted = stepped
     return train_step
 
 
@@ -279,21 +281,35 @@ def make_pipeline_forward(
     )
 
 
-def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage"):
+def stacked_state_specs(state, n_stages: int, stage_axis: str = "stage",
+                        overrides: Mapping[str, P] | None = None):
     """PartitionSpec pytree for a TrainState whose params are stage-stacked:
     every array leaf with leading dim ``n_stages`` shards over the stage
     axis (params and the mirroring optimizer moments), everything else
-    (step counters, scalars, rng) replicates."""
+    (step counters, scalars, rng) replicates.
 
-    def leaf_spec(leaf):
+    The leading-dim test is a HEURISTIC: a leaf whose first dim happens to
+    equal ``n_stages`` without being stacked (a ``[P, P]`` router table, a
+    vocab of exactly ``n_stages``) would silently mis-shard.  ``overrides``
+    escapes it: ``{path_substring: spec}`` pins the spec of every leaf whose
+    ``jax.tree_util.keystr`` path contains the substring (first match wins),
+    bypassing the shape guess entirely for those leaves."""
+
+    def leaf_spec(path, leaf):
+        if overrides:
+            name = jax.tree_util.keystr(path)
+            for pat, spec in overrides.items():
+                if pat in name:
+                    return spec
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n_stages:
             return P(stage_axis)
         return P()
 
-    return jax.tree.map(leaf_spec, state)
+    return jax.tree_util.tree_map_with_path(leaf_spec, state)
 
 
-def state_specs_like(state, param_specs):
+def state_specs_like(state, param_specs,
+                     mirrors: Mapping[str, bool] | None = None):
     """Full-TrainState spec tree from a params spec tree: every opt-state
     subtree that mirrors the params — same pytree structure AND same
     per-leaf shapes (Adam moments etc.) — gets ``param_specs``;
@@ -304,21 +320,53 @@ def state_specs_like(state, param_specs):
     cross-contaminate each other's optimizer moments (same motivation as
     `ps_state_specs`' match-by-path, adapted to optax's mirrored trees);
     the shape condition also keeps scalar state (e.g. Adam's count) from
-    matching when ``params`` is a single bare array."""
+    matching when ``params`` is a single bare array.
+
+    A subtree with the params' STRUCTURE but different leaf shapes is
+    ambiguous — the old behaviour replicated it silently, which mis-shards
+    any transform that stores param-aligned-but-reshaped state (factored
+    second moments, quantized moments).  Such subtrees now raise, naming
+    the offending path; resolve with ``mirrors``: ``{path_substring: bool}``
+    matched against the subtree's ``keystr`` path within ``opt_state``
+    (``True`` → treat as param-mirroring and apply ``param_specs``,
+    ``False`` → replicate every leaf).  ``mirrors`` also overrides the
+    heuristic where it *would* have matched."""
     param_treedef = jax.tree.structure(state.params)
     param_shapes = [getattr(l, "shape", None)
                     for l in jax.tree.leaves(state.params)]
+    bare = param_treedef == jax.tree.structure(0)  # single-array params
 
-    def mirrors_params(subtree) -> bool:
-        if jax.tree.structure(subtree) != param_treedef:
-            return False
-        return [getattr(l, "shape", None)
-                for l in jax.tree.leaves(subtree)] == param_shapes
+    def struct_matches(subtree) -> bool:
+        if bare:
+            # every leaf "matches" a bare-array structure; fall back to the
+            # shape test so scalars (Adam's count) keep replicating
+            return (getattr(subtree, "shape", None) == param_shapes[0]
+                    and not isinstance(subtree, P))
+        return jax.tree.structure(subtree) == param_treedef
 
-    opt_specs = jax.tree.map(
-        lambda sub: (param_specs if mirrors_params(sub)
-                     else jax.tree.map(lambda _: P(), sub)),
-        state.opt_state, is_leaf=mirrors_params)
+    def decide(path, sub):
+        name = jax.tree_util.keystr(path)
+        if mirrors is not None:
+            for pat, flag in mirrors.items():
+                if pat in name:
+                    return (param_specs if flag
+                            else jax.tree.map(lambda _: P(), sub))
+        if not struct_matches(sub):
+            return P()  # a plain non-mirroring leaf
+        shapes = [getattr(l, "shape", None) for l in jax.tree.leaves(sub)]
+        if shapes == param_shapes:
+            return param_specs
+        bad = next((i for i, (s, p) in enumerate(zip(shapes, param_shapes))
+                    if s != p), 0)
+        raise ValueError(
+            f"optimizer-state subtree {name} has the params' tree structure "
+            f"but different leaf shapes (leaf {bad}: {shapes[bad]} vs param "
+            f"{param_shapes[bad]}); guessing would silently mis-shard it — "
+            f"pass mirrors={{{name!r}: True}} to apply the param specs "
+            f"anyway, or mirrors={{{name!r}: False}} to replicate it")
+
+    opt_specs = jax.tree_util.tree_map_with_path(
+        decide, state.opt_state, is_leaf=struct_matches)
     return state.replace(
         params=param_specs, opt_state=opt_specs, step=P(), rng=P())
 
@@ -484,6 +532,11 @@ def make_stacked_pipeline_train_step(
     def train_step(state, x, y):
         return stepped(state, (x, y))
 
+    train_step.lower = lambda state, x, y: stepped.lower(state, (x, y))
+    train_step.jitted = stepped
+    # GPipe fill-drain: (P-1) idle ticks at each end of the 2(M+P-1) span
+    train_step.bubble_fraction = (n_stages - 1) / (
+        num_microbatches + n_stages - 1)
     return train_step
 
 
@@ -680,6 +733,8 @@ def make_packed_pipeline_train_step(
     def train_step(state, x, y):
         return stepped(state, (x, y))
 
+    train_step.lower = lambda state, x, y: stepped.lower(state, (x, y))
+    train_step.jitted = stepped
     return train_step
 
 
@@ -925,7 +980,7 @@ def _one_f_one_b_schedule(P: int, M: int, V: int = 1) -> _OneFOneBSchedule:
 
 def make_1f1b_pipeline_train_step(
     block_fn: StageFn,
-    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None,
     mesh: Mesh,
     num_microbatches: int,
     state_example,
@@ -933,6 +988,8 @@ def make_1f1b_pipeline_train_step(
     stage_axis: str = "stage",
     donate: bool = True,
     virtual_stages: int = 1,
+    embed_fn: Callable[[Any, jnp.ndarray], jnp.ndarray] | None = None,
+    head_loss_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
 ):
     """1F1B pipeline of HOMOGENEOUS blocks with stage-sharded parameters.
 
@@ -950,13 +1007,31 @@ def make_1f1b_pipeline_train_step(
     ``virtual_stages > 1`` selects the INTERLEAVED 1F1B schedule (the full
     Megatron-LM schedule): chunk ``c = v·P + p`` of a ``P·V``-deep stack
     runs on device ``c mod P``, shrinking the bubble by ~V at V extra ring
-    hops per micro-batch.  ``state.params`` leaves must then be stacked
+    hops per micro-batch.  The stacked params leaves must then be stacked
     ``[P·V, ...]`` in DEVICE order — build chunk-ordered params and apply
     :func:`interleave_params` first.
 
     Cotangents ride the reverse ``ppermute`` ring one hop per tick; the
     last CHUNK seeds them from the loss (scaled 1/M so the summed
     micro-batch gradients equal the full-batch gradient).
+
+    **Real-model mode** (``embed_fn`` / ``head_loss_fn``): a full language
+    model is NOT a homogeneous block stack — it has an embedding in front
+    and a norm+head+loss behind.  Passing either hook switches
+    ``state.params`` to ``{"stages": stacked_tree, "extra": extra_tree}``:
+    ``stages`` is the ``[P·V, ...]``-stacked block params sharded over the
+    stage axis as before; ``extra`` (embedding / final-norm / head params)
+    replicates.  ``embed_fn(extra, x_mb) -> acts`` maps a raw input
+    micro-batch (e.g. int token ids) to the first chunk's activations —
+    the activation shape/dtype is taken from its ``eval_shape``, so it may
+    differ from the input's.  ``head_loss_fn(extra, out_mb, y_mb) ->
+    scalar`` replaces ``loss_fn`` on the last chunk's output (norm + head
+    + mean loss in one differentiable hop).  Gradients for ``extra`` flow
+    through the same per-tick ``jax.vjp``: embedding cotangents appear
+    only on chunk-0 backward ticks, head cotangents only on last-chunk
+    backward ticks, everywhere else they are exact zeros — summed over the
+    schedule, ``psum``'d over the stage axis (each device holds a partial)
+    and ``pmean``'d over data like everything else.
     """
     n_p = mesh.shape[stage_axis]
     M, V = num_microbatches, virtual_stages
@@ -964,14 +1039,36 @@ def make_1f1b_pipeline_train_step(
     sched = _one_f_one_b_schedule(n_p, M, V)
     tbl = {k: jnp.asarray(getattr(sched, k))
            for k in ("kind", "m", "v", "frecv", "crecv", "fread", "cread")}
-    for path, leaf in jax.tree_util.tree_leaves_with_path(state_example.params):
+    extra_mode = embed_fn is not None or head_loss_fn is not None
+    if extra_mode:
+        try:
+            stages_example = state_example.params["stages"]
+            state_example.params["extra"]
+        except (TypeError, KeyError):
+            raise ValueError(
+                "embed_fn/head_loss_fn require state.params = "
+                '{"stages": stacked_tree, "extra": extra_tree}') from None
+        if head_loss_fn is None and loss_fn is None:
+            raise ValueError("pass head_loss_fn or loss_fn for the last chunk")
+    else:
+        stages_example = state_example.params
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stages_example):
         if not (hasattr(leaf, "ndim") and leaf.ndim >= 1
                 and leaf.shape[0] == L):
             raise ValueError(
                 f"1F1B pipeline requires every param leaf stacked "
                 f"[{L}, ...] (P·V); {jax.tree_util.keystr(path)} has shape "
                 f"{getattr(leaf, 'shape', None)}")
-    state_specs = stacked_state_specs(state_example, L, stage_axis)
+    if extra_mode:
+        param_specs = {
+            "stages": jax.tree.map(lambda _: P(stage_axis),
+                                   state_example.params["stages"]),
+            "extra": jax.tree.map(lambda _: P(),
+                                  state_example.params["extra"]),
+        }
+        state_specs = state_specs_like(state_example, param_specs)
+    else:
+        state_specs = stacked_state_specs(state_example, L, stage_axis)
     inv_m = 1.0 / M
 
     def _step(state, batch):
@@ -984,15 +1081,33 @@ def make_1f1b_pipeline_train_step(
         cols = tuple(
             lax.dynamic_index_in_dim(tbl[k], my_p, axis=1, keepdims=False)
             for k in ("kind", "m", "v", "frecv", "crecv", "fread", "cread"))
-        my_params = state.params  # local stack of V chunk slices
+        if extra_mode:
+            my_params = state.params["stages"]  # local stack of V chunks
+            extra = state.params["extra"]
+            act_sds = (jax.eval_shape(embed_fn, extra, xs[0])
+                       if embed_fn is not None
+                       else jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype))
+        else:
+            my_params = state.params
+            extra = {}
+            act_sds = jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)
+        zero_e = jax.tree.map(jnp.zeros_like, extra)
 
-        def fwd_only(pp, aa):
-            return block_fn(pp, aa)
+        def chunk0_in(ex, x_m, a_banked, use_banked):
+            # chunk 0 reads its input from xs (through the embedding in
+            # real-model mode); deeper chunks read the banked activation.
+            # The jnp.where routes cotangents exactly: the unselected
+            # branch's gradient is zero, so embedding grads appear only on
+            # chunk-0 backward ticks.
+            a_emb = (embed_fn(ex, x_m) if embed_fn is not None
+                     else x_m.astype(a_banked.dtype))
+            return jnp.where(use_banked, a_banked, a_emb)
 
         def tick(carry, col):
-            buf_f, buf_c, act_q, cot_q, gacc, lacc = carry
+            buf_f, buf_c, act_q, cot_q, gacc, eacc, lacc = carry
             kind, m, ev, frecv, crecv, fread, cread = col
             is_last = (my_p == n_p - 1) & (ev == V - 1)
+            use_banked = fread >= 0
             # 1. bank arrivals
             stored_a = lax.dynamic_update_index_in_dim(
                 act_q, buf_f, jnp.clip(frecv, 0), 0)
@@ -1004,9 +1119,8 @@ def make_1f1b_pipeline_train_step(
             #    schedule makes every consumer discard them)
             a_banked = lax.dynamic_index_in_dim(
                 act_q, jnp.clip(fread, 0), 0, keepdims=False)
-            a_x = lax.dynamic_index_in_dim(
+            x_m = lax.dynamic_index_in_dim(
                 xs, jnp.clip(m, 0), 0, keepdims=False)
-            a_in = jnp.where(fread >= 0, a_banked, a_x)
             cot_in = lax.dynamic_index_in_dim(
                 cot_q, jnp.clip(cread, 0), 0, keepdims=False)
             y_m = lax.dynamic_index_in_dim(
@@ -1016,38 +1130,55 @@ def make_1f1b_pipeline_train_step(
                     pr, jnp.clip(ev, 0), 0, keepdims=False),
                 my_params)
             zero_g = jax.tree.map(jnp.zeros_like, p_v)
+            a_zero = jnp.zeros(act_sds.shape, act_sds.dtype)
+
+            def seed_loss(ex, out, ym):
+                if head_loss_fn is not None:
+                    return head_loss_fn(ex, out, ym)
+                return loss_fn(out, ym)
 
             def idle_branch(op):
-                _pp, a, _c, _ym = op
-                return (jnp.zeros_like(a), jnp.zeros_like(a), zero_g,
+                _ex, _ab, _c, _ym = op
+                return (a_zero, a_zero, zero_g, zero_e,
                         jnp.zeros((), jnp.float32))
 
             def fwd_branch(op):
-                pp, a, _c, _ym = op
-                out = block_fn(pp, a)
-                return (out, jnp.zeros_like(a), zero_g,
+                ex, ab, _c, _ym = op
+                out = block_fn(p_v, chunk0_in(ex, x_m, ab, use_banked))
+                return (out, a_zero, zero_g, zero_e,
                         jnp.zeros((), jnp.float32))
 
             def bwd_branch(op):
-                pp, a, c, ym = op
-                out, vjp = jax.vjp(fwd_only, pp, a)
+                ex, ab, c, ym = op
+
+                def fwd_only(pp, exx, aa):
+                    return block_fn(pp, chunk0_in(exx, x_m, aa, use_banked))
+
+                out, vjp = jax.vjp(fwd_only, p_v, ex, ab)
                 # the last CHUNK seeds from the loss; others use the
                 # cotangent that rode the reverse ring
-                l_m, vjp_l = jax.vjp(lambda o: loss_fn(o, ym), out)
-                (dout_loss,) = vjp_l(jnp.asarray(inv_m, l_m.dtype))
+                l_m, vjp_l = jax.vjp(
+                    lambda exx, o: seed_loss(exx, o, ym), ex, out)
+                dex_loss, dout_loss = vjp_l(jnp.asarray(inv_m, l_m.dtype))
                 cot_eff = jnp.where(is_last, dout_loss.astype(out.dtype),
                                     c.astype(out.dtype))
-                gp, ga = vjp(cot_eff)
+                gp, gex, ga = vjp(cot_eff)
+                # head grads exist only where the loss actually seeded
+                gex = jax.tree.map(
+                    lambda g, dl: g + jnp.where(is_last, dl, 0.0).astype(
+                        g.dtype),
+                    gex, dex_loss)
                 loss_contrib = jnp.where(
                     is_last, (l_m * inv_m).astype(jnp.float32), 0.0)
-                return (jnp.zeros_like(a), ga.astype(a.dtype), gp,
+                return (a_zero, ga.astype(act_sds.dtype), gp, gex,
                         loss_contrib)
 
-            send_f, send_c, gp, l_c = lax.switch(
+            send_f, send_c, gp, gex, l_c = lax.switch(
                 kind + 1, [idle_branch, fwd_branch, bwd_branch],
-                (p_v, a_in, cot_in, y_m))
+                (extra, a_banked, cot_in, y_m))
             gacc = jax.tree.map(
                 lambda acc, g: acc.at[jnp.clip(ev, 0)].add(g), gacc, gp)
+            eacc = jax.tree.map(jnp.add, eacc, gex)
             lacc = lacc + l_c
             # 3. one hop each way around the (mod-P) ring; receivers
             #    without a scheduled arrival discard via frecv/crecv = -1
@@ -1060,19 +1191,25 @@ def make_1f1b_pipeline_train_step(
                     [(i, (i - 1) % n_p) for i in range(n_p)])
             else:
                 buf_f, buf_c = send_f, send_c
-            return (buf_f, buf_c, act_q, cot_q, gacc, lacc), None
+            return (buf_f, buf_c, act_q, cot_q, gacc, eacc, lacc), None
 
-        mb_shape = xs.shape[1:]
         carry0 = (
-            jnp.zeros(mb_shape, xs.dtype),
-            jnp.zeros(mb_shape, xs.dtype),
-            jnp.zeros((sched.Qa, *mb_shape), xs.dtype),
-            jnp.zeros((sched.Qc, *mb_shape), xs.dtype),
+            jnp.zeros(act_sds.shape, act_sds.dtype),
+            jnp.zeros(act_sds.shape, act_sds.dtype),
+            jnp.zeros((sched.Qa, *act_sds.shape), act_sds.dtype),
+            jnp.zeros((sched.Qc, *act_sds.shape), act_sds.dtype),
             jax.tree.map(jnp.zeros_like, my_params),
+            zero_e,
             jnp.zeros((), jnp.float32),
         )
-        (_, _, _, _, gacc, lacc), _ = lax.scan(tick, carry0, cols)
+        (_, _, _, _, gacc, eacc, lacc), _ = lax.scan(tick, carry0, cols)
         grads = lax.pmean(gacc, data_axis)
+        if extra_mode:
+            # every device accumulated only the extra-grad slices its own
+            # chunks produced (embedding on the chunk-0 owner, head on the
+            # last-chunk owner) — assemble over the stage ring first
+            extra_grads = lax.pmean(lax.psum(eacc, stage_axis), data_axis)
+            grads = {"stages": grads, "extra": extra_grads}
         metrics = {"loss": lax.pmean(lax.psum(lacc, stage_axis), data_axis)}
         return state.apply_gradients(grads), metrics
 
@@ -1084,6 +1221,10 @@ def make_1f1b_pipeline_train_step(
     def train_step(state, x, y):
         return stepped(state, (x, y))
 
+    train_step.lower = lambda state, x, y: stepped.lower(state, (x, y))
+    train_step.jitted = stepped
+    train_step.schedule = sched
+    train_step.bubble_fraction = (sched.T - 2 * V * M) / sched.T
     return train_step
 
 
@@ -1322,4 +1463,9 @@ def make_interleaved_pipeline_train_step(
     def train_step(state, x, y):
         return stepped(state, (x, y))
 
+    train_step.lower = lambda state, x, y: stepped.lower(state, (x, y))
+    train_step.jitted = stepped
+    train_step.schedule = sched
+    # forward-only chunk ticks: V·M useful per device over the span T
+    train_step.bubble_fraction = (sched.T - V * M) / sched.T
     return train_step

@@ -139,6 +139,12 @@ def make_spmd_train_step(
     # (reduce-scatter / all-gather / all-to-all) are ASSERTED against this
     # HLO rather than trusted to GSPMD (tests/test_fsdp.py, test_moe.py).
     train_step.jitted = stepped
+
+    def _lower(state, *batch):
+        with mesh:
+            return stepped.lower(state, batch)
+
+    train_step.lower = _lower  # cost-probe / MFU hook (obs.xla)
     return train_step
 
 

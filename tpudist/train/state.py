@@ -45,6 +45,29 @@ class TrainState:
             tx=tx,
         )
 
+    @classmethod
+    def create_sharded(
+        cls,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        spec: Any,
+        mesh: Any = None,
+        rng: jax.Array | int = 0,
+    ) -> "TrainState":
+        """Create a state laid out by a :class:`tpudist.parallel.mesh.
+        MeshSpec`: params sharded by the spec's composed rules
+        (tp/ep rules first, fsdp takes a remaining dim), optimizer state
+        inheriting the shardings.  ``mesh`` defaults to ``spec.build()``.
+        Lazy import — the parallel layer depends on this module."""
+        from tpudist.parallel.mesh import make_composed_state
+
+        if mesh is None:
+            mesh = spec.build()
+        state, _ = make_composed_state(apply_fn, params, tx, spec, mesh,
+                                       rng=rng)
+        return state
+
     def apply_gradients(self, grads: Any) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)

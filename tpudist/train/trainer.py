@@ -37,6 +37,12 @@ from tpudist.parallel.data_parallel import (
     make_dp_train_loop,
     make_dp_train_step,
 )
+from tpudist.parallel.mesh import (
+    MeshSpec,
+    make_composed_eval_step,
+    make_composed_state,
+    make_composed_train_step,
+)
 from tpudist.train.state import TrainState
 from tpudist.utils.config import config_field
 from tpudist.utils.logging import get_logger
@@ -77,6 +83,13 @@ class TrainerConfig:
         "and the disk write overlap the next epoch (Checkpointer "
         "async_save); False restores fully synchronous saves",
     )
+    mesh_axes: str = config_field(
+        "",
+        "composed-mesh axis sizes, e.g. 'dp=2,fsdp=2,tp=2' — selects HOW "
+        "the model trains by axis size instead of strategy function "
+        "(tpudist.parallel.mesh.MeshSpec); empty keeps the legacy "
+        "data-parallel path over the provided mesh",
+    )
 
 
 class Trainer:
@@ -86,7 +99,7 @@ class Trainer:
         model_apply: Callable,
         params: Any,
         tx: optax.GradientTransformation,
-        mesh: Mesh,
+        mesh: Mesh | MeshSpec,
         train_loader: ShardedLoader,
         test_loader: ShardedLoader | None = None,
         loss_fn: Callable = cross_entropy,
@@ -94,6 +107,30 @@ class Trainer:
         seed: int = 0,
     ) -> None:
         self.config = config
+        # One declarative knob for HOW the model trains: a MeshSpec (passed
+        # directly or parsed from config.mesh_axes) selects axis sizes; the
+        # strategy follows from them (make_composed_train_step).  No spec =
+        # the legacy data-parallel path over the provided mesh, unchanged.
+        self.mesh_spec: MeshSpec | None = None
+        if isinstance(mesh, MeshSpec):
+            self.mesh_spec = mesh
+            mesh = mesh.build()
+        elif config.mesh_axes:
+            self.mesh_spec = MeshSpec.parse(config.mesh_axes)
+            for name, size in self.mesh_spec.axis_sizes().items():
+                if mesh.shape.get(name) != size:
+                    raise ValueError(
+                        f"config.mesh_axes={config.mesh_axes!r} wants axis "
+                        f"{name}={size} but the provided mesh has "
+                        f"{dict(mesh.shape)}; build it with "
+                        "MeshSpec.parse(config.mesh_axes).build()")
+        if self.mesh_spec is not None and self.mesh_spec.pp > 1:
+            raise ValueError(
+                "Trainer's epoch/eval/snapshot loop assumes a "
+                "(state, inputs, labels) step; pipeline (pp > 1) training "
+                "uses stage-stacked params and a schedule-specific batch "
+                "layout — drive make_composed_train_step directly (see "
+                "tpudist/parallel/mesh_bench.py)")
         self.mesh = mesh
         self.train_loader = train_loader
         self.test_loader = test_loader
@@ -116,12 +153,18 @@ class Trainer:
         def dp_predict(params, inputs):
             return model_apply({"params": params}, *inputs)
 
-        self.state = TrainState.create(
-            apply_fn=model_apply,
-            params=broadcast_params(params, mesh),
-            tx=tx,
-            rng=jax.random.key(seed),
-        )
+        spec = self.mesh_spec
+        if spec is None:
+            self.state = TrainState.create(
+                apply_fn=model_apply,
+                params=broadcast_params(params, mesh),
+                tx=tx,
+                rng=jax.random.key(seed),
+            )
+        else:
+            self.state, self._param_specs = make_composed_state(
+                model_apply, params, tx, spec, mesh,
+                rng=jax.random.key(seed))
         # ONE save path shared with the elastic runtime: the flat layout
         # keeps the reference's rolling snapshot.npz contract while async
         # saves overlap d2h + disk write with the next epoch's compute
@@ -129,12 +172,28 @@ class Trainer:
                                   async_save=config.async_snapshot,
                                   layout="flat")
         self._maybe_load_snapshot()
-        self.train_step = make_dp_train_step(dp_loss, mesh)
-        self.train_loop = (
-            make_dp_train_loop(dp_loss, mesh)
-            if config.steps_per_dispatch > 1 else None
-        )
-        self.eval_step = make_dp_masked_eval_step(dp_predict, mesh)
+        if spec is None:
+            self.train_step = make_dp_train_step(dp_loss, mesh)
+            self.train_loop = (
+                make_dp_train_loop(dp_loss, mesh)
+                if config.steps_per_dispatch > 1 else None
+            )
+            self.eval_step = make_dp_masked_eval_step(dp_predict, mesh)
+        else:
+            pure_dp = spec.fsdp == spec.tp == spec.ep == 1
+            self.train_step = make_composed_train_step(
+                spec, mesh, dp_loss, params=self.state.params)
+            if config.steps_per_dispatch > 1:
+                if not pure_dp:
+                    raise ValueError(
+                        "steps_per_dispatch > 1 (the fused dp scan loop) "
+                        "is data-parallel only; set it to 1 for "
+                        "fsdp/tp/ep specs")
+                self.train_loop = make_dp_train_loop(dp_loss, mesh,
+                                                     axis="dp")
+            else:
+                self.train_loop = None
+            self.eval_step = make_composed_eval_step(dp_predict, mesh)
         self.metrics = MetricLogger()
         self.throughput = ThroughputMeter(warmup_steps=2)
         # obs handles cached once: the hot loop touches them by attribute,
@@ -191,9 +250,21 @@ class Trainer:
                     "rng": self.state.rng,
                 },
             )
+            if self.mesh_spec is None:
+                params = broadcast_params(tree["params"], self.mesh)
+                opt_state = broadcast_params(tree["opt_state"], self.mesh)
+            else:
+                # restore into the composed layout: every leaf goes back
+                # where its live counterpart lives (fsdp/tp/ep shards
+                # included), not broadcast-replicated
+                place = lambda new, like: jax.device_put(new, like.sharding)
+                params = jax.tree.map(place, tree["params"],
+                                      self.state.params)
+                opt_state = jax.tree.map(place, tree["opt_state"],
+                                         self.state.opt_state)
             self.state = self.state.replace(
-                params=broadcast_params(tree["params"], self.mesh),
-                opt_state=broadcast_params(tree["opt_state"], self.mesh),
+                params=params,
+                opt_state=opt_state,
                 rng=tree["rng"],
                 step=jnp.asarray(meta.get("step", 0), jnp.int32),
             )
